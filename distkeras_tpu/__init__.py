@@ -1,0 +1,114 @@
+"""distkeras_tpu — a TPU-native distributed training framework.
+
+A ground-up rebuild of the capabilities of dist-keras (reference:
+cbonnett/dist-keras): data-parallel distributed optimization of Keras
+models — but designed TPU-first.  Where the reference runs Spark
+executors that exchange pickled weight deltas with a socket-based
+parameter server (reference: distkeras/parameter_servers.py,
+distkeras/networking.py), this framework compiles Keras 3 models to XLA
+via the JAX backend and combines gradients with XLA collectives over the
+TPU ICI mesh (``jax.sharding`` + ``jit``/``shard_map``).  The Spark
+DataFrame data plane is replaced by a host-sharded, device-prefetching
+column Dataset.
+
+Public surface (mirrors the reference's — see SURVEY.md §2):
+
+* Trainers (reference: distkeras/trainers.py): :class:`SingleTrainer`,
+  :class:`ADAG`, :class:`DOWNPOUR`, :class:`AEASGD`, :class:`EAMSGD`,
+  :class:`DynSGD`, :class:`AveragingTrainer`, :class:`EnsembleTrainer`.
+* Predictors (reference: distkeras/predictors.py): :class:`ModelPredictor`.
+* Transformers (reference: distkeras/transformers.py):
+  :class:`OneHotTransformer`, :class:`LabelIndexTransformer`,
+  :class:`MinMaxTransformer`, :class:`ReshapeTransformer`,
+  :class:`DenseTransformer`.
+* Evaluators (reference: distkeras/evaluators.py): :class:`AccuracyEvaluator`.
+* Serialization (reference: distkeras/utils.py):
+  :func:`serialize_keras_model`, :func:`deserialize_keras_model`.
+
+The Keras backend is forced to JAX at import time: every compute path in
+this package goes through XLA.
+"""
+
+import os as _os
+import sys as _sys
+
+# The framework requires the JAX backend of Keras 3; TensorFlow is the
+# default otherwise.  Must happen before `keras` is imported anywhere.
+_os.environ.setdefault("KERAS_BACKEND", "jax")
+if _os.environ.get("KERAS_BACKEND") != "jax":  # pragma: no cover
+    raise ImportError(
+        "distkeras_tpu requires KERAS_BACKEND=jax; found %r. "
+        "Unset KERAS_BACKEND or set it to 'jax' before importing." %
+        _os.environ.get("KERAS_BACKEND"))
+if "keras" in _sys.modules:  # keras imported before us — check its backend
+    import keras as _keras
+
+    if _keras.backend.backend() != "jax":  # pragma: no cover
+        raise ImportError(
+            "keras was imported with the %r backend before distkeras_tpu "
+            "could select JAX. Either `import distkeras_tpu` before keras, "
+            "or set KERAS_BACKEND=jax in the environment." %
+            _keras.backend.backend())
+
+from distkeras_tpu.version import __version__
+
+from distkeras_tpu.utils.serialization import (
+    serialize_keras_model,
+    deserialize_keras_model,
+)
+from distkeras_tpu.models.adapter import ModelAdapter, TrainState
+from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+from distkeras_tpu.parallel.sharding import ShardingPlan
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.transformers import (
+    Transformer,
+    OneHotTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    ReshapeTransformer,
+    DenseTransformer,
+)
+from distkeras_tpu.evaluators import Evaluator, AccuracyEvaluator
+from distkeras_tpu.predictors import Predictor, ModelPredictor
+from distkeras_tpu.trainers import (
+    Trainer,
+    SingleTrainer,
+    ADAG,
+    DOWNPOUR,
+    AEASGD,
+    EAMSGD,
+    DynSGD,
+    AveragingTrainer,
+    EnsembleTrainer,
+)
+
+__all__ = [
+    "__version__",
+    "serialize_keras_model",
+    "deserialize_keras_model",
+    "ModelAdapter",
+    "TrainState",
+    "MeshSpec",
+    "make_mesh",
+    "ShardingPlan",
+    "Dataset",
+    "Transformer",
+    "OneHotTransformer",
+    "LabelIndexTransformer",
+    "MinMaxTransformer",
+    "ReshapeTransformer",
+    "DenseTransformer",
+    "Evaluator",
+    "AccuracyEvaluator",
+    "Predictor",
+    "ModelPredictor",
+    "Trainer",
+    "SingleTrainer",
+    "ADAG",
+    "DOWNPOUR",
+    "AEASGD",
+    "EAMSGD",
+    "DynSGD",
+    "AveragingTrainer",
+    "EnsembleTrainer",
+]

@@ -1,0 +1,19 @@
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.transformers import (
+    Transformer,
+    OneHotTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    ReshapeTransformer,
+    DenseTransformer,
+)
+
+__all__ = [
+    "Dataset",
+    "Transformer",
+    "OneHotTransformer",
+    "LabelIndexTransformer",
+    "MinMaxTransformer",
+    "ReshapeTransformer",
+    "DenseTransformer",
+]

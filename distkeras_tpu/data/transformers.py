@@ -1,0 +1,138 @@
+"""Column transformers (reference parity: distkeras/transformers.py).
+
+Each transformer is ``transform(dataset) -> dataset`` appending or
+replacing named columns, mirroring the reference's Spark-DataFrame
+transformers one for one (SURVEY.md §2): OneHotTransformer,
+LabelIndexTransformer, MinMaxTransformer, ReshapeTransformer,
+DenseTransformer.  They are vectorized numpy ops on host columns — the
+per-row Python udf of the reference becomes one array expression.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+class Transformer:
+    """Base: subclasses implement ``transform(dataset) -> dataset``."""
+
+    def transform(self, dataset: Dataset) -> Dataset:  # pragma: no cover
+        raise NotImplementedError
+
+    def __call__(self, dataset: Dataset) -> Dataset:
+        return self.transform(dataset)
+
+
+class OneHotTransformer(Transformer):
+    """Integer label column -> one-hot float vector column.
+
+    Reference parity: distkeras/transformers.py::OneHotTransformer.
+    """
+
+    def __init__(self, num_classes: int, input_col: str = "label",
+                 output_col: str = "label_onehot"):
+        self.num_classes = num_classes
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        labels = dataset[self.input_col].astype(np.int64)
+        onehot = np.eye(self.num_classes, dtype=np.float32)[labels]
+        return dataset.with_column(self.output_col, onehot)
+
+
+class LabelIndexTransformer(Transformer):
+    """Prediction-vector column -> argmax index column.
+
+    Reference parity: distkeras/transformers.py::LabelIndexTransformer
+    (used after ModelPredictor to turn raw outputs into class labels,
+    SURVEY.md §3.5).
+    """
+
+    def __init__(self, input_col: str = "prediction",
+                 output_col: str = "prediction_index"):
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        preds = dataset[self.input_col]
+        return dataset.with_column(self.output_col,
+                                   np.argmax(preds, axis=-1).astype(np.int64))
+
+
+class MinMaxTransformer(Transformer):
+    """Scale a column to [new_min, new_max] given observed/known bounds.
+
+    Reference parity: distkeras/transformers.py::MinMaxTransformer.
+    Bounds may be supplied (the reference requires them) or computed
+    from the data when omitted.
+    """
+
+    def __init__(self, input_col: str = "features",
+                 output_col: str | None = None,
+                 o_min: float | None = None, o_max: float | None = None,
+                 n_min: float = 0.0, n_max: float = 1.0):
+        self.input_col = input_col
+        self.output_col = output_col or input_col
+        self.o_min, self.o_max = o_min, o_max
+        self.n_min, self.n_max = n_min, n_max
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        x = dataset[self.input_col].astype(np.float32)
+        o_min = self.o_min if self.o_min is not None else float(x.min())
+        o_max = self.o_max if self.o_max is not None else float(x.max())
+        scale = (self.n_max - self.n_min) / max(o_max - o_min, 1e-12)
+        return dataset.with_column(self.output_col,
+                                   (x - o_min) * scale + self.n_min)
+
+
+class ReshapeTransformer(Transformer):
+    """Reshape each row of a column (flat vector -> image tensor).
+
+    Reference parity: distkeras/transformers.py::ReshapeTransformer
+    (used to feed CNNs from flat Spark vectors).
+    """
+
+    def __init__(self, input_col: str, output_col: str, shape: tuple):
+        self.input_col = input_col
+        self.output_col = output_col
+        self.shape = tuple(shape)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        x = dataset[self.input_col]
+        return dataset.with_column(self.output_col,
+                                   x.reshape((len(x),) + self.shape))
+
+
+class DenseTransformer(Transformer):
+    """Sparse (indices, values) columns -> dense vector column.
+
+    Reference parity: distkeras/transformers.py::DenseTransformer
+    (Spark sparse vectors -> dense).  Input is a pair of object-arrays of
+    per-row index/value arrays, or an already-dense column (passthrough).
+    """
+
+    def __init__(self, input_col: str = "features",
+                 output_col: str | None = None, size: int | None = None,
+                 indices_col: str | None = None, values_col: str | None = None):
+        self.input_col = input_col
+        self.output_col = output_col or input_col
+        self.size = size
+        self.indices_col = indices_col
+        self.values_col = values_col
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        if self.indices_col and self.values_col:
+            idx = dataset[self.indices_col]
+            val = dataset[self.values_col]
+            if self.size is None:
+                raise ValueError("DenseTransformer needs size= for sparse input")
+            out = np.zeros((len(dataset), self.size), dtype=np.float32)
+            for i, (ii, vv) in enumerate(zip(idx, val)):
+                out[i, np.asarray(ii, dtype=np.int64)] = vv
+            return dataset.with_column(self.output_col, out)
+        # Already dense: ensure float32 ndarray.
+        x = np.asarray(dataset[self.input_col], dtype=np.float32)
+        return dataset.with_column(self.output_col, x)

@@ -1,0 +1,40 @@
+"""Evaluators (reference parity: distkeras/evaluators.py).
+
+``evaluate(dataset) -> float`` over named columns, mirroring the
+reference's ``AccuracyEvaluator`` that compared a label column with a
+prediction-index column on a Spark DataFrame.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+class Evaluator:
+    def evaluate(self, dataset: Dataset) -> float:  # pragma: no cover
+        raise NotImplementedError
+
+
+class AccuracyEvaluator(Evaluator):
+    """Fraction of rows where prediction index equals the label.
+
+    Reference parity: distkeras/evaluators.py::AccuracyEvaluator.
+    Accepts either an index column (from LabelIndexTransformer) or a raw
+    prediction-vector column (argmaxed on the fly).
+    """
+
+    def __init__(self, prediction_col: str = "prediction_index",
+                 label_col: str = "label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataset: Dataset) -> float:
+        preds = dataset[self.prediction_col]
+        if preds.ndim > 1:
+            preds = np.argmax(preds, axis=-1)
+        labels = dataset[self.label_col]
+        if labels.ndim > 1:  # one-hot labels
+            labels = np.argmax(labels, axis=-1)
+        return float(np.mean(preds.astype(np.int64) == labels.astype(np.int64)))

@@ -1,0 +1,3 @@
+from distkeras_tpu.models.adapter import ModelAdapter, TrainState
+
+__all__ = ["ModelAdapter", "TrainState"]

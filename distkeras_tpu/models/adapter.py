@@ -1,0 +1,189 @@
+"""Stateless functional view of a Keras 3 model (JAX backend).
+
+This is the L1' substrate from SURVEY.md §7.1.  The reference keeps a
+*stateful* Keras model inside each Spark worker and mutates it with
+``model.train_on_batch`` (reference: distkeras/workers.py).  On TPU the
+idiomatic unit is a *pure function over pytrees*: we extract the model's
+variables once, and every train/predict step is
+
+    loss, (tv, ntv, opt_state) = step(tv, ntv, opt_state, batch)
+
+built from ``model.stateless_call`` — fully traceable, so the whole
+epoch compiles to one XLA program per shape, and ``jax.sharding``
+annotations on the pytrees drive data/tensor parallelism with collectives
+inserted by the compiler (this replaces the reference's
+parameter-server pull/commit protocol, distkeras/parameter_servers.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Sequence
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.ops.losses import resolve_loss
+from distkeras_tpu.ops.optimizers import resolve_optimizer
+from distkeras_tpu.utils.serialization import (
+    deserialize_keras_model,
+    serialize_keras_model,
+)
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Pure pytree holding everything a train step needs.
+
+    ``tv``/``ntv`` are the trainable / non-trainable variable values, in
+    the order Keras reports them.  ``opt_state`` is the optax state over
+    ``tv``.  ``step`` is the global step counter (device scalar, so the
+    whole state lives on-device between steps).
+    """
+
+    tv: Any
+    ntv: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+class ModelAdapter:
+    """Wraps a Keras 3 model into stateless apply / train-step builders.
+
+    One adapter instance owns the (traced-once) Keras object; all actual
+    compute flows through pure functions that close over the model's
+    *structure* but take variables as explicit pytree arguments.
+    """
+
+    def __init__(self, keras_model, loss="categorical_crossentropy",
+                 optimizer="sgd", learning_rate: float | None = None,
+                 metrics: Sequence[str] = ()):
+        import keras  # deferred so KERAS_BACKEND is already forced
+
+        if keras.backend.backend() != "jax":  # pragma: no cover
+            raise RuntimeError(
+                "distkeras_tpu requires the Keras JAX backend, but keras is "
+                "running on %r. Import distkeras_tpu before keras, or set "
+                "KERAS_BACKEND=jax." % keras.backend.backend())
+        self.model = keras_model
+        if not keras_model.built:
+            raise ValueError(
+                "Keras model must be built (call it once or pass an Input "
+                "layer) before wrapping in ModelAdapter")
+        self.loss_fn = resolve_loss(loss)
+        self.optimizer = resolve_optimizer(optimizer, learning_rate)
+        self.metrics = tuple(metrics)
+        # Variable paths, for sharding rules keyed on names.
+        self.tv_paths = [v.path for v in keras_model.trainable_variables]
+        self.ntv_paths = [v.path for v in keras_model.non_trainable_variables]
+
+    # ---------------------------------------------------------------- state
+
+    def init_state(self) -> TrainState:
+        """Snapshot the Keras variables into a fresh TrainState."""
+        tv = [jnp.asarray(v.value) for v in self.model.trainable_variables]
+        ntv = [jnp.asarray(v.value) for v in self.model.non_trainable_variables]
+        return TrainState(
+            tv=tv,
+            ntv=ntv,
+            opt_state=self.optimizer.init(tv),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def write_back(self, state: TrainState) -> None:
+        """Copy trained values from a TrainState back into the Keras model."""
+        for var, val in zip(self.model.trainable_variables, state.tv):
+            var.assign(np.asarray(val))
+        for var, val in zip(self.model.non_trainable_variables, state.ntv):
+            var.assign(np.asarray(val))
+
+    def export_model(self, state: TrainState):
+        """Return a *new* Keras model holding the trained weights.
+
+        Mirrors the reference trainers returning a fresh deserialized
+        model to the driver (distkeras/trainers.py Trainer.train).
+        """
+        self.write_back(state)
+        return deserialize_keras_model(serialize_keras_model(self.model))
+
+    # ---------------------------------------------------------------- fns
+
+    def stateless_apply(self, tv, ntv, x, training: bool = False):
+        """Pure forward pass: returns (outputs, updated_ntv)."""
+        out, ntv2 = self.model.stateless_call(tv, ntv, x, training=training)
+        return out, ntv2
+
+    def make_loss_fn(self) -> Callable:
+        """Pure ``f(tv, ntv, x, y) -> (loss, ntv')`` for value_and_grad."""
+        model, loss_fn = self.model, self.loss_fn
+
+        def compute_loss(tv, ntv, x, y):
+            preds, ntv2 = model.stateless_call(tv, ntv, x, training=True)
+            return loss_fn(y, preds), ntv2
+
+        return compute_loss
+
+    def make_train_step(self) -> Callable:
+        """Build ``step(state, x, y) -> (state', loss)`` (not yet jitted).
+
+        The caller decides how to jit/shard it — SingleTrainer jits it
+        plain; distributed trainers wrap it with shardings over a mesh.
+        """
+        compute_loss = self.make_loss_fn()
+        optimizer = self.optimizer
+
+        def train_step(state: TrainState, x, y):
+            grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+            (loss, ntv2), grads = grad_fn(state.tv, state.ntv, x, y)
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.tv)
+            tv = jax.tree.map(lambda p, u: p + u, state.tv, updates)
+            return TrainState(tv=tv, ntv=ntv2, opt_state=opt_state,
+                              step=state.step + 1), loss
+
+        return train_step
+
+    def make_accum_train_step(self, window: int) -> Callable:
+        """Build a gradient-accumulation step over ``window`` microbatches.
+
+        ``step(state, xs, ys)`` with ``xs: [window, B, ...]`` scans the
+        microbatches, accumulating gradients, then applies one optimizer
+        update on the mean gradient.  This is the synchronous semantics of
+        the reference's ``communication_window`` commit cadence
+        (distkeras/workers.py: workers accumulate for N batches then
+        commit to the parameter server) — see SURVEY.md §7.4.
+        """
+        compute_loss = self.make_loss_fn()
+        optimizer = self.optimizer
+
+        def train_step(state: TrainState, xs, ys):
+            grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+            zero = jax.tree.map(jnp.zeros_like, state.tv)
+
+            def micro(carry, batch):
+                g_acc, ntv, loss_acc = carry
+                x, y = batch
+                (loss, ntv2), grads = grad_fn(state.tv, ntv, x, y)
+                g_acc = jax.tree.map(jnp.add, g_acc, grads)
+                return (g_acc, ntv2, loss_acc + loss), None
+
+            (g_sum, ntv2, loss_sum), _ = jax.lax.scan(
+                micro, (zero, state.ntv, jnp.zeros(())), (xs, ys))
+            grads = jax.tree.map(lambda g: g / window, g_sum)
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.tv)
+            tv = jax.tree.map(lambda p, u: p + u, state.tv, updates)
+            return TrainState(tv=tv, ntv=ntv2, opt_state=opt_state,
+                              step=state.step + 1), loss_sum / window
+
+        return train_step
+
+    def make_predict_fn(self) -> Callable:
+        """Pure ``f(tv, ntv, x) -> outputs`` (inference mode)."""
+        model = self.model
+
+        def predict(tv, ntv, x):
+            out, _ = model.stateless_call(tv, ntv, x, training=False)
+            return out
+
+        return predict

@@ -1,0 +1,4 @@
+from distkeras_tpu.ops.losses import resolve_loss
+from distkeras_tpu.ops.optimizers import resolve_optimizer
+
+__all__ = ["resolve_loss", "resolve_optimizer"]

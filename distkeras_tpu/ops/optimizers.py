@@ -1,0 +1,54 @@
+"""Optimizer resolution: reference-style names -> optax transforms.
+
+The reference hands Keras optimizer name strings to ``model.compile``
+inside each Spark worker (reference: distkeras/trainers.py
+``worker_optimizer`` kwarg).  Here the same names resolve to optax
+gradient transformations applied inside the jitted train step, so the
+update math runs on-device and fuses with the backward pass.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def resolve_optimizer(spec, learning_rate: float | None = None
+                      ) -> optax.GradientTransformation:
+    """Resolve ``spec`` to an ``optax.GradientTransformation``.
+
+    ``spec`` may be:
+      * a string name: sgd, adam, adamw, adagrad, adadelta, rmsprop, nadam
+        (the set the reference's Keras 1/2 accepted for ``worker_optimizer``)
+      * an ``optax.GradientTransformation`` (passed through)
+    ``learning_rate`` overrides the per-name default (the Keras default).
+    """
+    if isinstance(spec, optax.GradientTransformation):
+        return spec
+    if not isinstance(spec, str):
+        raise TypeError(
+            f"optimizer spec must be a name or optax transform, got {type(spec)}")
+
+    name = spec.lower()
+    defaults = {
+        "sgd": 0.01,
+        "adam": 0.001,
+        "adamw": 0.001,
+        "nadam": 0.001,
+        "adagrad": 0.01,
+        "adadelta": 1.0,
+        "rmsprop": 0.001,
+    }
+    if name not in defaults:
+        raise ValueError(
+            f"Unknown optimizer {spec!r}; known: {sorted(defaults)}")
+    lr = learning_rate if learning_rate is not None else defaults[name]
+    factory = {
+        "sgd": optax.sgd,
+        "adam": optax.adam,
+        "adamw": optax.adamw,
+        "nadam": optax.nadam,
+        "adagrad": optax.adagrad,
+        "adadelta": optax.adadelta,
+        "rmsprop": optax.rmsprop,
+    }[name]
+    return factory(lr)

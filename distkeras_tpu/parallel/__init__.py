@@ -1,0 +1,4 @@
+from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh, local_device_count
+from distkeras_tpu.parallel.sharding import ShardingPlan
+
+__all__ = ["MeshSpec", "make_mesh", "local_device_count", "ShardingPlan"]

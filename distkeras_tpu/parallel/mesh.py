@@ -1,0 +1,103 @@
+"""Device mesh construction (L2' — replaces the reference's transport).
+
+The reference's communication fabric is a hand-rolled star of TCP
+sockets between Spark executors and a driver-side parameter server
+(reference: distkeras/networking.py — connect/send_data/recv_data — and
+distkeras/parameter_servers.py).  The TPU-native equivalent is a
+``jax.sharding.Mesh`` over the device grid: collectives (psum /
+all-gather / reduce-scatter) are emitted by XLA from sharding
+annotations and ride the ICI torus, with DCN used automatically across
+pod slices.  There is deliberately *no* user-level transport code in
+this package — deleting the pickle-over-TCP hot path is the point
+(SURVEY.md §3.2 identifies it as the reference's scalability
+bottleneck).
+
+Multi-host: call :func:`initialize_multihost` once per host process
+before building a mesh; ``jax.devices()`` then spans the whole pod and
+the same MeshSpec code path produces a global mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+# Canonical axis names, in mesh order.  data = batch (DP replicas),
+# model = tensor parallelism, pipeline/seq/expert reserved for the wider
+# parallelism surface (PP/SP/EP) layered on the same mesh.
+AXES = ("data", "model", "pipeline", "seq", "expert")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape.  ``-1`` on ``data`` means "all remaining devices".
+
+    Only axes with size > 1 consume devices; every axis is always present
+    in the mesh so PartitionSpecs can name them unconditionally.
+    """
+
+    data: int = -1
+    model: int = 1
+    pipeline: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        fixed = self.model * self.pipeline * self.seq * self.expert
+        if n_devices % fixed:
+            raise ValueError(
+                f"{n_devices} devices not divisible by model*pipeline*seq*expert={fixed}")
+        data = self.data if self.data != -1 else n_devices // fixed
+        total = data * fixed
+        if total != n_devices:
+            raise ValueError(
+                f"MeshSpec {self} needs {total} devices, have {n_devices}")
+        return (data, self.model, self.pipeline, self.seq, self.expert)
+
+
+def make_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` from a :class:`MeshSpec`.
+
+    Device order follows ``jax.devices()`` which JAX already orders for
+    ICI locality on TPU; the innermost mesh axes get the nearest
+    neighbours, so put the highest-bandwidth-hungry axis (model) after
+    data when both are >1.
+    """
+    spec = spec or MeshSpec()
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    shape = spec.resolve(devices.size)
+    return Mesh(devices.reshape(shape), AXES)
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def initialize_multihost(coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> None:
+    """Join a multi-host JAX runtime (one call per host process).
+
+    Replaces the reference's process-management inheritance from Spark
+    (SURVEY.md §5: Spark executors host the workers).  On TPU pods the
+    hosts coordinate through ``jax.distributed``; afterwards
+    ``jax.devices()`` is global and every mesh built here spans the pod.
+
+    No-op when running single-process (the common dev/test case).
+    """
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return int(mesh.shape[axis])

@@ -1,0 +1,111 @@
+"""Sharding plans: variable-path rules -> PartitionSpecs.
+
+The reference has exactly one placement policy: the full weight vector
+lives on the parameter server and full copies live on every worker
+(distkeras/parameter_servers.py holds the "center variable").  Here
+placement is a first-class, declarative plan: regex rules over Keras
+variable paths map each parameter to a ``PartitionSpec`` on the mesh.
+The default plan is pure data parallelism (weights replicated, batch
+split over ``data``); a tensor-parallel plan shards the big matmul
+operands over ``model`` and XLA inserts the all-gathers/reduce-scatters.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ShardingPlan:
+    """Ordered (regex, PartitionSpec) rules; first match wins.
+
+    Unmatched variables are replicated.  Rules match against the Keras
+    variable path (e.g. ``"dense_1/kernel"``).
+    """
+
+    def __init__(self, rules: Sequence[tuple[str, P]] = (),
+                 batch_spec: P = P("data")):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.batch_spec = batch_spec
+
+    def spec_for(self, path: str, ndim: int | None = None) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return spec
+        return P()
+
+    # ------------------------------------------------------------- builders
+
+    def param_shardings(self, mesh: Mesh, paths: Sequence[str]):
+        """NamedShardings for a list-of-arrays pytree ordered like ``paths``."""
+        return [NamedSharding(mesh, self.spec_for(p)) for p in paths]
+
+    def state_shardings(self, mesh: Mesh, state, tv_paths: Sequence[str]):
+        """Shardings pytree matching a :class:`TrainState`.
+
+        ``tv`` (and its optimizer-state mirrors) get the plan's rules;
+        ``ntv``/``step`` are replicated.  Optax states are pytrees whose
+        array leaves mirror parameter shapes (mu/nu in adam etc.) or are
+        scalars; we map any leaf whose shape matches a param positionally.
+        """
+        tv_sh = self.param_shardings(mesh, tv_paths)
+        rep = NamedSharding(mesh, P())
+
+        # Optax states embed subtrees mirroring the param pytree (our tv
+        # is a flat list, so e.g. adam's mu/nu are lists in tv order).
+        # Match each opt-state leaf to its param by the *index* of the
+        # innermost list it sits in — positional, not shape-based, so
+        # same-shaped params with different specs stay distinct.  A leaf
+        # whose innermost-list index doesn't correspond to a matching
+        # param shape (EmptyState internals, scalar counts) replicates.
+        tv_list = list(state.tv)
+
+        def opt_leaf_sharding(path, leaf):
+            idx = None
+            for key in reversed(path):
+                if isinstance(key, jax.tree_util.SequenceKey):
+                    idx = key.idx
+                    break
+            if (idx is not None and idx < len(tv_list)
+                    and hasattr(leaf, "shape")
+                    and tuple(leaf.shape) == tuple(tv_list[idx].shape)):
+                return tv_sh[idx]
+            return rep
+
+        from distkeras_tpu.models.adapter import TrainState
+
+        return TrainState(
+            tv=tv_sh,
+            ntv=jax.tree.map(lambda _: rep, state.ntv),
+            opt_state=jax.tree_util.tree_map_with_path(
+                opt_leaf_sharding, state.opt_state),
+            step=rep,
+        )
+
+    def batch_sharding(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.batch_spec)
+
+
+def dp_plan() -> ShardingPlan:
+    """Pure data parallelism: replicate weights, split batch on ``data``."""
+    return ShardingPlan(rules=(), batch_spec=P("data"))
+
+
+def tp_plan(extra_rules: Sequence[tuple[str, P]] = ()) -> ShardingPlan:
+    """Data + tensor parallelism for dense/conv/embedding stacks.
+
+    Default rules follow the Megatron layout on the ``model`` axis:
+    dense kernels column-sharded ([in, out] -> out over model); embeddings
+    sharded over the vocab/feature dim; conv kernels over output channels.
+    XLA turns the resulting partial products into psum/reduce-scatter on
+    the ICI.
+    """
+    rules = list(extra_rules) + [
+        (r"(dense|mlp|fc)[^/]*/kernel$", P(None, "model")),
+        (r"embedding[^/]*/embeddings$", P(None, "model")),
+        (r"conv[^/]*/kernel$", P(None, None, None, "model")),
+    ]
+    return ShardingPlan(rules=rules, batch_spec=P("data"))

@@ -1,0 +1,26 @@
+from distkeras_tpu.trainers.base import Trainer, SingleTrainer
+from distkeras_tpu.trainers.distributed import (
+    DistributedTrainer,
+    ADAG,
+    DynSGD,
+)
+from distkeras_tpu.trainers.elastic import (
+    AEASGD,
+    EAMSGD,
+    DOWNPOUR,
+    AveragingTrainer,
+    EnsembleTrainer,
+)
+
+__all__ = [
+    "Trainer",
+    "SingleTrainer",
+    "DistributedTrainer",
+    "ADAG",
+    "DynSGD",
+    "AEASGD",
+    "EAMSGD",
+    "DOWNPOUR",
+    "AveragingTrainer",
+    "EnsembleTrainer",
+]

@@ -1,0 +1,107 @@
+"""Trainer base + SingleTrainer (reference parity: distkeras/trainers.py).
+
+API contract kept from the reference: construct with a Keras model,
+loss, optimizer and knobs; ``train(dataset) -> trained keras model``;
+``training_time`` attribute records the wall clock of the run
+(reference: Trainer.train records training_time; SURVEY.md §5 notes it
+is the reference's only perf signal).  ``history`` additionally records
+per-step losses — strictly more observability than the reference.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.models.adapter import ModelAdapter
+
+
+class Trainer:
+    """Base trainer: owns the adapter and the train() bookkeeping."""
+
+    def __init__(self, keras_model, loss="categorical_crossentropy",
+                 worker_optimizer="sgd", learning_rate: float | None = None,
+                 batch_size: int = 32, num_epoch: int = 1,
+                 features_col: str = "features", label_col: str = "label",
+                 shuffle: bool = False, seed: int | None = None):
+        self.adapter = ModelAdapter(
+            keras_model, loss=loss, optimizer=worker_optimizer,
+            learning_rate=learning_rate)
+        self.batch_size = batch_size
+        self.num_epoch = num_epoch
+        self.features_col = features_col
+        self.label_col = label_col
+        self.shuffle = shuffle
+        self.seed = seed
+        self.training_time: float = 0.0
+        self.history: list[float] = []
+
+    # -- subclass hook -----------------------------------------------------
+    def _fit(self, dataset: Dataset):  # pragma: no cover
+        raise NotImplementedError
+
+    def train(self, dataset: Dataset, features_col: str | None = None,
+              label_col: str | None = None):
+        """Train and return a fresh Keras model with the learned weights."""
+        if features_col:
+            self.features_col = features_col
+        if label_col:
+            self.label_col = label_col
+        if self.shuffle:
+            dataset = dataset.shuffle(self.seed)
+        t0 = time.perf_counter()
+        state = self._fit(dataset)
+        jax.block_until_ready(state.tv)
+        self.training_time = time.perf_counter() - t0
+        return self.adapter.export_model(state)
+
+    # -- helpers -----------------------------------------------------------
+    def _epoch_stream(self, dataset: Dataset, window: int | None = None):
+        """Yield (x, y) batches across all epochs."""
+        for _ in range(self.num_epoch):
+            ds = dataset
+            yield from ds.batches(
+                self.batch_size, features_col=self.features_col,
+                label_col=self.label_col, drop_remainder=True, window=window)
+
+    def _record(self, losses) -> None:
+        self.history.extend(float(l) for l in losses)
+
+    def _require_steps(self, losses, rows_needed: int, n_rows: int) -> None:
+        """Refuse to silently return an untrained model.
+
+        Every trainer needs at least ``rows_needed`` rows to form one
+        step; with fewer, the batch stream is empty and training would
+        be a no-op the user can't distinguish from success.
+        """
+        if not losses:
+            raise ValueError(
+                f"dataset has {n_rows} rows but one training step needs "
+                f"{rows_needed} (batch_size x num_workers x window); "
+                "reduce batch_size/communication_window/num_workers or "
+                "provide more data")
+
+
+class SingleTrainer(Trainer):
+    """Single-device training: one jitted step, a Python loop over batches.
+
+    Reference parity: distkeras/trainers.py::SingleTrainer +
+    distkeras/workers.py::SingleTrainerWorker (one partition, sequential
+    ``train_on_batch`` loop — SURVEY.md §3.1).  Here the step is one XLA
+    program; the loop merely feeds batches and retires device losses
+    without forcing a sync every step.
+    """
+
+    def _fit(self, dataset: Dataset):
+        state = self.adapter.init_state()
+        step = jax.jit(self.adapter.make_train_step(), donate_argnums=0)
+        losses = []
+        for x, y in self._epoch_stream(dataset):
+            state, loss = step(state, x, y)
+            losses.append(loss)  # device array; no sync here
+        self._require_steps(losses, self.batch_size, len(dataset))
+        self._record(losses)
+        return state

@@ -1,0 +1,126 @@
+"""Synchronous data-parallel trainers: ADAG and DynSGD.
+
+Reference parity: distkeras/trainers.py::ADAG / DynSGD +
+distkeras/workers.py::ADAGWorker / DynSGDWorker +
+distkeras/parameter_servers.py (ADAG/DynSGD parameter servers).
+
+Semantic mapping (SURVEY.md §7.4): the reference's workers accumulate
+updates for ``communication_window`` batches, then commit the
+accumulated delta to a central parameter server and pull fresh weights.
+In bulk-synchronous SPMD that cadence is *gradient accumulation*: each
+DP replica scans ``window`` microbatches accumulating gradients, the
+mean gradient is combined across replicas by the compiler-inserted
+all-reduce (the batch is sharded over the mesh ``data`` axis), and one
+optimizer update applies it.  The pickle-over-TCP parameter-server hot
+path (SURVEY.md §3.2) has no equivalent here — XLA collectives over ICI
+do the exchange.
+
+DynSGD's only difference from ADAG was staleness-scaled learning rate
+``lr/(tau+1)``; under synchronous execution staleness tau == 0, so
+DynSGD degenerates to ADAG exactly (SURVEY.md §7.4).  The class is kept
+for API parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+from distkeras_tpu.parallel.sharding import ShardingPlan, dp_plan
+from distkeras_tpu.trainers.base import Trainer
+
+
+class DistributedTrainer(Trainer):
+    """Base for mesh trainers: builds the mesh and sharding plumbing.
+
+    ``num_workers`` (reference kwarg) = number of data-parallel replicas
+    = size of the mesh's ``data`` axis.  Defaults to all visible
+    devices.  A :class:`ShardingPlan` may add tensor parallelism on the
+    ``model`` axis on top (something the reference cannot do at all).
+    """
+
+    def __init__(self, keras_model, loss="categorical_crossentropy",
+                 worker_optimizer="sgd", learning_rate: float | None = None,
+                 batch_size: int = 32, num_epoch: int = 1,
+                 num_workers: int | None = None, mesh=None,
+                 plan: ShardingPlan | None = None, **kw):
+        super().__init__(keras_model, loss=loss,
+                         worker_optimizer=worker_optimizer,
+                         learning_rate=learning_rate, batch_size=batch_size,
+                         num_epoch=num_epoch, **kw)
+        self.plan = plan or dp_plan()
+        if mesh is not None:
+            self.mesh = mesh
+        else:
+            devices = jax.devices()
+            n = num_workers or len(devices)
+            if n > len(devices):
+                raise ValueError(
+                    f"num_workers={n} exceeds visible devices ({len(devices)}); "
+                    "oversubscription is not supported — it would serialize "
+                    "on-device anyway")
+            self.mesh = make_mesh(MeshSpec(data=n), devices=devices[:n])
+        self.num_workers = int(self.mesh.shape["data"])
+
+    # ------------------------------------------------------------ helpers
+
+    def _shard_state(self, state):
+        sh = self.plan.state_shardings(self.mesh, state, self.adapter.tv_paths)
+        return jax.device_put(state, sh), sh
+
+    def _batch_sharding(self, leading_window: bool):
+        spec = (P(None, "data") if leading_window else P("data"))
+        return NamedSharding(self.mesh, spec)
+
+
+class ADAG(DistributedTrainer):
+    """Asynchronous Distributed Adaptive Gradients, synchronously.
+
+    Reference parity: distkeras/trainers.py::ADAG (the reference's own
+    flagship algorithm, SURVEY.md §3.2).  ``communication_window`` maps
+    to gradient-accumulation depth per global step.
+    """
+
+    def __init__(self, keras_model, communication_window: int = 12, **kw):
+        super().__init__(keras_model, **kw)
+        self.communication_window = communication_window
+
+    def _fit(self, dataset: Dataset):
+        w = self.communication_window
+        state = self.adapter.init_state()
+        state, state_sh = self._shard_state(state)
+        batch_sh = self._batch_sharding(leading_window=True)
+
+        step = jax.jit(
+            self.adapter.make_accum_train_step(w),
+            in_shardings=(state_sh, batch_sh, batch_sh),
+            out_shardings=(state_sh, NamedSharding(self.mesh, P())),
+            donate_argnums=0,
+        )
+
+        # Global batch = num_workers * batch_size rows per microbatch;
+        # one jitted call consumes `window` microbatches.
+        global_bs = self.batch_size * self.num_workers
+        losses = []
+        for _ in range(self.num_epoch):
+            for xs, ys in dataset.batches(
+                    global_bs, features_col=self.features_col,
+                    label_col=self.label_col, window=w):
+                state, loss = step(state, xs, ys)
+                losses.append(loss)
+        self._require_steps(losses, global_bs * w, len(dataset))
+        self._record(losses)
+        return state
+
+
+class DynSGD(ADAG):
+    """Dynamic SGD.  Reference parity: distkeras/trainers.py::DynSGD.
+
+    The reference scales each commit's learning rate by 1/(tau+1) where
+    tau is the update staleness (DynSGDParameterServer).  Synchronous
+    execution has tau == 0 identically, so DynSGD == ADAG here; kept as
+    a distinct class for API parity (SURVEY.md §7.4).
+    """

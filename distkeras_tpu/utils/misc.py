@@ -1,0 +1,28 @@
+"""Small array helpers mirroring reference distkeras/utils.py extras."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def to_dense_vector(label, num_classes: int) -> np.ndarray:
+    """Integer label -> one-hot dense vector.
+
+    Reference parity: distkeras/utils.py::to_dense_vector.  Vectorized:
+    accepts a scalar or an array of labels.
+    """
+    labels = np.asarray(label, dtype=np.int64)
+    return np.eye(num_classes, dtype=np.float32)[labels]
+
+
+def uniform_weights(model, bounds=(-0.5, 0.5), seed: int | None = None):
+    """Re-initialize every weight of ``model`` uniformly in ``bounds``.
+
+    Reference parity: distkeras/utils.py::uniform_weights.
+    """
+    rng = np.random.default_rng(seed)
+    low, high = bounds
+    model.set_weights(
+        [rng.uniform(low, high, size=w.shape).astype(w.dtype)
+         for w in model.get_weights()])
+    return model

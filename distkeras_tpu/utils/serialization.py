@@ -1,0 +1,38 @@
+"""Keras model (de)serialization.
+
+Keeps the contract of the reference's ``distkeras/utils.py``
+(``serialize_keras_model`` / ``deserialize_keras_model``: architecture as a
+JSON string plus a list of weight arrays) so that models travel as plain
+picklable dicts — across processes, into checkpoints, and between rounds.
+The reference shipped these dicts through Spark closures and TCP sockets;
+here they feed process-local reconstruction and orbax checkpoints instead,
+but the format stays a ``{"model": json, "weights": [np.ndarray]}`` dict.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def serialize_keras_model(model) -> dict:
+    """Serialize a Keras model to a picklable dict.
+
+    Reference parity: distkeras/utils.py::serialize_keras_model (JSON
+    architecture + weight list).
+    """
+    return {
+        "model": model.to_json(),
+        "weights": [np.asarray(w) for w in model.get_weights()],
+    }
+
+
+def deserialize_keras_model(blob: dict):
+    """Rebuild a Keras model from :func:`serialize_keras_model` output.
+
+    Reference parity: distkeras/utils.py::deserialize_keras_model.
+    """
+    import keras
+
+    model = keras.models.model_from_json(blob["model"])
+    model.set_weights(blob["weights"])
+    return model

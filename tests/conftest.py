@@ -1,0 +1,68 @@
+"""Test harness: force an 8-device CPU mesh (SURVEY.md §4).
+
+The JAX analogue of the reference testing its socket protocol on Spark
+``local[N]``: ``--xla_force_host_platform_device_count=8`` gives eight
+CPU devices in one process, so every pjit/shard_map collective path runs
+for real without TPU hardware.
+
+The axon sitecustomize imports jax at interpreter start with
+JAX_PLATFORMS=axon, so flipping the env var here is too late; instead we
+switch the platform through jax.config before any backend is
+initialized (verified: works as long as jax.devices() hasn't run yet).
+"""
+
+import os
+
+os.environ["KERAS_BACKEND"] = "jax"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+if os.environ.get("DKT_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 test devices, got {devs}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_blobs(n=512, dim=16, classes=4, seed=0):
+    """Linearly separable gaussian blobs — learnable in a few steps."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 4.0, (classes, dim))
+    labels = rng.integers(0, classes, n)
+    feats = centers[labels] + rng.normal(0, 0.5, (n, dim))
+    return feats.astype(np.float32), labels.astype(np.int64)
+
+
+@pytest.fixture()
+def blobs():
+    return make_blobs()
+
+
+def make_mlp(dim=16, classes=4, hidden=32, seed=0):
+    import keras
+
+    keras.utils.set_random_seed(seed)
+    return keras.Sequential([
+        keras.Input((dim,)),
+        keras.layers.Dense(hidden, activation="relu"),
+        keras.layers.Dense(classes),
+    ])
+
+
+@pytest.fixture()
+def mlp():
+    return make_mlp()

@@ -1,0 +1,123 @@
+import numpy as np
+import pytest
+
+from distkeras_tpu import (
+    Dataset,
+    OneHotTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    ReshapeTransformer,
+    DenseTransformer,
+    AccuracyEvaluator,
+)
+
+
+def make_ds(n=100):
+    return Dataset.from_arrays(
+        np.arange(n * 4, dtype=np.float32).reshape(n, 4),
+        np.arange(n, dtype=np.int64) % 3)
+
+
+def test_basics():
+    ds = make_ds()
+    assert len(ds) == 100
+    assert set(ds.columns) == {"features", "label"}
+    ds2 = ds.with_column("z", np.zeros(100))
+    assert "z" in ds2.columns and "z" not in ds.columns
+    with pytest.raises(ValueError):
+        Dataset({"a": np.zeros(3), "b": np.zeros(4)})
+
+
+def test_shuffle_is_permutation():
+    ds = make_ds().shuffle(seed=1)
+    assert sorted(ds["label"].tolist()) == sorted(make_ds()["label"].tolist())
+    assert not np.array_equal(ds["label"], make_ds()["label"])
+
+
+def test_shard_partitions_everything():
+    ds = make_ds(100)
+    parts = [ds.shard(i, 4) for i in range(4)]
+    assert sum(len(p) for p in parts) == 100
+    all_rows = np.concatenate([p["features"] for p in parts])
+    assert sorted(all_rows[:, 0].tolist()) == sorted(ds["features"][:, 0].tolist())
+
+
+def test_batches_shapes():
+    ds = make_ds(100)
+    batches = list(ds.batches(32))
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == (32, 4) and y.shape == (32,)
+
+
+def test_windowed_batches():
+    ds = make_ds(128)
+    batches = list(ds.batches(16, window=4))
+    assert len(batches) == 2
+    x, y = batches[0]
+    assert x.shape == (4, 16, 4) and y.shape == (4, 16)
+
+
+def test_one_hot_transformer():
+    ds = OneHotTransformer(3)(make_ds())
+    assert ds["label_onehot"].shape == (100, 3)
+    np.testing.assert_array_equal(ds["label_onehot"].argmax(-1), ds["label"])
+
+
+def test_label_index_transformer():
+    ds = make_ds().with_column("prediction",
+                               np.eye(3, dtype=np.float32)[make_ds()["label"]])
+    out = LabelIndexTransformer()(ds)
+    np.testing.assert_array_equal(out["prediction_index"], ds["label"])
+
+
+def test_min_max_transformer():
+    ds = MinMaxTransformer(input_col="features")(make_ds())
+    assert ds["features"].min() >= 0.0 and ds["features"].max() <= 1.0
+
+
+def test_reshape_transformer():
+    ds = ReshapeTransformer("features", "image", (2, 2, 1))(make_ds())
+    assert ds["image"].shape == (100, 2, 2, 1)
+
+
+def test_dense_transformer_sparse():
+    idx = np.empty(2, dtype=object)
+    val = np.empty(2, dtype=object)
+    idx[0], val[0] = np.array([0, 2]), np.array([1.0, 2.0])
+    idx[1], val[1] = np.array([1]), np.array([3.0])
+    ds = Dataset({"i": idx, "v": val})
+    out = DenseTransformer(indices_col="i", values_col="v", size=4,
+                           output_col="features")(ds)
+    np.testing.assert_array_equal(out["features"],
+                                  [[1, 0, 2, 0], [0, 3, 0, 0]])
+
+
+def test_accuracy_evaluator():
+    ds = make_ds().with_column("prediction_index", make_ds()["label"])
+    assert AccuracyEvaluator().evaluate(ds) == 1.0
+    wrong = (make_ds()["label"] + 1) % 3
+    ds2 = make_ds().with_column("prediction_index", wrong)
+    assert AccuracyEvaluator().evaluate(ds2) == 0.0
+
+
+def test_csv_round_trip(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("a,b,y\n1.0,2.0,0\n3.0,4.0,1\n")
+    ds = Dataset.from_csv(str(p), label_col="y")
+    assert ds["features"].shape == (2, 2)
+    np.testing.assert_array_equal(ds["y"], [0, 1])
+
+
+def test_window_requires_drop_remainder():
+    ds = make_ds(10)
+    with pytest.raises(ValueError, match="drop_remainder"):
+        list(ds.batches(2, window=3, drop_remainder=False))
+
+
+def test_csv_multiline_header(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("# comment line\na,b,y\n1.0,2.0,0\n3.0,4.0,1\n")
+    ds = Dataset.from_csv(str(p), label_col="y", skip_header=2)
+    assert ds["features"].shape == (2, 2)
+    np.testing.assert_array_equal(ds["y"], [0, 1])

@@ -1,0 +1,35 @@
+import numpy as np
+
+from distkeras_tpu import serialize_keras_model, deserialize_keras_model
+from distkeras_tpu.utils.misc import to_dense_vector, uniform_weights
+
+
+def test_round_trip(mlp):
+    blob = serialize_keras_model(mlp)
+    assert isinstance(blob["model"], str)
+    m2 = deserialize_keras_model(blob)
+    for a, b in zip(mlp.get_weights(), m2.get_weights()):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_blob_is_picklable(mlp):
+    import pickle
+
+    blob = serialize_keras_model(mlp)
+    m2 = deserialize_keras_model(pickle.loads(pickle.dumps(blob)))
+    for a, b in zip(mlp.get_weights(), m2.get_weights()):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_to_dense_vector():
+    out = to_dense_vector(2, 4)
+    np.testing.assert_array_equal(out, [0, 0, 1, 0])
+    out = to_dense_vector([0, 3], 4)
+    assert out.shape == (2, 4)
+    assert out[1, 3] == 1.0
+
+
+def test_uniform_weights(mlp):
+    uniform_weights(mlp, bounds=(-0.1, 0.1), seed=0)
+    for w in mlp.get_weights():
+        assert w.min() >= -0.1 and w.max() <= 0.1

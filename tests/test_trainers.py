@@ -1,0 +1,145 @@
+"""Trainer-family integration tests on the 8-device CPU mesh.
+
+The moral equivalent of the reference's workflow.ipynb running every
+trainer against one dataset (SURVEY.md §4) — but automated, seeded, and
+asserting accuracy, not eyeballing it.
+"""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import (
+    ADAG,
+    AEASGD,
+    AveragingTrainer,
+    DOWNPOUR,
+    DynSGD,
+    EAMSGD,
+    EnsembleTrainer,
+    SingleTrainer,
+    AccuracyEvaluator,
+    Dataset,
+    LabelIndexTransformer,
+    ModelPredictor,
+)
+from tests.conftest import make_blobs, make_mlp
+
+
+def fit_and_score(trainer_cls, n=1024, accuracy_floor=0.9, **kw):
+    x, y = make_blobs(n=n)
+    ds = Dataset.from_arrays(x, y)
+    model = make_mlp()
+    trainer = trainer_cls(model, loss="sparse_categorical_crossentropy",
+                          num_epoch=kw.pop("num_epoch", 5), **kw)
+    trained = trainer.train(ds)
+    assert trainer.training_time > 0
+    assert len(trainer.history) > 0
+    # losses should drop substantially over training
+    assert trainer.history[-1] < trainer.history[0]
+
+    scored = ModelPredictor(trained).predict(ds)
+    scored = LabelIndexTransformer()(scored)
+    acc = AccuracyEvaluator().evaluate(scored)
+    assert acc >= accuracy_floor, f"{trainer_cls.__name__} accuracy {acc}"
+    return trainer
+
+
+def test_single_trainer(devices):
+    fit_and_score(SingleTrainer, learning_rate=0.1)
+
+
+def test_adag(devices):
+    fit_and_score(ADAG, learning_rate=0.1, communication_window=2,
+                  batch_size=16)
+
+
+def test_adag_respects_num_workers(devices):
+    t = fit_and_score(ADAG, learning_rate=0.1, communication_window=2,
+                      batch_size=16, num_workers=4)
+    assert t.num_workers == 4
+
+
+def test_dynsgd(devices):
+    fit_and_score(DynSGD, learning_rate=0.1, communication_window=2,
+                  batch_size=16)
+
+
+def test_aeasgd(devices):
+    fit_and_score(AEASGD, learning_rate=0.05, rho=1.0,
+                  communication_window=4, batch_size=8, num_epoch=10)
+
+
+def test_eamsgd(devices):
+    fit_and_score(EAMSGD, learning_rate=0.02, rho=1.0, momentum=0.9,
+                  communication_window=4, batch_size=8, num_epoch=10)
+
+
+def test_downpour(devices):
+    fit_and_score(DOWNPOUR, learning_rate=0.05, communication_window=4,
+                  batch_size=8, num_epoch=10)
+
+
+def test_averaging(devices):
+    fit_and_score(AveragingTrainer, learning_rate=0.1, batch_size=8,
+                  num_epoch=10)
+
+
+def test_ensemble(devices):
+    x, y = make_blobs(n=1024)
+    ds = Dataset.from_arrays(x, y)
+    trainer = EnsembleTrainer(make_mlp(), num_models=4,
+                              loss="sparse_categorical_crossentropy",
+                              worker_optimizer="sgd", learning_rate=0.1,
+                              batch_size=8, num_epoch=10)
+    models = trainer.train(ds)
+    assert len(models) == 4
+    # models must be genuinely different (independent training)
+    w0 = models[0].get_weights()[0]
+    w1 = models[1].get_weights()[0]
+    assert not np.allclose(w0, w1)
+    # each member should be decent on its own
+    for m in models:
+        scored = LabelIndexTransformer()(ModelPredictor(m).predict(ds))
+        assert AccuracyEvaluator().evaluate(scored) > 0.8
+
+
+def test_adag_matches_single_semantics(devices):
+    """DP + accumulation must equal single-device large-batch SGD."""
+    x, y = make_blobs(n=512)
+    ds = Dataset.from_arrays(x, y)
+
+    m1 = make_mlp(seed=7)
+    t1 = SingleTrainer(m1, loss="sparse_categorical_crossentropy",
+                       worker_optimizer="sgd", learning_rate=0.1,
+                       batch_size=256, num_epoch=1)
+    trained1 = t1.train(ds)
+
+    m2 = make_mlp(seed=7)
+    t2 = ADAG(m2, loss="sparse_categorical_crossentropy",
+              worker_optimizer="sgd", learning_rate=0.1,
+              batch_size=16, communication_window=2, num_workers=8,
+              num_epoch=1)
+    trained2 = t2.train(ds)
+
+    # batch 256 = 8 workers * 16 rows * window 2 -> identical SGD math
+    for a, b in zip(trained1.get_weights(), trained2.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_too_small_dataset_raises(devices):
+    x, y = make_blobs(n=64)
+    ds = Dataset.from_arrays(x, y)
+    t = AEASGD(make_mlp(), loss="sparse_categorical_crossentropy",
+               batch_size=32, communication_window=32)
+    with pytest.raises(ValueError, match="training step needs"):
+        t.train(ds)
+
+
+def test_ensemble_honors_column_overrides(devices):
+    x, y = make_blobs(n=1024)
+    ds = Dataset({"f2": x, "y2": y})
+    t = EnsembleTrainer(make_mlp(), num_models=2,
+                        loss="sparse_categorical_crossentropy",
+                        learning_rate=0.1, batch_size=8, num_epoch=2)
+    models = t.train(ds, features_col="f2", label_col="y2")
+    assert len(models) == 2
