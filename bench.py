@@ -1,0 +1,74 @@
+"""Headline benchmark: CIFAR-CNN training throughput on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The north-star target (BASELINE.json) is >=10x samples/sec vs an
+8-executor Spark CPU baseline on the CIFAR-10 small CNN.  The reference
+publishes no numbers, so the baseline is the measured proxy from
+scripts/measure_cpu_baseline.py: a single-process Keras
+``train_on_batch`` CPU loop (the reference worker's exact hot path,
+reference: distkeras/workers.py) x 8 executors, charging the reference
+nothing for its parameter-server overhead.  Measured on this machine
+2026-07-29: 267.1 samples/sec single-process -> 2137 samples/sec
+8-executor proxy (see BASELINE.md).
+
+TPU-side setup: bf16 compute (MXU-native), batch 1024, jitted
+train step with donated state, synthetic device-resident data so the
+measurement is pure training throughput.
+"""
+
+import json
+import os
+import time
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+SPARK8_CPU_PROXY_SPS = 2137.0  # samples/sec; provenance in module docstring
+
+BATCH = 1024
+WARMUP = 10
+ITERS = 300
+
+
+def main():
+    import jax
+    import numpy as np
+    import keras
+
+    keras.mixed_precision.set_global_policy("mixed_bfloat16")
+
+    from distkeras_tpu.models.adapter import ModelAdapter
+    from distkeras_tpu.models.zoo import cifar_cnn
+
+    model = cifar_cnn(seed=0)
+    adapter = ModelAdapter(model, loss="sparse_categorical_crossentropy",
+                           optimizer="sgd", learning_rate=0.01)
+    state = adapter.init_state()
+    step = jax.jit(adapter.make_train_step(), donate_argnums=0)
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.normal(size=(BATCH, 32, 32, 3)).astype(np.float32))
+    y = jax.device_put(rng.integers(0, 10, BATCH))
+
+    for _ in range(WARMUP):
+        state, loss = step(state, x, y)
+    float(loss)  # device->host transfer: a true barrier (the axon
+    # relay's block_until_ready returns before remote execution drains)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, loss = step(state, x, y)
+    float(loss)  # barrier through the sequential state dependency chain
+    dt = time.perf_counter() - t0
+
+    sps = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "cifar_cnn_train_throughput",
+        "value": round(sps, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps / SPARK8_CPU_PROXY_SPS, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

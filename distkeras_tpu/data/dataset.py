@@ -71,7 +71,7 @@ class Dataset:
         return Dataset(cols)
 
     @staticmethod
-    def from_csv(path: str, label_col: str | None = None,
+    def from_csv(path: str, label_col: str | int | None = None,
                  features_col: str = "features", dtype=np.float32,
                  delimiter: str = ",", skip_header: int = 1) -> "Dataset":
         """Read a numeric CSV into one features matrix (+ optional label).
@@ -86,7 +86,21 @@ class Dataset:
             path, delimiter=delimiter,
             names=True if skip_header else None,
             skip_header=max(0, skip_header - 1),
-            dtype=None, encoding="utf-8")
+            # Headerless: force a plain 2-D float array (dtype=None would
+            # build a structured array with synthetic f0..fN names).
+            dtype=None if skip_header else dtype, encoding="utf-8")
+        if raw.dtype.names is None:
+            # Headerless numeric CSV: label_col may be an integer index.
+            data = np.atleast_2d(raw.astype(dtype))
+            if label_col is None:
+                return Dataset({features_col: data})
+            if not isinstance(label_col, int):
+                raise ValueError(
+                    "headerless CSV (skip_header=0): label_col must be a "
+                    f"column index, got {label_col!r}")
+            labels = data[:, label_col]
+            feats = np.delete(data, label_col, axis=1)
+            return Dataset({features_col: feats, "label": labels})
         names = list(raw.dtype.names)
         if label_col is not None and label_col not in names:
             raise ValueError(f"label column {label_col!r} not in {names}")

@@ -1,0 +1,106 @@
+"""Model zoo: the reference's example workloads as Keras 3 builders.
+
+One builder per BASELINE.json config (the five benchmark workloads):
+MNIST MLP, CIFAR-10 CNN, ATLAS-Higgs tabular MLP, IMDB LSTM, and the
+ResNet-50 stretch config.  The reference defines these ad hoc inside
+example notebooks (reference: examples/mnist notebook, workflow.ipynb);
+here they are library functions so benchmarks and tests share one
+definition.
+
+All models end in *logits* (no softmax): pair them with the
+``*_crossentropy`` losses, which fold log-softmax into the loss — the
+numerically stable and XLA-fusion-friendly layout.
+"""
+
+from __future__ import annotations
+
+
+def mnist_mlp(hidden=(500, 300), num_classes: int = 10, input_dim: int = 784,
+              seed: int | None = None):
+    """3-layer MLP, the reference's canonical MNIST architecture
+    (reference: examples mnist notebook — Dense 500/300/10)."""
+    import keras
+
+    if seed is not None:
+        keras.utils.set_random_seed(seed)
+    layers = [keras.Input((input_dim,))]
+    for h in hidden:
+        layers.append(keras.layers.Dense(h, activation="relu"))
+    layers.append(keras.layers.Dense(num_classes))
+    return keras.Sequential(layers, name="mnist_mlp")
+
+
+def cifar_cnn(num_classes: int = 10, input_shape=(32, 32, 3),
+              seed: int | None = None):
+    """Small CNN for CIFAR-10 (BASELINE.json config #2)."""
+    import keras
+
+    if seed is not None:
+        keras.utils.set_random_seed(seed)
+    return keras.Sequential([
+        keras.Input(input_shape),
+        keras.layers.Conv2D(32, 3, padding="same", activation="relu"),
+        keras.layers.Conv2D(32, 3, padding="same", activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Conv2D(64, 3, padding="same", activation="relu"),
+        keras.layers.Conv2D(64, 3, padding="same", activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(512, activation="relu"),
+        keras.layers.Dense(num_classes),
+    ], name="cifar_cnn")
+
+
+def higgs_mlp(input_dim: int = 28, num_classes: int = 2,
+              hidden=(600, 600, 600), seed: int | None = None):
+    """Tabular MLP for the ATLAS Higgs task (reference: workflow.ipynb
+    trains a dense net on ~28 engineered physics features)."""
+    import keras
+
+    if seed is not None:
+        keras.utils.set_random_seed(seed)
+    layers = [keras.Input((input_dim,))]
+    for h in hidden:
+        layers.append(keras.layers.Dense(h, activation="relu"))
+    layers.append(keras.layers.Dense(num_classes))
+    return keras.Sequential(layers, name="higgs_mlp")
+
+
+def imdb_lstm(vocab_size: int = 20000, embed_dim: int = 128,
+              lstm_units: int = 128, maxlen: int = 128,
+              seed: int | None = None):
+    """LSTM sentiment classifier (BASELINE.json config #4).
+
+    Binary logits output; use ``binary_crossentropy``.
+    """
+    import keras
+
+    if seed is not None:
+        keras.utils.set_random_seed(seed)
+    return keras.Sequential([
+        keras.Input((maxlen,), dtype="int32"),
+        keras.layers.Embedding(vocab_size, embed_dim),
+        keras.layers.LSTM(lstm_units),
+        keras.layers.Dense(1),
+    ], name="imdb_lstm")
+
+
+def resnet50(num_classes: int = 1000, input_shape=(224, 224, 3),
+             seed: int | None = None):
+    """ResNet-50 (BASELINE.json stretch config), random init, logits out."""
+    import keras
+
+    if seed is not None:
+        keras.utils.set_random_seed(seed)
+    return keras.applications.ResNet50(
+        weights=None, input_shape=input_shape, classes=num_classes,
+        classifier_activation=None)
+
+
+ZOO = {
+    "mnist_mlp": mnist_mlp,
+    "cifar_cnn": cifar_cnn,
+    "higgs_mlp": higgs_mlp,
+    "imdb_lstm": imdb_lstm,
+    "resnet50": resnet50,
+}

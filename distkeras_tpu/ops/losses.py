@@ -34,10 +34,23 @@ def _sparse_categorical_crossentropy(y_true, y_pred, from_logits=True):
     return -jnp.mean(picked)
 
 
-def _binary_crossentropy(y_true, y_pred, from_logits=True):
-    import jax.nn
+def _align(y_true, y_pred):
+    """Match label rank to prediction rank for elementwise losses.
 
-    y_true = y_true.astype(y_pred.dtype)
+    (B,) labels vs (B, 1) predictions would otherwise silently
+    broadcast to (B, B) and compute garbage.
+    """
+    if y_true.ndim == y_pred.ndim - 1 and y_pred.shape[-1] == 1:
+        return y_true[..., None]
+    if y_true.shape != y_pred.shape:
+        raise ValueError(
+            f"label shape {y_true.shape} incompatible with prediction "
+            f"shape {y_pred.shape}")
+    return y_true
+
+
+def _binary_crossentropy(y_true, y_pred, from_logits=True):
+    y_true = _align(jnp.asarray(y_true), y_pred).astype(y_pred.dtype)
     if from_logits:
         # Numerically stable BCE-with-logits.
         z, x = y_true, y_pred
@@ -47,10 +60,12 @@ def _binary_crossentropy(y_true, y_pred, from_logits=True):
 
 
 def _mse(y_true, y_pred):
+    y_true = _align(jnp.asarray(y_true), y_pred)
     return jnp.mean(jnp.square(y_pred - y_true.astype(y_pred.dtype)))
 
 
 def _mae(y_true, y_pred):
+    y_true = _align(jnp.asarray(y_true), y_pred)
     return jnp.mean(jnp.abs(y_pred - y_true.astype(y_pred.dtype)))
 
 

@@ -45,7 +45,10 @@ class Trainer:
 
     def train(self, dataset: Dataset, features_col: str | None = None,
               label_col: str | None = None):
-        """Train and return a fresh Keras model with the learned weights."""
+        """Train and return a fresh Keras model with the learned weights.
+
+        (EnsembleTrainer returns a list of models via its ``_export``.)
+        """
         if features_col:
             self.features_col = features_col
         if label_col:
@@ -56,6 +59,9 @@ class Trainer:
         state = self._fit(dataset)
         jax.block_until_ready(state.tv)
         self.training_time = time.perf_counter() - t0
+        return self._export(state)
+
+    def _export(self, state):
         return self.adapter.export_model(state)
 
     # -- helpers -----------------------------------------------------------

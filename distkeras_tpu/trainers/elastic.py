@@ -40,10 +40,6 @@ from jax import shard_map
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.adapter import TrainState
 from distkeras_tpu.trainers.distributed import DistributedTrainer
-from distkeras_tpu.utils.serialization import (
-    deserialize_keras_model,
-    serialize_keras_model,
-)
 
 # A sync rule: (local_tv, center_tv, axis_name) -> (new_local_tv, new_center_tv)
 SyncFn = Callable
@@ -277,35 +273,18 @@ class EnsembleTrainer(ReplicaTrainer):
         self.communication_window = window
 
     def _replica_states(self) -> TrainState:
-        # Independent initializations: rebuild the model k times from its
-        # architecture (fresh random init each time), snapshot each.
+        # Independent initializations per member, derived from the
+        # trainer seed for reproducibility.
         states = []
-        blob = serialize_keras_model(self.adapter.model)
-        for _ in range(self.num_workers):
-            m = deserialize_keras_model(
-                {"model": blob["model"],
-                 "weights": _reinit_weights(blob["weights"])})
-            tv = [jnp.asarray(w) for w in m.get_weights()]
-            # Map weights back through the adapter ordering by loading
-            # into the adapter's model then snapshotting.
-            self.adapter.model.set_weights([np.asarray(t) for t in tv])
+        original = self.adapter.model.get_weights()
+        for i in range(self.num_workers):
+            seed = None if self.seed is None else self.seed + i
+            self.adapter.model.set_weights(_reinit_weights(original, seed))
             states.append(self.adapter.init_state())
+        self.adapter.model.set_weights(original)
         return self._stack_state(states)
 
-    def train(self, dataset: Dataset, features_col: str | None = None,
-              label_col: str | None = None) -> list:
-        import time
-
-        if features_col:
-            self.features_col = features_col
-        if label_col:
-            self.label_col = label_col
-        t0 = time.perf_counter()
-        if self.shuffle:
-            dataset = dataset.shuffle(self.seed)
-        self._fit(dataset)
-        jax.block_until_ready(self._final_stacked.tv)
-        self.training_time = time.perf_counter() - t0
+    def _export(self, state) -> list:
         models = []
         for i in range(self.num_workers):
             st = jax.tree.map(lambda a: a[i], self._final_stacked)
@@ -313,11 +292,11 @@ class EnsembleTrainer(ReplicaTrainer):
         return models
 
 
-def _reinit_weights(weights):
+def _reinit_weights(weights, seed=None):
     """Fresh glorot-ish reinitialization for matrices; 1-D weights
     (biases, BatchNorm gamma/beta, ...) keep their original init — zeroing
     them would kill normalization layers (gamma must stay at ones)."""
-    rng = np.random.default_rng()
+    rng = np.random.default_rng(seed)
     out = []
     for w in weights:
         if w.ndim >= 2:

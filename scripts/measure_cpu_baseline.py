@@ -1,0 +1,61 @@
+"""Measure the reference-shaped CPU baseline (run once, record in BASELINE.md).
+
+The reference publishes no numbers (BASELINE.md), so the 10x north-star
+target is against an "8-executor Spark CPU" baseline we must construct.
+Proxy: a single-process Keras ``model.train_on_batch`` loop on CPU —
+exactly what each reference worker runs inside its executor
+(reference: distkeras/workers.py hot loop) — scaled by 8 for the eight
+executors, charging the reference NOTHING for its parameter-server
+pickle/TCP overhead (SURVEY.md §3.2); i.e. a *generous* upper bound on
+reference throughput.
+
+Usage: python scripts/measure_cpu_baseline.py [mnist_mlp|cifar_cnn]
+"""
+
+import os
+import sys
+import time
+
+os.environ["KERAS_BACKEND"] = "jax"
+os.environ.setdefault("CPU_BASELINE", "1")
+
+import numpy as np
+
+
+def main(which: str = "cifar_cnn"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import keras
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from distkeras_tpu.models import zoo
+
+    batch = 128
+    if which == "mnist_mlp":
+        model = zoo.mnist_mlp(seed=0)
+        x = np.random.default_rng(0).normal(size=(batch, 784)).astype(np.float32)
+    elif which == "cifar_cnn":
+        model = zoo.cifar_cnn(seed=0)
+        x = np.random.default_rng(0).normal(size=(batch, 32, 32, 3)).astype(np.float32)
+    else:
+        raise SystemExit(f"unknown model {which}")
+    y = np.random.default_rng(1).integers(0, 10, batch)
+
+    model.compile(optimizer="sgd",
+                  loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True))
+    # Warmup (compile/trace)
+    for _ in range(3):
+        model.train_on_batch(x, y)
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        model.train_on_batch(x, y)
+    dt = time.perf_counter() - t0
+    sps = batch * iters / dt
+    print(f"{which}: single-process CPU train_on_batch {sps:.1f} samples/sec")
+    print(f"{which}: 8-executor Spark proxy = {8 * sps:.1f} samples/sec")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "cifar_cnn")

@@ -108,3 +108,15 @@ def test_losses_finite(name):
 def test_unknown_loss_raises():
     with pytest.raises(ValueError):
         resolve_loss("nope")
+
+
+def test_bce_rank_alignment():
+    """(B,) labels vs (B,1) logits must not broadcast to (B,B)."""
+    loss = resolve_loss("binary_crossentropy")
+    y = jnp.array([0.0, 1.0, 1.0, 0.0])
+    logits = jnp.array([[-2.0], [3.0], [1.0], [-1.0]])
+    v = float(loss(y, logits))
+    v_ref = float(loss(y[:, None], logits))
+    assert abs(v - v_ref) < 1e-6
+    with pytest.raises(ValueError, match="incompatible"):
+        loss(jnp.zeros((3,)), jnp.zeros((4, 2)))

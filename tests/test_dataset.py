@@ -121,3 +121,16 @@ def test_csv_multiline_header(tmp_path):
     ds = Dataset.from_csv(str(p), label_col="y", skip_header=2)
     assert ds["features"].shape == (2, 2)
     np.testing.assert_array_equal(ds["y"], [0, 1])
+
+
+def test_csv_headerless():
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "h.csv")
+        with open(p, "w") as f:
+            f.write("1.0,2.0,0\n3.0,4.0,1\n")
+        ds = Dataset.from_csv(p, label_col=2, skip_header=0)
+        assert ds["features"].shape == (2, 2)
+        np.testing.assert_array_equal(ds["label"], [0, 1])
+        ds2 = Dataset.from_csv(p, skip_header=0)
+        assert ds2["features"].shape == (2, 3)

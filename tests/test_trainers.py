@@ -143,3 +143,20 @@ def test_ensemble_honors_column_overrides(devices):
                         learning_rate=0.1, batch_size=8, num_epoch=2)
     models = t.train(ds, features_col="f2", label_col="y2")
     assert len(models) == 2
+
+
+def test_ensemble_seed_reproducible(devices):
+    x, y = make_blobs(n=512)
+    ds = Dataset.from_arrays(x, y)
+
+    def run():
+        t = EnsembleTrainer(make_mlp(), num_models=2,
+                            loss="sparse_categorical_crossentropy",
+                            learning_rate=0.1, batch_size=8, num_epoch=1,
+                            seed=3)
+        return t.train(ds)
+
+    a, b = run(), run()
+    for m1, m2 in zip(a, b):
+        for w1, w2 in zip(m1.get_weights(), m2.get_weights()):
+            np.testing.assert_array_equal(w1, w2)

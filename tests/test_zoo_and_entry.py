@@ -1,0 +1,57 @@
+"""Model zoo builders + driver entry points."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import zoo
+from distkeras_tpu.models.adapter import ModelAdapter
+
+
+def test_mnist_mlp_forward():
+    m = zoo.mnist_mlp(seed=0)
+    out = m(np.zeros((2, 784), np.float32))
+    assert out.shape == (2, 10)
+
+
+def test_cifar_cnn_forward():
+    m = zoo.cifar_cnn(seed=0)
+    out = m(np.zeros((2, 32, 32, 3), np.float32))
+    assert out.shape == (2, 10)
+
+
+def test_higgs_mlp_forward():
+    m = zoo.higgs_mlp(seed=0)
+    out = m(np.zeros((2, 28), np.float32))
+    assert out.shape == (2, 2)
+
+
+def test_imdb_lstm_forward():
+    m = zoo.imdb_lstm(vocab_size=100, embed_dim=8, lstm_units=8, maxlen=16,
+                      seed=0)
+    out = m(np.zeros((2, 16), np.int32))
+    assert out.shape == (2, 1)
+
+
+def test_graft_entry_single(devices):
+    import importlib.util, pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", pathlib.Path(__file__).parent.parent / "__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    import jax
+
+    fn, args = mod.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 10)
+
+
+def test_graft_entry_multichip(devices):
+    import importlib.util, pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", pathlib.Path(__file__).parent.parent / "__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.dryrun_multichip(8)
