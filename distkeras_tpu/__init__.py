@@ -68,6 +68,7 @@ from distkeras_tpu.data.transformers import (
     ReshapeTransformer,
     DenseTransformer,
 )
+from distkeras_tpu.checkpoint import CheckpointManager
 from distkeras_tpu.evaluators import Evaluator, AccuracyEvaluator
 from distkeras_tpu.predictors import Predictor, ModelPredictor
 from distkeras_tpu.trainers import (
@@ -98,6 +99,7 @@ __all__ = [
     "MinMaxTransformer",
     "ReshapeTransformer",
     "DenseTransformer",
+    "CheckpointManager",
     "Evaluator",
     "AccuracyEvaluator",
     "Predictor",

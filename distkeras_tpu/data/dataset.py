@@ -79,19 +79,11 @@ class Dataset:
         Covers the reference's canonical tabular flow (workflow.ipynb
         reads the ATLAS Higgs CSV then assembles a feature vector).
         """
-        # skip_header semantics: the number of header lines; column names
-        # are read from the *last* of them (genfromtxt's skip_header counts
-        # lines skipped before the names line).
-        raw = np.genfromtxt(
-            path, delimiter=delimiter,
-            names=True if skip_header else None,
-            skip_header=max(0, skip_header - 1),
-            # Headerless: force a plain 2-D float array (dtype=None would
-            # build a structured array with synthetic f0..fN names).
-            dtype=None if skip_header else dtype, encoding="utf-8")
-        if raw.dtype.names is None:
+        if not skip_header:
             # Headerless numeric CSV: label_col may be an integer index.
-            data = np.atleast_2d(raw.astype(dtype))
+            # ndmin=2 keeps one-column files as [n, 1], not a transposed
+            # [1, n] (np.atleast_2d on a 1-D read would do the latter).
+            data = np.loadtxt(path, delimiter=delimiter, dtype=dtype, ndmin=2)
             if label_col is None:
                 return Dataset({features_col: data})
             if not isinstance(label_col, int):
@@ -101,6 +93,15 @@ class Dataset:
             labels = data[:, label_col]
             feats = np.delete(data, label_col, axis=1)
             return Dataset({features_col: feats, "label": labels})
+        # skip_header semantics: the number of header lines; column names
+        # are read from the *last* of them (genfromtxt's skip_header counts
+        # lines skipped before the names line).
+        # dtype=None infers per-column dtypes, so a non-numeric column
+        # (string ids etc.) raises at the astype below instead of turning
+        # into silent NaNs.
+        raw = np.genfromtxt(
+            path, delimiter=delimiter, names=True, dtype=None,
+            skip_header=max(0, skip_header - 1), encoding="utf-8")
         names = list(raw.dtype.names)
         if label_col is not None and label_col not in names:
             raise ValueError(f"label column {label_col!r} not in {names}")

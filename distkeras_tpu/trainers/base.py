@@ -26,7 +26,9 @@ class Trainer:
                  worker_optimizer="sgd", learning_rate: float | None = None,
                  batch_size: int = 32, num_epoch: int = 1,
                  features_col: str = "features", label_col: str = "label",
-                 shuffle: bool = False, seed: int | None = None):
+                 shuffle: bool = False, seed: int | None = None,
+                 checkpoint_dir: str | None = None, checkpoint_every: int = 0,
+                 max_checkpoints: int = 3, resume: bool = False):
         self.adapter = ModelAdapter(
             keras_model, loss=loss, optimizer=worker_optimizer,
             learning_rate=learning_rate)
@@ -38,6 +40,22 @@ class Trainer:
         self.seed = seed
         self.training_time: float = 0.0
         self.history: list[float] = []
+        # Checkpoint/resume (SURVEY.md §5: the reference has none; here
+        # any trainer can persist its full training state via orbax).
+        self.checkpoint_every = checkpoint_every
+        self.resume = resume
+        self.checkpoint_dir = checkpoint_dir
+        self.max_checkpoints = max_checkpoints
+        self._ckpt = None
+        if resume and shuffle and seed is None:
+            raise ValueError(
+                "resume=True with shuffle=True needs a fixed seed: resume "
+                "skips the first N rounds of the stream, which only lands on "
+                "the right data if the permutation is reproducible")
+        if (resume or checkpoint_every) and not checkpoint_dir:
+            raise ValueError(
+                "resume/checkpoint_every need a checkpoint_dir — without one "
+                "nothing is restored or written")
 
     # -- subclass hook -----------------------------------------------------
     def _fit(self, dataset: Dataset):  # pragma: no cover
@@ -56,8 +74,29 @@ class Trainer:
         if self.shuffle:
             dataset = dataset.shuffle(self.seed)
         t0 = time.perf_counter()
-        state = self._fit(dataset)
-        jax.block_until_ready(state.tv)
+        if self.checkpoint_dir:
+            from distkeras_tpu.checkpoint import CheckpointManager
+
+            # Opened per run and closed on exit so orbax's async machinery
+            # doesn't outlive the training it serves.
+            self._ckpt = CheckpointManager(
+                self.checkpoint_dir, max_to_keep=self.max_checkpoints)
+            if not self.resume and self._ckpt.latest_step() is not None:
+                self._ckpt.close()
+                self._ckpt = None
+                raise ValueError(
+                    f"checkpoint_dir {self.checkpoint_dir!r} already holds "
+                    "checkpoints; pass resume=True to continue from them or "
+                    "point at a fresh directory (orbax refuses to overwrite "
+                    "an existing step)")
+        self._last_saved_round = 0
+        try:
+            state = self._fit(dataset)
+            jax.block_until_ready(state.tv)
+        finally:
+            if self._ckpt is not None:
+                self._ckpt.close()
+                self._ckpt = None
         self.training_time = time.perf_counter() - t0
         return self._export(state)
 
@@ -75,6 +114,36 @@ class Trainer:
 
     def _record(self, losses) -> None:
         self.history.extend(float(l) for l in losses)
+
+    # -- checkpointing -----------------------------------------------------
+    def _restore_or(self, pytree):
+        """Return (pytree, start_round): latest checkpoint if resuming.
+
+        Resume semantics: deterministic data order; the first
+        ``start_round`` rounds of the batch stream are skipped so the
+        restored state continues exactly where the checkpoint left off.
+        """
+        if not (self._ckpt and self.resume):
+            return pytree, 0
+        step = self._ckpt.latest_step()
+        if step is None:
+            return pytree, 0
+        return self._ckpt.restore(pytree, step), step
+
+    def _checkpoint(self, pytree, round_idx: int, final: bool = False) -> None:
+        """Persist training state after round ``round_idx`` (1-based).
+
+        Blocks until the save is durable: the round loop donates state
+        buffers into the next step, so an in-flight async write must not
+        alias them.  States at dist-keras scale write in milliseconds.
+        """
+        if self._ckpt is None or round_idx == self._last_saved_round:
+            return  # (final save right after a periodic one: already durable)
+        periodic = self.checkpoint_every and round_idx % self.checkpoint_every == 0
+        if final or periodic:
+            self._ckpt.save(pytree, round_idx, force=True)
+            self._ckpt.wait_until_finished()
+            self._last_saved_round = round_idx
 
     def _require_steps(self, losses, rows_needed: int, n_rows: int) -> None:
         """Refuse to silently return an untrained model.
@@ -103,11 +172,18 @@ class SingleTrainer(Trainer):
 
     def _fit(self, dataset: Dataset):
         state = self.adapter.init_state()
+        state, start = self._restore_or(state)
         step = jax.jit(self.adapter.make_train_step(), donate_argnums=0)
-        losses = []
-        for x, y in self._epoch_stream(dataset):
+        losses, rnd = [], start
+        for rnd, (x, y) in enumerate(self._epoch_stream(dataset), 1):
+            if rnd <= start:
+                continue
             state, loss = step(state, x, y)
             losses.append(loss)  # device array; no sync here
+            self._checkpoint(state, rnd)
+        if start and not losses:  # resumed past the end: nothing left to do
+            return state
         self._require_steps(losses, self.batch_size, len(dataset))
         self._record(losses)
+        self._checkpoint(state, rnd, final=True)
         return state

@@ -104,15 +104,23 @@ class ADAG(DistributedTrainer):
         # Global batch = num_workers * batch_size rows per microbatch;
         # one jitted call consumes `window` microbatches.
         global_bs = self.batch_size * self.num_workers
-        losses = []
+        losses, rnd = [], 0
+        state, start = self._restore_or(state)
         for _ in range(self.num_epoch):
             for xs, ys in dataset.batches(
                     global_bs, features_col=self.features_col,
                     label_col=self.label_col, window=w):
+                rnd += 1
+                if rnd <= start:
+                    continue
                 state, loss = step(state, xs, ys)
                 losses.append(loss)
+                self._checkpoint(state, rnd)
+        if start and not losses:
+            return state
         self._require_steps(losses, global_bs * w, len(dataset))
         self._record(losses)
+        self._checkpoint(state, rnd, final=True)
         return state
 
 

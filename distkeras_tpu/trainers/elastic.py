@@ -154,13 +154,24 @@ class ReplicaTrainer(DistributedTrainer):
         stacked, center_tv = self._put(stacked, center_tv)
         round_fn = self._make_round(window)
 
-        losses = []
+        restored, start = self._restore_or(
+            {"stacked": stacked, "center_tv": center_tv})
+        stacked, center_tv = restored["stacked"], restored["center_tv"]
+        losses, rnd = [], 0
         for xs, ys in self._round_stream(dataset, window):
+            rnd += 1
+            if rnd <= start:
+                continue
             stacked, center_tv, loss = round_fn(stacked, center_tv, xs, ys)
             losses.append(loss)
-        self._require_steps(
-            losses, self.batch_size * self.num_workers * window, len(dataset))
-        self._record(losses)
+            self._checkpoint({"stacked": stacked, "center_tv": center_tv}, rnd)
+        if losses or not start:  # resumed-past-the-end runs skip straight to export
+            self._require_steps(
+                losses, self.batch_size * self.num_workers * window,
+                len(dataset))
+            self._record(losses)
+            self._checkpoint({"stacked": stacked, "center_tv": center_tv},
+                             rnd, final=True)
         self._final_stacked = stacked  # kept for ensemble export
         # Export the center variable; aux state (BatchNorm stats etc.)
         # taken from replica 0.

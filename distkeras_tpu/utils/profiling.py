@@ -1,0 +1,107 @@
+"""Tracing & step timing (SURVEY.md §5: reference has `training_time` only).
+
+The reference's entire observability surface is one wall-clock number
+recorded by ``Trainer.train`` (reference: distkeras/trainers.py) plus
+whatever the Spark UI shows.  Here:
+
+* :func:`trace` — context manager writing an XLA/TPU profile (HLO
+  timelines, per-op HBM/MXU utilization) viewable in TensorBoard or
+  Perfetto, via ``jax.profiler``.
+* :class:`StepTimer` — cheap per-step wall-clock stats with correct
+  device synchronization at the measurement boundaries only (never
+  inside the loop, which would stall the TPU pipeline).
+* :func:`annotate` — named region that shows up on the profile
+  timeline (``jax.profiler.TraceAnnotation``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import statistics
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Profile everything in the block into ``logdir``.
+
+    View with ``tensorboard --logdir`` (profile plugin) or upload the
+    ``.trace.json.gz`` to Perfetto.
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region on the profiler timeline (usable as ctx or decorator)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Wall-clock stats over repeated step calls.
+
+    Usage::
+
+        timer = StepTimer()
+        with timer.round():           # sync boundary outside the loop
+            for batch in batches:
+                state, loss = step(state, *batch)
+        timer.finalize(state)         # blocks, closes the open round
+        timer.mean_step_s, timer.p50_round_s, timer.samples_per_sec(n)
+
+    Device work is async: individual step dispatches return immediately,
+    so per-call timing lies.  The timer therefore measures *rounds*
+    (sync → work → sync) and divides by the step count you report.
+    """
+
+    def __init__(self):
+        self.rounds: list[tuple[float, int]] = []  # (seconds, n_steps)
+        self._t0: float | None = None
+        self._n = 0
+
+    @contextlib.contextmanager
+    def round(self, n_steps: int = 0):
+        self._t0 = time.perf_counter()
+        self._n = n_steps
+        yield self
+        # finalize() closes the round after the caller syncs.
+
+    def count(self, n: int = 1) -> None:
+        self._n += n
+
+    def finalize(self, *sync_refs) -> None:
+        """Block on ``sync_refs`` (device arrays) and close the round."""
+        if sync_refs:
+            jax.block_until_ready(sync_refs)
+        if self._t0 is not None:
+            self.rounds.append((time.perf_counter() - self._t0, self._n))
+            self._t0 = None
+            self._n = 0
+
+    # ------------------------------------------------------------- stats
+
+    @property
+    def total_s(self) -> float:
+        return sum(s for s, _ in self.rounds)
+
+    @property
+    def total_steps(self) -> int:
+        return sum(n for _, n in self.rounds)
+
+    @property
+    def mean_step_s(self) -> float:
+        n = self.total_steps
+        return self.total_s / n if n else 0.0
+
+    @property
+    def p50_round_s(self) -> float:
+        return statistics.median(s for s, _ in self.rounds) if self.rounds else 0.0
+
+    def samples_per_sec(self, samples_per_step: int) -> float:
+        return (samples_per_step * self.total_steps / self.total_s
+                if self.total_s else 0.0)

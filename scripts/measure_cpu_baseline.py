@@ -17,7 +17,6 @@ import sys
 import time
 
 os.environ["KERAS_BACKEND"] = "jax"
-os.environ.setdefault("CPU_BASELINE", "1")
 
 import numpy as np
 

@@ -1,0 +1,142 @@
+"""Checkpoint/resume (SURVEY.md §5: capability the reference lacks).
+
+Resume correctness is tested as *bit-for-bit determinism*: training N
+epochs straight through must equal training 1 epoch, checkpointing, and
+resuming for the remaining epochs from disk.
+"""
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.checkpoint import CheckpointManager
+
+from conftest import make_blobs, make_mlp
+
+
+def _weights(model):
+    return [np.asarray(w) for w in model.get_weights()]
+
+
+def test_manager_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    state = {"a": jnp.arange(12.0).reshape(3, 4), "b": [jnp.zeros(5)],
+             "step": jnp.asarray(7, jnp.int32)}
+    with CheckpointManager(str(tmp_path / "ckpt")) as mngr:
+        assert mngr.latest_step() is None
+        mngr.save(state, step=3)
+        mngr.wait_until_finished()
+        assert mngr.latest_step() == 3
+        template = {"a": jnp.zeros((3, 4)), "b": [jnp.ones(5)],
+                    "step": jnp.asarray(0, jnp.int32)}
+        out = mngr.restore(template)
+    np.testing.assert_array_equal(out["a"], state["a"])
+    np.testing.assert_array_equal(out["b"][0], state["b"][0])
+    assert int(out["step"]) == 7
+
+
+def test_manager_missing_raises(tmp_path):
+    with CheckpointManager(str(tmp_path / "empty")) as mngr:
+        with pytest.raises(FileNotFoundError):
+            mngr.restore({"x": np.zeros(2)})
+
+
+def test_manager_max_to_keep(tmp_path):
+    import jax.numpy as jnp
+
+    with CheckpointManager(str(tmp_path / "k"), max_to_keep=2) as mngr:
+        for s in (1, 2, 3):
+            mngr.save({"v": jnp.asarray(float(s))}, step=s, force=True)
+        mngr.wait_until_finished()
+        assert mngr.all_steps() == [2, 3]
+
+
+@pytest.mark.parametrize("trainer_cls,kw", [
+    (dk.SingleTrainer, {}),
+    (dk.ADAG, {"communication_window": 2, "num_workers": 4}),
+    (dk.AEASGD, {"communication_window": 2, "num_workers": 4}),
+])
+def test_resume_matches_straight_run(tmp_path, trainer_cls, kw):
+    x, y = make_blobs(n=256)
+    ds = dk.Dataset.from_arrays(x, y)
+    common = dict(loss="sparse_categorical_crossentropy",
+                  worker_optimizer="sgd", learning_rate=0.05, batch_size=16)
+
+    straight = trainer_cls(make_mlp(), num_epoch=2, **common, **kw)
+    ref = straight.train(ds)
+
+    d = str(tmp_path / "ckpt")
+    first = trainer_cls(make_mlp(), num_epoch=1, checkpoint_dir=d,
+                        **common, **kw)
+    first.train(ds)
+    resumed = trainer_cls(make_mlp(), num_epoch=2, checkpoint_dir=d,
+                          resume=True, **common, **kw)
+    out = resumed.train(ds)
+
+    for wr, wo in zip(_weights(ref), _weights(out)):
+        np.testing.assert_allclose(wr, wo, rtol=1e-5, atol=1e-6)
+    # The resumed run only executed epoch 2's rounds.
+    assert len(resumed.history) == len(straight.history) - len(first.history)
+
+
+def test_resume_past_end_returns_trained_model(tmp_path):
+    x, y = make_blobs(n=128)
+    ds = dk.Dataset.from_arrays(x, y)
+    d = str(tmp_path / "ckpt")
+    common = dict(loss="sparse_categorical_crossentropy", batch_size=16,
+                  learning_rate=0.05)
+    t1 = dk.SingleTrainer(make_mlp(), num_epoch=1, checkpoint_dir=d, **common)
+    ref = t1.train(ds)
+    t2 = dk.SingleTrainer(make_mlp(), num_epoch=1, checkpoint_dir=d,
+                          resume=True, **common)
+    out = t2.train(ds)  # nothing left to train; must not raise
+    for wr, wo in zip(_weights(ref), _weights(out)):
+        np.testing.assert_allclose(wr, wo, rtol=1e-6)
+
+
+def test_final_round_collides_with_periodic(tmp_path):
+    # checkpoint_every divides the round count: the final save must not
+    # re-save the same step (orbax raises StepAlreadyExists otherwise).
+    x, y = make_blobs(n=64)
+    ds = dk.Dataset.from_arrays(x, y)
+    t = dk.SingleTrainer(make_mlp(), loss="sparse_categorical_crossentropy",
+                         batch_size=16, num_epoch=1,
+                         checkpoint_dir=str(tmp_path / "c"), checkpoint_every=4)
+    t.train(ds)  # 4 rounds; round 4 is both periodic and final
+
+
+def test_resume_with_unseeded_shuffle_rejected(tmp_path):
+    with pytest.raises(ValueError, match="seed"):
+        dk.SingleTrainer(make_mlp(), checkpoint_dir=str(tmp_path / "c"),
+                         resume=True, shuffle=True)
+
+
+def test_resume_without_dir_rejected():
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        dk.SingleTrainer(make_mlp(), resume=True)
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        dk.SingleTrainer(make_mlp(), checkpoint_every=5)
+
+
+def test_retrain_into_populated_dir_fails_fast(tmp_path):
+    x, y = make_blobs(n=64)
+    ds = dk.Dataset.from_arrays(x, y)
+    d = str(tmp_path / "c")
+    common = dict(loss="sparse_categorical_crossentropy", batch_size=16)
+    dk.SingleTrainer(make_mlp(), checkpoint_dir=d, **common).train(ds)
+    with pytest.raises(ValueError, match="resume=True"):
+        dk.SingleTrainer(make_mlp(), checkpoint_dir=d, **common).train(ds)
+
+
+def test_periodic_checkpoints_written(tmp_path):
+    x, y = make_blobs(n=256)
+    ds = dk.Dataset.from_arrays(x, y)
+    d = str(tmp_path / "ckpt")
+    t = dk.SingleTrainer(make_mlp(), loss="sparse_categorical_crossentropy",
+                         batch_size=16, num_epoch=1, checkpoint_dir=d,
+                         checkpoint_every=5, max_checkpoints=100)
+    t.train(ds)
+    with CheckpointManager(d) as mngr:
+        steps = mngr.all_steps()
+    assert steps == [5, 10, 15, 16]  # every 5 rounds + final (16 rounds)

@@ -134,3 +134,11 @@ def test_csv_headerless():
         np.testing.assert_array_equal(ds["label"], [0, 1])
         ds2 = Dataset.from_csv(p, skip_header=0)
         assert ds2["features"].shape == (2, 3)
+
+
+def test_csv_headerless_single_column(tmp_path):
+    # One column: must parse as [n, 1] samples, not one [1, n] row.
+    p = tmp_path / "one.csv"
+    p.write_text("1.0\n2.0\n3.0\n")
+    ds = Dataset.from_csv(str(p), skip_header=0)
+    assert ds["features"].shape == (3, 1)

@@ -1,0 +1,36 @@
+"""StepTimer + profiler trace smoke (SURVEY.md §5 observability rebuild)."""
+
+import glob
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.utils.profiling import StepTimer, annotate, trace
+
+
+def test_step_timer_rounds():
+    timer = StepTimer()
+    step = jax.jit(lambda x: x * 2.0)
+    x = jnp.ones((128, 128))
+    for _ in range(3):
+        with timer.round():
+            for _ in range(4):
+                x = step(x)
+                timer.count()
+        timer.finalize(x)
+    assert timer.total_steps == 12
+    assert len(timer.rounds) == 3
+    assert timer.total_s > 0
+    assert timer.mean_step_s > 0
+    assert timer.samples_per_sec(128) > 0
+    assert timer.p50_round_s > 0
+
+
+def test_trace_writes_profile(tmp_path):
+    logdir = str(tmp_path / "prof")
+    with trace(logdir):
+        with annotate("matmul_region"):
+            y = jax.jit(lambda a: a @ a)(jnp.ones((64, 64)))
+            jax.block_until_ready(y)
+    files = glob.glob(logdir + "/**/*", recursive=True)
+    assert any("trace" in f or "xplane" in f for f in files), files
