@@ -1,0 +1,302 @@
+"""Attention kernels: naive, blockwise (online-softmax), and Pallas flash.
+
+The reference has no attention anywhere (its largest model is an LSTM —
+reference: examples, IMDB config); this module exists because the TPU
+rebuild treats long-context training as first-class.  Three tiers share
+one set of semantics so tests can pin them against each other:
+
+- :func:`naive_attention` — O(L^2) materialized logits; the numerics
+  oracle for tests.
+- :func:`blockwise_attention` — online-softmax over KV chunks
+  (`lax.scan`), O(block) memory; pure jnp so it runs on any backend and
+  is the differentiable reference for the flash kernel's VJP.  Its
+  chunk-update core (:func:`attention_chunk`) is also the per-hop step
+  of ring attention (distkeras_tpu.parallel.ring).
+- :func:`flash_attention` — Pallas TPU kernel (MXU-tiled, VMEM-resident
+  online softmax) on TPU backends; falls back to blockwise elsewhere.
+  Backward pass recomputes through the blockwise implementation
+  (flash-style rematerialization: O(L) residuals instead of O(L^2)).
+
+All take ``q: [B, Lq, H, D]``, ``k/v: [B, Lkv, H, D]`` and return
+``[B, Lq, H, D]``.  ``q_offset``/``kv_offset`` give the global positions
+of the local chunks so causal masking works when sequences are sharded
+(ring attention).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+# Finite stand-in for -inf: keeps exp()/max() NaN-free when a whole row
+# or chunk is masked (e.g. ring hops entirely in the causal future).
+NEG_INF = -1e30
+
+
+def _scale_for(q, scale):
+    return (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+
+
+def _causal_mask(lq: int, lk: int, q_offset, kv_offset):
+    """[lq, lk] bool mask: True where q position >= k position (global)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0) + q_offset
+    cols = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1) + kv_offset
+    return rows >= cols
+
+
+def naive_attention(q, k, v, causal: bool = False, scale: float | None = None,
+                    q_offset: int = 0, kv_offset: int = 0):
+    """Materialized-logits attention; the test oracle."""
+    scale = _scale_for(q, scale)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = _causal_mask(q.shape[1], k.shape[1], q_offset, kv_offset)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# --------------------------------------------------------------- online core
+
+
+def attention_chunk(q, k, v, m, l, o, causal: bool, scale: float,
+                    q_offset, kv_offset):
+    """One online-softmax update with a KV chunk.
+
+    Running state (per q row): ``m`` max logit ``[B,H,Lq]``, ``l``
+    normalizer ``[B,H,Lq]``, ``o`` unnormalized output ``[B,H,Lq,D]``.
+    This is the flash-attention recurrence; ring attention replays it
+    once per hop with the offsets of whichever shard's KV it holds.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = _causal_mask(q.shape[1], k.shape[1], q_offset, kv_offset)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(logits - m_new[..., None])
+    l_new = l * correction + p.sum(axis=-1)
+    o_new = o * correction[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def online_init(batch, heads, lq, dim, dtype=jnp.float32):
+    """Fresh (m, l, o) state for the online-softmax recurrence."""
+    return (jnp.full((batch, heads, lq), NEG_INF, dtype),
+            jnp.zeros((batch, heads, lq), dtype),
+            jnp.zeros((batch, heads, lq, dim), dtype))
+
+
+def online_finish(m, l, o):
+    """Normalize accumulated output -> [B, Lq, H, D].
+
+    Fully-masked rows return the uniform average of V — identical to
+    softmax over an all-``NEG_INF`` row, i.e. exactly what the naive
+    oracle computes (finite NEG_INF keeps every tier NaN-free and
+    mutually consistent).  The ``l == 0`` guard only protects against
+    catastrophic exp-underflow, not the masked case.
+    """
+    out = o / jnp.where(l == 0, 1.0, l)[..., None]
+    return out.transpose(0, 2, 1, 3)
+
+
+def blockwise_attention(q, k, v, causal: bool = False,
+                        scale: float | None = None, block_k: int = 512,
+                        q_offset: int = 0, kv_offset: int = 0):
+    """Online-softmax attention scanning KV in chunks; O(block_k) logits.
+
+    Pure jnp: the differentiable any-backend reference for
+    :func:`flash_attention`, and the single-device semantics that ring
+    attention distributes.
+    """
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    # Clamp to the largest divisor of lk <= block_k so any length works
+    # (e.g. lk=1000 -> 500).  Prime lk degenerates to block_k=1 — pick
+    # a composite sequence length if that matters.
+    block_k = min(block_k, lk)
+    while lk % block_k:
+        block_k -= 1
+    scale = _scale_for(q, scale)
+    n_blocks = lk // block_k
+    # [n, B, block, H, D] chunk-major for lax.scan.
+    ks = k.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    vs = v.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    qf = q.astype(jnp.float32)
+
+    def body(carry, chunk):
+        m, l, o = carry
+        kc, vc, idx = chunk
+        m, l, o = attention_chunk(
+            qf, kc.astype(jnp.float32), vc.astype(jnp.float32), m, l, o,
+            causal, scale, q_offset, kv_offset + idx * block_k)
+        return (m, l, o), None
+
+    (m, l, o), _ = jax.lax.scan(
+        body, online_init(b, h, lq, d), (ks, vs, jnp.arange(n_blocks)))
+    return online_finish(m, l, o).astype(q.dtype)
+
+
+# ------------------------------------------------------------- Pallas kernel
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  causal: bool, scale: float):
+    """Flash-attention forward for one (batch*head, q-block, kv-block) cell.
+
+    KV streams through the grid's innermost dimension so VMEM holds only
+    one [block_k, D] tile at a time — sequence length is HBM-bound, not
+    VMEM-bound.  Online-softmax state (m, l, acc) lives in VMEM scratch,
+    which persists across the sequential kv-block iterations; it is
+    initialized at j == 0 and the normalized output is written at the
+    last j.  ``m``/``l`` are stored lane-broadcast ([block_q, 128]) to
+    respect the f32 (8, 128) tile.
+    """
+    j = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: kv blocks strictly above the diagonal contribute nothing;
+    # predicate the whole update away (restores the ~2x causal saving).
+    row0 = pl.program_id(1) * block_q
+    live = (not causal) or (j * block_k <= row0 + block_q - 1)
+
+    @pl.when(live)
+    def _update():
+        qi = jax.lax.convert_element_type(q_ref[0], jnp.float32) * scale
+        kj = jax.lax.convert_element_type(k_ref[0], jnp.float32)
+        vj = jax.lax.convert_element_type(v_ref[0], jnp.float32)
+        logits = jax.lax.dot_general(
+            qi, kj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [block_q, block_k]
+        if causal:
+            rows = (jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+                    + row0)
+            cols = (jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+                    + j * block_k)
+            logits = jnp.where(rows >= cols, logits, NEG_INF)
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, vj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_kb - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        out = acc_scr[:] / jnp.where(l == 0, 1.0, l)
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+try:  # Pallas import is cheap but keep non-TPU environments working.
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
+
+    def call(): return pl.pallas_call(
+        kernel,
+        grid=(b * h, lq // block_q, lk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # m (lane-broadcast)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # l
+            pltpu.VMEM((block_q, d), jnp.float32),    # acc
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * lq * lk * d,
+            bytes_accessed=(qf.size + kf.size + vf.size) * q.dtype.itemsize,
+            transcendentals=b * h * lq * lk,
+        ),
+    )(qf, kf, vf)
+
+    if interpret:
+        # The TPU-semantics interpreter: validates the kernel (incl.
+        # program_id, memory spaces) on CPU in tests.  The mode is
+        # captured at pallas_call *construction*, hence the thunk.
+        with pltpu.force_tpu_interpret_mode():
+            out = call()
+    else:
+        out = call()
+    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+
+
+def _use_pallas(q, k, block_q, block_k) -> bool:
+    if not _HAVE_PALLAS or jax.default_backend() != "tpu":
+        return False
+    lq, lk, d = q.shape[1], k.shape[1], q.shape[-1]
+    # Tiling constraints: last dim 128-aligned, seq divisible into blocks.
+    return (d % 128 == 0 and lq % min(block_q, lq) == 0
+            and lk % min(block_k, lk) == 0 and min(lq, lk) >= 8)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
+                    block_q: int = 256, block_k: int = 512):
+    """Fused attention: Pallas kernel on TPU, blockwise jnp elsewhere.
+
+    Differentiable via flash-style rematerialization: the backward pass
+    re-runs the blockwise forward under ``jax.vjp`` (O(L) residual
+    memory, trading FLOPs for HBM — the right trade on TPU).
+    """
+    s = _scale_for(q, scale)
+    if _use_pallas(q, k, block_q, block_k):
+        return _flash_pallas(q, k, v, causal, s, block_q, block_k)
+    return blockwise_attention(q, k, v, causal=causal, scale=s,
+                               block_k=block_k)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    return flash_attention(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(
+            q, k, v, causal=causal, scale=_scale_for(q, scale),
+            block_k=block_k),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

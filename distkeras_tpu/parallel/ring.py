@@ -1,0 +1,99 @@
+"""Ring attention: sequence/context parallelism over the mesh ``seq`` axis.
+
+The reference caps sequence length at single-device memory (its longest
+sequence model is the IMDB LSTM at maxlen=128; reference: examples).
+Here long context is first-class: the sequence dimension is sharded over
+the mesh ``seq`` axis and attention runs as a ring — each device holds
+its Q shard permanently plus a rotating KV shard, updates flash-style
+online-softmax state (distkeras_tpu.ops.attention.attention_chunk), and
+``ppermute``s the KV block to its ring neighbour.  After ``seq`` hops
+every Q row has attended to the full global sequence while per-device
+memory stays O(L/seq).  The KV transfer rides the ICI ring concurrently
+with the chunk matmuls (XLA overlaps the ppermute DMA with compute).
+
+This is the Ring Attention construction (Liu et al., 2023 — see
+PAPERS.md); the blockwise core it rotates is shared with the Pallas
+flash kernel so single-device and ring numerics match by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from distkeras_tpu.ops.attention import (
+    attention_chunk,
+    online_finish,
+    online_init,
+    _scale_for,
+)
+
+
+def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
+                   scale: float | None = None):
+    """Per-shard ring attention body; call inside ``shard_map``.
+
+    ``q/k/v: [B, L_local, H, D]`` — the local shard of a sequence of
+    global length ``L_local * axis_size``.  Returns the local shard of
+    the attention output.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    s = _scale_for(q, scale)
+    qf = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def update(m, l, o, kc, vc, hop):
+        # After `hop` rotations we hold the KV shard originally on
+        # (my_idx - hop) mod axis_size; offsets make causal masking
+        # global-position-correct.
+        src = (my_idx - hop) % axis_size
+        return attention_chunk(
+            qf, kc.astype(jnp.float32), vc.astype(jnp.float32), m, l, o,
+            causal, s, q_offset=my_idx * lq, kv_offset=src * lk)
+
+    def body(carry, hop):
+        m, l, o, kc, vc = carry
+        m, l, o = update(m, l, o, kc, vc, hop)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (m, l, o, kc, vc), None
+
+    # The last hop consumes its KV shard without rotating it onward —
+    # scanning all `axis_size` hops would send one extra KV shard per
+    # device over the ICI for nothing.
+    init = (*online_init(b, h, lq, d), k, v)
+    (m, l, o, kc, vc), _ = jax.lax.scan(
+        body, init, jnp.arange(axis_size - 1))
+    m, l, o = update(m, l, o, kc, vc, axis_size - 1)
+    return online_finish(m, l, o).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "seq",
+                        batch_axis: str | None = "data",
+                        causal: bool = False, scale: float | None = None):
+    """Wrap :func:`ring_attention` in shard_map over ``mesh``.
+
+    Returns ``f(q, k, v) -> out`` taking/returning global arrays of
+    shape [B, L, H, D]; batch is sharded over ``batch_axis``, sequence
+    over ``axis_name``, heads/dim replicated.  Composes under an outer
+    jit/pjit — tensor parallelism on the H axis can be layered by
+    sharding the projection weights, not this function.
+    """
+    spec = P(batch_axis, axis_name, None, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)
+
+
+def sequence_sharding(mesh: Mesh, batch_axis: str | None = "data",
+                      axis_name: str = "seq") -> NamedSharding:
+    """NamedSharding for [B, L, ...] activations under ring attention."""
+    return NamedSharding(mesh, P(batch_axis, axis_name))

@@ -1,0 +1,119 @@
+"""Attention tier equivalence: naive == blockwise == pallas(interpret) == ring.
+
+The contract: every implementation computes identical math, so the
+Pallas kernel and the ring-parallel version are validated against the
+materialized-logits oracle (SURVEY.md §4 test strategy: numerics vs a
+hand-rolled reference).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops.attention import (
+    blockwise_attention,
+    flash_attention,
+    naive_attention,
+    _flash_pallas,
+)
+from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+from distkeras_tpu.parallel.ring import make_ring_attention
+
+
+def qkv(rng, b=2, l=32, h=2, d=8, lk=None):
+    shape_q = (b, l, h, d)
+    shape_k = (b, lk or l, h, d)
+    return (rng.normal(size=shape_q).astype(np.float32),
+            rng.normal(size=shape_k).astype(np.float32),
+            rng.normal(size=shape_k).astype(np.float32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block_k", [8, 16, 32])
+def test_blockwise_matches_naive(rng, causal, block_k):
+    q, k, v = qkv(rng)
+    ref = naive_attention(q, k, v, causal=causal)
+    out = blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_blockwise_cross_attention(rng):
+    q, k, v = qkv(rng, l=16, lk=48)
+    ref = naive_attention(q, k, v)
+    out = blockwise_attention(q, k, v, block_k=16)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_flash_fallback_any_length(rng):
+    """Non-divisible KV lengths must clamp block_k, not raise."""
+    q, k, v = qkv(rng, l=24, lk=40)  # gcd(512, 40) -> block_k 40... etc.
+    ref = naive_attention(q, k, v)
+    out = flash_attention(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+    out = blockwise_attention(q, k, v, block_k=512)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_grads_match_naive(rng, causal):
+    q, k, v = qkv(rng, b=1, l=16, h=1, d=4)
+
+    def loss_ref(q, k, v):
+        return naive_attention(q, k, v, causal=causal).sum()
+
+    def loss_blk(q, k, v):
+        return blockwise_attention(q, k, v, causal=causal, block_k=8).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_blk = jax.grad(loss_blk, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_blk):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fallback_and_vjp(rng, causal):
+    """On CPU flash_attention routes to blockwise; VJP must still work."""
+    q, k, v = qkv(rng, l=16)
+    ref = naive_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    g = jax.grad(lambda q: flash_attention(q, k, v, causal).sum())(q)
+    g_ref = jax.grad(lambda q: naive_attention(q, k, v, causal=causal).sum())(q)
+    np.testing.assert_allclose(g, g_ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_kernel_interpret(rng, causal):
+    """The TPU kernel's logic, run via the Pallas interpreter on CPU."""
+    q, k, v = qkv(rng, b=1, l=16, h=1, d=128)
+    ref = naive_attention(q, k, v, causal=causal)
+    out = _flash_pallas(q, k, v, causal, 1.0 / np.sqrt(128), block_q=8,
+                        block_k=8, interpret=True)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4)])
+def test_ring_attention_matches_full(devices, rng, causal, mesh_shape):
+    data, seq = mesh_shape
+    mesh = make_mesh(MeshSpec(data=data, seq=seq), devices=devices)
+    q, k, v = qkv(rng, b=2, l=32, h=2, d=8)
+    ref = naive_attention(q, k, v, causal=causal)
+    ring = jax.jit(make_ring_attention(mesh, causal=causal))
+    out = ring(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_grads(devices, rng):
+    mesh = make_mesh(MeshSpec(data=1, seq=4), devices=devices[:4])
+    q, k, v = qkv(rng, b=1, l=16, h=1, d=4)
+    ring = make_ring_attention(mesh, causal=True)
+    g = jax.jit(jax.grad(lambda q, k, v: ring(q, k, v).sum(),
+                         argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: naive_attention(q, k, v, causal=True).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
