@@ -1,0 +1,309 @@
+"""Functional transformer LM: the framework's flagship composite model.
+
+The reference's deepest model is a one-layer LSTM (reference: examples,
+IMDB config); this module is where the TPU rebuild goes past it — a
+decoder-only transformer written as pure functions over a dict pytree,
+designed so every parallelism axis of the device mesh applies:
+
+- **data**: batch sharded via the batch PartitionSpec,
+- **model** (TP): Megatron layout — QKV/FFN-in column-sharded,
+  attn-out/FFN-out row-sharded (XLA inserts the psum/reduce-scatter),
+- **seq** (SP): ring attention (distkeras_tpu.parallel.ring) when
+  ``attention_fn`` is a ring wrapper; activations sharded [data, seq],
+- **expert** (EP): Switch-style top-1 MoE FFN with capacity dropping;
+  expert weights sharded over ``expert`` (XLA inserts the all-to-alls
+  around the dispatch/combine einsums),
+- **pipeline** (PP): the per-layer params are stacked [L, ...] so a
+  contiguous slice of layers forms a stage
+  (distkeras_tpu.parallel.pipeline consumes ``block_apply``).
+
+No flax: parameters are plain nested dicts so sharding rules regex over
+key-paths (parallel.sharding.ShardingPlan.tree_shardings) and the
+driver's dry-run can jit the full train step with explicit
+NamedShardings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from distkeras_tpu.ops.attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_len: int = 128
+    # MoE: 0 experts = dense FFN.  With E > 0 every layer's FFN is a
+    # Switch top-1 MoE with `capacity_factor` slack per expert.
+    num_experts: int = 0
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    dtype: str = "float32"  # activation/compute dtype (bfloat16 on TPU)
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def _dense_init(rng, shape, fan_in):
+    return jax.random.normal(rng, shape, jnp.float32) / math.sqrt(fan_in)
+
+
+def init_params(rng, cfg: TransformerConfig):
+    """Build the parameter pytree.  Per-layer params are stacked on a
+    leading [n_layers] axis (scan/pipeline-friendly: one tree, L-major).
+    """
+    keys = jax.random.split(rng, 12)
+    d, f, h, hd = cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.head_dim
+    L = cfg.n_layers
+
+    def stack(key, shape, fan_in):
+        return _dense_init(key, (L, *shape), fan_in)
+
+    layers = {
+        "ln1_scale": jnp.ones((L, d)),
+        "ln2_scale": jnp.ones((L, d)),
+        "attn": {
+            "wq": stack(keys[0], (d, h, hd), d),
+            "wk": stack(keys[1], (d, h, hd), d),
+            "wv": stack(keys[2], (d, h, hd), d),
+            "wo": stack(keys[3], (h, hd, d), d),
+        },
+    }
+    if cfg.num_experts:
+        layers["moe"] = {
+            "wg": stack(keys[4], (d, cfg.num_experts), d),
+            "w1": stack(keys[5], (cfg.num_experts, d, f), d),
+            "w2": stack(keys[6], (cfg.num_experts, f, d), f),
+        }
+    else:
+        layers["ffn"] = {
+            "w1": stack(keys[7], (d, f), d),
+            "w2": stack(keys[8], (f, d), f),
+        }
+    return {
+        # Tied embedding/unembedding: std 1/sqrt(d) keeps initial logits
+        # O(1) so the initial LM loss sits at ~ln(vocab).
+        "tok_emb": _dense_init(keys[9], (cfg.vocab_size, d), d),
+        "pos_emb": _dense_init(keys[10], (cfg.max_len, d), 1.0) * 0.02,
+        "ln_f_scale": jnp.ones((d,)),
+        "layers": layers,
+    }
+
+
+def tp_rules():
+    """Megatron-layout PartitionSpecs over the ``model`` axis.
+
+    Keyed on tree_shardings key-paths (leading [L] stack axis first for
+    per-layer params).  Column-parallel in, row-parallel out: the only
+    collective per block is one psum pair, inserted by XLA.
+    """
+    return [
+        (r"attn/w[qkv]$", P(None, None, "model", None)),
+        (r"attn/wo$", P(None, "model", None, None)),
+        (r"ffn/w1$", P(None, None, "model")),
+        (r"ffn/w2$", P(None, "model", None)),
+        # MoE: experts over 'expert', their matmuls over 'model'.
+        (r"moe/wg$", P()),
+        (r"moe/w1$", P(None, "expert", None, "model")),
+        (r"moe/w2$", P(None, "expert", "model", None)),
+        (r"tok_emb$", P(None, "model")),
+        (r"pos_emb$", P(None, "model")),
+    ]
+
+
+def _rms_norm(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def _attention_block(lp, x, attention_fn):
+    q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
+    out = attention_fn(q, k, v)
+    return jnp.einsum("bshk,hkd->bsd", out, lp["wo"])
+
+
+def _moe_block(lp, x, cfg: TransformerConfig):
+    """Switch top-1 MoE with capacity dropping.
+
+    Tokens flatten to [N, D]; the dispatch/combine einsums carry the
+    expert axis, which the EP sharding rules place on the mesh
+    ``expert`` axis — XLA emits the all-to-alls.  Dropped tokens pass
+    through with 0 (the residual connection keeps their stream).
+    Returns (out, aux_loss).
+    """
+    b, s, d = x.shape
+    n = b * s
+    e = cfg.num_experts
+    cap = max(1, int(cfg.capacity_factor * n / e))
+    flat = x.reshape(n, d)
+
+    router = jnp.einsum("nd,de->ne", flat.astype(jnp.float32), lp["wg"])
+    probs = jax.nn.softmax(router, axis=-1)
+    gate = probs.max(axis=-1)
+    expert = probs.argmax(axis=-1)
+    one_hot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+
+    # Load-balancing aux loss (Switch Transformer eq. 4).
+    density = one_hot.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux = jnp.sum(density * density_proxy) * e * cfg.aux_loss_coef
+
+    pos = jnp.cumsum(one_hot, axis=0) * one_hot  # 1-based slot, [N, E]
+    keep = (pos <= cap).astype(jnp.float32) * one_hot
+    slot_oh = jax.nn.one_hot((pos - 1.0).astype(jnp.int32), cap,
+                             dtype=jnp.float32) * keep[..., None]  # [N,E,C]
+
+    xe = jnp.einsum("nec,nd->ecd", slot_oh, flat.astype(jnp.float32))
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, lp["w1"]))
+    ye = jnp.einsum("ecf,efd->ecd", h, lp["w2"])
+    out = jnp.einsum("ecd,nec->nd", ye, slot_oh) * (gate * keep.sum(-1))[:, None]
+    return out.astype(x.dtype).reshape(b, s, d), aux
+
+
+def block_apply(layer_params, x, cfg: TransformerConfig,
+                attention_fn: Callable):
+    """One transformer block (pre-norm).  Returns (x, aux_loss)."""
+    h = _rms_norm(x, layer_params["ln1_scale"])
+    x = x + _attention_block(layer_params["attn"], h, attention_fn)
+    h = _rms_norm(x, layer_params["ln2_scale"])
+    if cfg.num_experts:
+        y, aux = _moe_block(layer_params["moe"], h, cfg)
+    else:
+        y = jnp.einsum(
+            "bsf,fd->bsd",
+            jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, layer_params["ffn"]["w1"])),
+            layer_params["ffn"]["w2"])
+        aux = jnp.zeros((), jnp.float32)
+    return x + y, aux
+
+
+def apply(params, tokens, cfg: TransformerConfig,
+          attention_fn: Callable | None = None):
+    """Forward pass: tokens [B, S] int32 -> logits [B, S, V].
+
+    ``attention_fn(q, k, v) -> out`` defaults to causal flash attention
+    (Pallas on TPU); pass a ``make_ring_attention(...)`` wrapper for
+    sequence parallelism.  Returns (logits, aux_loss).
+    """
+    if attention_fn is None:
+        attention_fn = lambda q, k, v: flash_attention(q, k, v, True)
+    dtype = jnp.dtype(cfg.dtype)
+    b, s = tokens.shape
+    x = params["tok_emb"][tokens].astype(dtype)
+    x = x + params["pos_emb"][:s][None].astype(dtype)
+
+    aux_total = jnp.zeros((), jnp.float32)
+
+    def one_layer(carry, lp):
+        x, aux_total = carry
+        x, aux = block_apply(lp, x, cfg, attention_fn)
+        return (x, aux_total + aux), None
+
+    # Python loop (not scan): attention_fn may close over shard_map /
+    # pallas calls whose tracing under scan complicates sharding; layer
+    # counts at this framework's scale compile fine unrolled.
+    for i in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+        (x, aux_total), _ = one_layer((x, aux_total), lp)
+
+    x = _rms_norm(x, params["ln_f_scale"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["tok_emb"].astype(dtype))
+    return logits.astype(jnp.float32), aux_total
+
+
+def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
+                    microbatches: int, attention_fn: Callable | None = None,
+                    axis_name: str = "pipeline"):
+    """Forward pass with the layer trunk pipelined over ``axis_name``.
+
+    Embedding and the head run outside the pipeline (they change shape);
+    the residual trunk — whose stacked [L, ...] params slice naturally
+    into ``n_stages`` contiguous stages — runs under
+    parallel.pipeline.make_pipeline.  MoE aux loss is not accumulated
+    under PP (stage outputs are activation-only); use the dense FFN or
+    accept the un-regularized router when pipelining.
+
+    Returns (logits, aux=0).
+    """
+    from distkeras_tpu.parallel.pipeline import make_pipeline
+
+    if attention_fn is None:
+        attention_fn = lambda q, k, v: flash_attention(q, k, v, True)
+    n_stages = int(mesh.shape[axis_name])
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible into {n_stages} stages")
+    per_stage = cfg.n_layers // n_stages
+
+    dtype = jnp.dtype(cfg.dtype)
+    b, s = tokens.shape
+    x = params["tok_emb"][tokens].astype(dtype)
+    x = x + params["pos_emb"][:s][None].astype(dtype)
+
+    stage_params = jax.tree.map(
+        lambda a: a.reshape(n_stages, per_stage, *a.shape[1:]),
+        params["layers"])
+
+    def stage_fn(lp, u):
+        for i in range(per_stage):
+            li = jax.tree.map(lambda a: a[i], lp)
+            u, _ = block_apply(li, u, cfg, attention_fn)
+        return u
+
+    pipe = make_pipeline(stage_fn, mesh, microbatches, axis_name)
+    x = pipe(stage_params, x)
+    x = _rms_norm(x, params["ln_f_scale"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["tok_emb"].astype(dtype))
+    return logits.astype(jnp.float32), jnp.zeros((), jnp.float32)
+
+
+def lm_loss(params, tokens, cfg: TransformerConfig,
+            attention_fn: Callable | None = None,
+            apply_fn: Callable | None = None):
+    """Next-token cross-entropy (+ MoE aux), mean over B*(S-1) targets.
+
+    ``apply_fn(params, inputs) -> (logits, aux)`` defaults to
+    :func:`apply`; pass a closure over :func:`apply_pipelined` to train
+    the pipelined trunk with the same loss.
+    """
+    if apply_fn is None:
+        apply_fn = lambda p, t: apply(p, t, cfg, attention_fn)
+    logits, aux = apply_fn(params, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+    return nll + aux
+
+
+def make_train_step(cfg: TransformerConfig, optimizer,
+                    attention_fn: Callable | None = None,
+                    apply_fn: Callable | None = None):
+    """``step((params, opt_state), tokens) -> ((params', opt_state'), loss)``.
+
+    Pure; callers jit it with NamedShardings (see __graft_entry__ and
+    the trainers).
+    """
+    def step(carry, tokens):
+        params, opt_state = carry
+        loss, grads = jax.value_and_grad(lm_loss)(
+            params, tokens, cfg, attention_fn, apply_fn)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return (params, opt_state), loss
+
+    return step

@@ -1,0 +1,83 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule on the mesh.
+
+Absent from the reference (SURVEY.md §2 census: no PP), present here
+because stage-partitioned models are part of the first-class parallelism
+surface.  The construction is the idiomatic TPU one: no runtime
+scheduler process (the reference would have used its socket fabric) —
+the schedule is *compiled into the program* as a `lax.scan` over clock
+ticks inside a `shard_map` that is manual over only the ``pipeline``
+axis.  Each tick every stage applies itself to its current microbatch
+and `ppermute`s the activation to its right neighbour over ICI; after
+``microbatches + n_stages - 1`` ticks the last stage has produced every
+microbatch (the classic GPipe bubble).  Because only ``pipeline`` is
+manual, data/tensor/expert sharding inside the stage function stays
+XLA-automatic, so PP composes with DP/TP/EP.
+
+Differentiable end-to-end: scan + ppermute transpose cleanly, so
+`jax.grad` through a pipelined forward runs the reverse schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_pipeline(stage_fn: Callable, mesh: Mesh, microbatches: int,
+                  axis_name: str = "pipeline"):
+    """Build ``f(stage_params, x) -> y`` running ``stage_fn`` as a pipeline.
+
+    ``stage_params``: pytree whose leaves have a leading [n_stages] axis
+    (stage i consumes slice i).  ``x``: [B, ...] global batch, split
+    into ``microbatches`` equal microbatches.  ``stage_fn(params, u)``
+    must be shape-preserving on ``u`` ([mb, ...] -> [mb, ...]); stages
+    that change activation shape belong outside the pipeline (embed /
+    head), matching how GPipe slices a residual trunk.
+    """
+    n_stages = int(mesh.shape[axis_name])
+
+    def run(stage_params, x):
+        local = jax.tree.map(lambda a: a[0], stage_params)
+        idx = jax.lax.axis_index(axis_name)
+        b = x.shape[0]
+        if b % microbatches:
+            raise ValueError(f"batch {b} not divisible into {microbatches} "
+                             "microbatches")
+        mb = b // microbatches
+        x_mb = x.reshape(microbatches, mb, *x.shape[1:])
+        ticks = microbatches + n_stages - 1
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(carry, t):
+            recv, outputs = carry
+            t_in = jnp.clip(t, 0, microbatches - 1)
+            inp = jnp.where(idx == 0, x_mb[t_in], recv)
+            out = stage_fn(local, inp)
+            recv_next = jax.lax.ppermute(out, axis_name, perm)
+            # Stage n-1 finishes microbatch t-(n-1) at tick t.
+            mb_i = t - (n_stages - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                outputs, out, jnp.maximum(mb_i, 0), 0)
+            outputs = jnp.where((idx == n_stages - 1) & (mb_i >= 0),
+                                upd, outputs)
+            return (recv_next, outputs), None
+
+        zero_act = jnp.zeros((mb, *x.shape[1:]), x.dtype)
+        zero_out = jnp.zeros((microbatches, mb, *x.shape[1:]), x.dtype)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (zero_act, zero_out), jnp.arange(ticks))
+        # Leading stage axis: only the last stage's slice is the result.
+        return outputs.reshape(b, *x.shape[1:])[None]
+
+    f = shard_map(run, mesh=mesh, axis_names={axis_name},
+                  in_specs=(P(axis_name), P()), out_specs=P(axis_name),
+                  check_vma=False)
+
+    def apply(stage_params, x):
+        return f(stage_params, x)[-1]
+
+    return apply

@@ -88,6 +88,19 @@ class ShardingPlan:
     def batch_sharding(self, mesh: Mesh) -> NamedSharding:
         return NamedSharding(mesh, self.batch_spec)
 
+    def tree_shardings(self, mesh: Mesh, pytree):
+        """NamedShardings for any pytree, rules keyed on jax key-paths.
+
+        Paths are rendered like ``"layers/0/attn/wq"`` (keystr with the
+        leading separator stripped), so the same regex rule language
+        covers Keras variable paths and functional-model dicts.
+        """
+        def leaf(path, _):
+            name = jax.tree_util.keystr(path, simple=True, separator="/")
+            return NamedSharding(mesh, self.spec_for(name))
+
+        return jax.tree_util.tree_map_with_path(leaf, pytree)
+
 
 def dp_plan() -> ShardingPlan:
     """Pure data parallelism: replicate weights, split batch on ``data``."""

@@ -18,7 +18,8 @@ from distkeras_tpu.ops.attention import (
     _flash_pallas,
 )
 from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
-from distkeras_tpu.parallel.ring import make_ring_attention
+from distkeras_tpu.parallel.ring import make_ring_attention, \
+    sequence_sharding
 
 
 def qkv(rng, b=2, l=32, h=2, d=8, lk=None):
@@ -101,6 +102,10 @@ def test_ring_attention_matches_full(devices, rng, causal, mesh_shape):
     mesh = make_mesh(MeshSpec(data=data, seq=seq), devices=devices)
     q, k, v = qkv(rng, b=2, l=32, h=2, d=8)
     ref = naive_attention(q, k, v, causal=causal)
+    # Pre-placing with sequence_sharding must match the ring's in_specs
+    # (pins the helper's [B, L, ...] contract).
+    sh = sequence_sharding(mesh)
+    q, k, v = (jax.device_put(a, sh) for a in (q, k, v))
     ring = jax.jit(make_ring_attention(mesh, causal=causal))
     out = ring(q, k, v)
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
