@@ -19,7 +19,8 @@ Public surface (mirrors the reference's — see SURVEY.md §2):
 * Predictors (reference: distkeras/predictors.py): :class:`ModelPredictor`.
 * Transformers (reference: distkeras/transformers.py):
   :class:`OneHotTransformer`, :class:`LabelIndexTransformer`,
-  :class:`MinMaxTransformer`, :class:`ReshapeTransformer`,
+  :class:`MinMaxTransformer`, :class:`StandardScaleTransformer`,
+  :class:`ReshapeTransformer`,
   :class:`DenseTransformer`.
 * Evaluators (reference: distkeras/evaluators.py): :class:`AccuracyEvaluator`.
 * Serialization (reference: distkeras/utils.py):
@@ -65,6 +66,7 @@ from distkeras_tpu.data.transformers import (
     OneHotTransformer,
     LabelIndexTransformer,
     MinMaxTransformer,
+    StandardScaleTransformer,
     ReshapeTransformer,
     DenseTransformer,
 )
@@ -97,6 +99,7 @@ __all__ = [
     "OneHotTransformer",
     "LabelIndexTransformer",
     "MinMaxTransformer",
+    "StandardScaleTransformer",
     "ReshapeTransformer",
     "DenseTransformer",
     "CheckpointManager",

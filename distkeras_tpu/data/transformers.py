@@ -88,6 +88,35 @@ class MinMaxTransformer(Transformer):
                                    (x - o_min) * scale + self.n_min)
 
 
+class StandardScaleTransformer(Transformer):
+    """Per-feature standardization: (x - mean) / std.
+
+    The reference's canonical workflow standardizes features with Spark
+    ML's StandardScaler before any dist-keras trainer sees them
+    (SURVEY.md §3.5 pipeline); this is that stage, Dataset-native.
+    Fit-once semantics: statistics are computed from the *first* dataset
+    transformed (or passed explicitly) and reused for every later call,
+    so train and test get the same scaling.
+    """
+
+    def __init__(self, input_col: str = "features",
+                 output_col: str | None = None,
+                 mean: np.ndarray | None = None,
+                 std: np.ndarray | None = None):
+        self.input_col = input_col
+        self.output_col = output_col or input_col
+        self.mean, self.std = mean, std
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        x = dataset[self.input_col].astype(np.float32)
+        if self.mean is None:
+            self.mean = x.mean(axis=0)
+        if self.std is None:
+            self.std = x.std(axis=0)
+        return dataset.with_column(
+            self.output_col, (x - self.mean) / np.maximum(self.std, 1e-12))
+
+
 class ReshapeTransformer(Transformer):
     """Reshape each row of a column (flat vector -> image tensor).
 

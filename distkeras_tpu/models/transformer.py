@@ -124,6 +124,14 @@ def tp_rules():
     ]
 
 
+def _check_len(s: int, cfg: TransformerConfig) -> None:
+    if s > cfg.max_len:
+        raise ValueError(
+            f"sequence length {s} exceeds max_len={cfg.max_len} (note "
+            "lm_loss feeds tokens[:, :-1], so token arrays may carry "
+            "max_len + 1 positions)")
+
+
 def _rms_norm(x, scale, eps=1e-6):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
@@ -204,6 +212,7 @@ def apply(params, tokens, cfg: TransformerConfig,
         attention_fn = lambda q, k, v: flash_attention(q, k, v, True)
     dtype = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
+    _check_len(s, cfg)
     x = params["tok_emb"][tokens].astype(dtype)
     x = x + params["pos_emb"][:s][None].astype(dtype)
 
@@ -252,6 +261,7 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
 
     dtype = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
+    _check_len(s, cfg)
     x = params["tok_emb"][tokens].astype(dtype)
     x = x + params["pos_emb"][:s][None].astype(dtype)
 

@@ -41,6 +41,12 @@ def make_pipeline(stage_fn: Callable, mesh: Mesh, microbatches: int,
     n_stages = int(mesh.shape[axis_name])
 
     def run(stage_params, x):
+        for leaf in jax.tree.leaves(stage_params):
+            if leaf.shape[0] != 1:
+                raise ValueError(
+                    f"stage_params leading axis must equal n_stages="
+                    f"{n_stages} (got a shard of {leaf.shape[0]} — stack "
+                    "exactly one param slice per pipeline stage)")
         local = jax.tree.map(lambda a: a[0], stage_params)
         idx = jax.lax.axis_index(axis_name)
         b = x.shape[0]

@@ -1,0 +1,69 @@
+"""The full pipeline workflow: every trainer on the Higgs-shaped task.
+
+Mirrors the reference's flagship notebook (reference:
+examples/workflow.ipynb — ATLAS Higgs tabular MLP through
+normalization, one-hot, every distributed trainer with timing table,
+then predictor + label-index + accuracy evaluation).  The Spark
+DataFrame stages map to Dataset/transformer ops; the trainer table maps
+1:1 (SURVEY.md §7.4 for the async->sync semantics).
+
+``DKT_EXAMPLE_DEVICES=8 python examples/workflow.py`` runs the
+distributed trainers over an 8-device CPU mesh (the reference's
+`local[8]`).
+"""
+
+from _common import setup_devices, synthetic_higgs
+
+
+def main(steps_scale: int = 1):
+    devices = setup_devices()
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.zoo import higgs_mlp
+
+    x, y = synthetic_higgs()
+    split = len(x) * 3 // 4
+
+    # -- pipeline ops (reference workflow: StandardScaler before the
+    # trainers — SURVEY.md §3.5) ---------------------------------------
+    ds = dk.StandardScaleTransformer(input_col="features").transform(
+        dk.Dataset.from_arrays(x, y))
+    xs, ys = ds["features"], ds["label"]
+    train = dk.Dataset.from_arrays(xs[:split], ys[:split])
+    test = dk.Dataset.from_arrays(xs[split:], ys[split:])
+
+    n = len(devices)
+    mk = lambda: higgs_mlp(seed=0)
+    common = dict(loss="sparse_categorical_crossentropy",
+                  worker_optimizer="adam", learning_rate=1e-3,
+                  num_epoch=4 * steps_scale)
+    trainers = [
+        ("SingleTrainer", dk.SingleTrainer(mk(), batch_size=128, **common)),
+        ("ADAG", dk.ADAG(mk(), batch_size=64, communication_window=4,
+                         num_workers=n, **common)),
+        ("DOWNPOUR", dk.DOWNPOUR(mk(), batch_size=64, communication_window=4,
+                                 num_workers=n, **common)),
+        ("AEASGD", dk.AEASGD(mk(), batch_size=64, communication_window=8,
+                             rho=5.0, num_workers=n, **common)),
+        ("EAMSGD", dk.EAMSGD(mk(), batch_size=64, communication_window=8,
+                             rho=5.0, momentum=0.9, num_workers=n, **common)),
+        ("DynSGD", dk.DynSGD(mk(), batch_size=64, communication_window=4,
+                             num_workers=n, **common)),
+        ("AveragingTrainer", dk.AveragingTrainer(mk(), batch_size=64,
+                                                 num_workers=n, **common)),
+    ]
+
+    print(f"{'trainer':18s} {'time (s)':>9s} {'accuracy':>9s}   ({n} workers)")
+    results = {}
+    for name, trainer in trainers:
+        model = trainer.train(train)
+        scored = dk.ModelPredictor(model, output_col="prediction").predict(test)
+        scored = dk.LabelIndexTransformer(input_col="prediction").transform(scored)
+        acc = dk.AccuracyEvaluator(
+            prediction_col="prediction_index").evaluate(scored)
+        results[name] = (trainer.training_time, acc)
+        print(f"{name:18s} {trainer.training_time:9.2f} {acc:9.4f}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Job deployment spec (reference parity: distkeras/job_deployment.py)."""
+
+import shlex
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.transformers import StandardScaleTransformer
+from distkeras_tpu.deploy import Job
+
+
+def test_command_lines_per_host():
+    job = Job(script="train.py", num_hosts=4,
+              coordinator="10.0.0.1:8476", env={"FOO": "bar", "SEED": 42},
+              args=("--epochs", 3))
+    cmds = job.command_lines()
+    assert len(cmds) == 4
+    for h, cmd in enumerate(cmds):
+        assert f"DKT_HOST_ID={h}" in cmd
+        assert "DKT_NUM_HOSTS=4" in cmd
+        assert "DKT_COORDINATOR=10.0.0.1:8476" in cmd
+        assert "FOO=bar" in cmd
+        assert "SEED=42" in cmd  # non-str env values are coerced
+        # Remote commands name a portable interpreter, not this
+        # machine's sys.executable.
+        assert "python3 train.py --epochs 3" in cmd
+        assert sys.executable not in cmd or sys.executable == "python3"
+        # Must be valid shell.
+        shlex.split(cmd)
+
+
+def test_env_for_range_checked():
+    job = Job(script="t.py", num_hosts=2)
+    with pytest.raises(ValueError):
+        job.env_for(2)
+
+
+def test_run_local_executes(tmp_path):
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os, sys\n"
+        "assert os.environ['DKT_NUM_HOSTS'] == '1'\n"
+        "assert os.environ['DKT_HOST_ID'] == '0'\n"
+        "sys.exit(0)\n")
+    Job(script=str(script)).run_local()
+
+
+def test_run_local_rejects_multihost():
+    with pytest.raises(ValueError):
+        Job(script="t.py", num_hosts=2).run_local()
+
+
+def test_init_from_env_noop_single_host(monkeypatch):
+    from distkeras_tpu import deploy
+
+    monkeypatch.delenv("DKT_NUM_HOSTS", raising=False)
+    deploy.init_from_env()  # must not raise / touch jax.distributed
+
+
+def test_standard_scale_transformer():
+    rng = np.random.default_rng(0)
+    x = rng.normal(3.0, 5.0, (256, 4)).astype(np.float32) * [1, 10, 100, 1000]
+    t = StandardScaleTransformer(input_col="features")
+    out = t.transform(Dataset({"features": x}))["features"]
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(out.std(axis=0), 1.0, atol=1e-4)
+    # Fit-once: a second dataset reuses the first dataset's statistics.
+    x2 = x + 100.0
+    out2 = t.transform(Dataset({"features": x2}))["features"]
+    np.testing.assert_allclose(out2, out + 100.0 / np.maximum(x.std(0), 1e-12),
+                               atol=1e-3)

@@ -31,6 +31,16 @@ def test_pipeline_matches_sequential(devices, rng):
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
 
+def test_pipeline_rejects_misstacked_params(devices, rng):
+    """Leading axis != n_stages must fail loudly, not drop layers."""
+    mesh = make_mesh(MeshSpec(data=1, pipeline=4), devices=devices[:4])
+    w = jnp.asarray(rng.normal(size=(8, 8, 8)).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    pipe = make_pipeline(lambda p, u: u @ p, mesh, microbatches=4)
+    with pytest.raises(ValueError, match="n_stages"):
+        jax.jit(pipe)(w, x)
+
+
 def test_pipeline_gradients(devices, rng):
     """grad through the pipeline == grad through sequential composition."""
     mesh = make_mesh(MeshSpec(data=1, pipeline=2), devices=devices[:2])

@@ -82,6 +82,12 @@ def test_sequence_parallel_ring_matches_single(devices, rng):
     np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
 
 
+def test_seq_len_over_max_len_raises(rng):
+    params = tfm.init_params(jax.random.key(0), CFG)
+    with pytest.raises(ValueError, match="max_len"):
+        tfm.apply(params, jnp.zeros((2, CFG.max_len + 4), jnp.int32), CFG)
+
+
 MOE_CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
                                 n_layers=1, d_ff=64, max_len=32,
                                 num_experts=4, capacity_factor=4.0)
