@@ -116,10 +116,17 @@ class Dataset:
 
     def shuffle(self, seed: int | None = None) -> "Dataset":
         """Global random permutation (reference: distkeras/utils.py::shuffle,
-        which sorted a Spark DataFrame by a random key)."""
+        which sorted a Spark DataFrame by a random key).
+
+        The row gather runs through the native threaded loader when
+        built (distkeras_tpu.native), numpy fancy indexing otherwise.
+        """
+        from distkeras_tpu.native import gather_rows
+
         rng = np.random.default_rng(seed)
         perm = rng.permutation(len(self))
-        return Dataset({k: v[perm] for k, v in self._cols.items()})
+        return Dataset({k: gather_rows(v, perm)
+                        for k, v in self._cols.items()})
 
     def shard(self, index: int, num_shards: int) -> "Dataset":
         """Strided host shard — each host keeps rows i, i+num_shards, ...
@@ -140,7 +147,7 @@ class Dataset:
 
     def batches(self, batch_size: int, *, features_col: str = "features",
                 label_col: str | None = "label", drop_remainder: bool = True,
-                window: int | None = None
+                window: int | None = None, prefetch: int = 0
                 ) -> Iterator[tuple[np.ndarray, np.ndarray] | np.ndarray]:
         """Yield (x, y) minibatches; with ``window``, yield [w, B, ...] stacks.
 
@@ -148,24 +155,34 @@ class Dataset:
         yielded element carries ``window`` microbatches so a single
         jitted scan step consumes them (SURVEY.md §7.4).
         ``drop_remainder=True`` keeps shapes static for XLA.
+        ``prefetch=N`` stages batch preparation N elements ahead on a
+        background thread (data.prefetch.Prefetcher).
         """
         if window and not drop_remainder:
             raise ValueError(
                 "window requires drop_remainder=True: a partial tail "
                 "cannot be reshaped to [window, batch, ...]")
-        n = len(self)
-        x = self._cols[features_col]
-        y = self._cols[label_col] if label_col else None
-        step = batch_size * (window or 1)
-        end = n - (n % step) if drop_remainder else n
-        for i in range(0, end, step):
-            xb = x[i:i + step]
-            yb = y[i:i + step] if y is not None else None
-            if window:
-                xb = xb.reshape((window, batch_size) + xb.shape[1:])
-                if yb is not None:
-                    yb = yb.reshape((window, batch_size) + yb.shape[1:])
-            yield (xb, yb) if y is not None else xb
+
+        def gen():
+            n = len(self)
+            x = self._cols[features_col]
+            y = self._cols[label_col] if label_col else None
+            step = batch_size * (window or 1)
+            end = n - (n % step) if drop_remainder else n
+            for i in range(0, end, step):
+                xb = x[i:i + step]
+                yb = y[i:i + step] if y is not None else None
+                if window:
+                    xb = xb.reshape((window, batch_size) + xb.shape[1:])
+                    if yb is not None:
+                        yb = yb.reshape((window, batch_size) + yb.shape[1:])
+                yield (xb, yb) if y is not None else xb
+
+        if prefetch:
+            from distkeras_tpu.data.prefetch import Prefetcher
+
+            return Prefetcher(gen(), depth=prefetch)
+        return gen()
 
     def num_batches(self, batch_size: int, window: int | None = None) -> int:
         return len(self) // (batch_size * (window or 1))

@@ -1,0 +1,87 @@
+"""Background batch prefetching.
+
+The reference overlaps input with compute for free — Spark executors
+iterate their partition while the JVM fetches the next (reference:
+workers.py consuming mapPartitions iterators).  Here the equivalent is
+a small host-side pipeline: a daemon thread runs the batch iterator
+(shuffle-gather, windows, dtype conversion) ``depth`` elements ahead of
+the training loop, so batch preparation overlaps the device step that
+jax dispatches asynchronously.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator
+
+
+class Prefetcher:
+    """Iterate ``source`` on a background thread, ``depth`` items ahead.
+
+    Exceptions in the source re-raise in the consumer (once; the
+    iterator is exhausted afterwards, like a generator).  Abandoning the
+    iterator mid-stream is safe: ``close()`` — called by ``__del__`` and
+    usable explicitly — unblocks and stops the producer thread.
+    """
+
+    _DONE = object()
+
+    def __init__(self, source: Iterable, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._err: BaseException | None = None
+        self._finished = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(iter(source),), daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Enqueue unless closed; False means stop producing."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self, it: Iterator) -> None:
+        try:
+            for item in it:
+                if not self._put(item):
+                    return
+        except BaseException as e:  # propagate to consumer
+            self._err = e
+        finally:
+            self._put(self._DONE)
+
+    def close(self) -> None:
+        """Stop the producer and release buffered items."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._finished = True
+
+    def __del__(self):  # pragma: no cover - GC timing
+        self.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            self._finished = True
+            if self._err is not None:
+                err, self._err = self._err, None
+                raise err
+            raise StopIteration
+        return item

@@ -1,0 +1,159 @@
+"""ctypes bindings for the native input-pipeline kernels.
+
+Builds ``native/dataloader.cc`` into a shared library on first use
+(g++, cached next to this package) and exposes :func:`gather_rows` /
+:func:`gather_normalize_u8`.  Everything degrades to numpy when no
+compiler is available — the native path is an optimization of the data
+plane, never a requirement (the reference's data plane performance
+likewise came from its substrate, Spark; SURVEY.md §2 native census).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native", "dataloader.cc")
+_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "_libdkt_data.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_DEF_THREADS = min(8, os.cpu_count() or 1)
+
+
+def _build() -> str | None:
+    if not os.path.exists(_SRC):
+        return None
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return _SO
+
+
+def lib():
+    """The loaded library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = _SO if os.path.exists(_SO) else _build()
+        if not path:
+            return None
+        try:
+            handle = ctypes.CDLL(path)
+        except OSError:
+            return None
+        handle.dkt_gather_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+        handle.dkt_gather_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+        handle.dkt_gather_u8_normalize.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int]
+        _lib = handle
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def _as_c(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a)
+
+
+def _check_idx(idx: np.ndarray, n_rows: int) -> np.ndarray:
+    """Bounds-check (both paths, so numpy fallback matches native: no
+    negative-index wrapping) and coerce to contiguous int64."""
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    if idx.size and (idx.min() < 0 or idx.max() >= n_rows):
+        raise IndexError(f"gather index out of range for {n_rows} rows")
+    return idx
+
+
+def _check_out(out: np.ndarray, shape: tuple, dtype) -> np.ndarray:
+    if out.shape != shape or out.dtype != np.dtype(dtype):
+        raise ValueError(
+            f"out buffer mismatch: need {shape} {np.dtype(dtype)}, got "
+            f"{out.shape} {out.dtype}")
+    if not out.flags.c_contiguous:
+        raise ValueError("out buffer must be C-contiguous (reshape of a "
+                         "non-contiguous buffer would write into a copy)")
+    return out
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray,
+                out: np.ndarray | None = None,
+                n_threads: int = _DEF_THREADS) -> np.ndarray:
+    """``src[idx]`` for row-major arrays, multithreaded when native.
+
+    Equivalent to numpy fancy indexing on axis 0; the native path runs
+    the row memcpys across threads (fancy indexing is single-threaded).
+    """
+    handle = lib()
+    src = _as_c(src)
+    rows = src.reshape(len(src), -1)
+    idx = _check_idx(idx, len(src))
+    out_shape = (len(idx), *src.shape[1:])
+    if out is not None:
+        out = _check_out(out, out_shape, src.dtype)
+    if handle is None:
+        result = src[idx]
+        if out is not None:
+            out[...] = result
+            return out
+        return result
+    if out is None:
+        out = np.empty(out_shape, src.dtype)
+    flat_out = out.reshape(len(idx), -1)
+    if src.dtype == np.float32:
+        handle.dkt_gather_f32(
+            rows.ctypes.data, idx.ctypes.data, flat_out.ctypes.data,
+            len(idx), rows.shape[1], n_threads)
+    else:
+        handle.dkt_gather_bytes(
+            rows.view(np.uint8).ctypes.data, idx.ctypes.data,
+            flat_out.view(np.uint8).ctypes.data,
+            len(idx), rows.shape[1] * src.dtype.itemsize, n_threads)
+    return out
+
+
+def gather_normalize_u8(src: np.ndarray, idx: np.ndarray, scale: float,
+                        bias: float = 0.0, out: np.ndarray | None = None,
+                        n_threads: int = _DEF_THREADS) -> np.ndarray:
+    """``src[idx].astype(f32) * scale + bias`` fused (uint8 images)."""
+    if src.dtype != np.uint8:
+        raise TypeError(f"gather_normalize_u8 needs uint8, got {src.dtype}")
+    handle = lib()
+    src = _as_c(src)
+    idx = _check_idx(idx, len(src))
+    out_shape = (len(idx), *src.shape[1:])
+    if out is not None:
+        out = _check_out(out, out_shape, np.float32)
+    if handle is None:
+        result = src[idx].astype(np.float32) * scale + bias
+        if out is not None:
+            out[...] = result
+            return out
+        return result
+    if out is None:
+        out = np.empty(out_shape, np.float32)
+    handle.dkt_gather_u8_normalize(
+        src.reshape(len(src), -1).ctypes.data, idx.ctypes.data,
+        out.reshape(len(idx), -1).ctypes.data,
+        len(idx), int(np.prod(src.shape[1:])), scale, bias, n_threads)
+    return out

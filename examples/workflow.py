@@ -24,12 +24,10 @@ def main(steps_scale: int = 1):
     split = len(x) * 3 // 4
 
     # -- pipeline ops (reference workflow: StandardScaler before the
-    # trainers — SURVEY.md §3.5) ---------------------------------------
-    ds = dk.StandardScaleTransformer(input_col="features").transform(
-        dk.Dataset.from_arrays(x, y))
-    xs, ys = ds["features"], ds["label"]
-    train = dk.Dataset.from_arrays(xs[:split], ys[:split])
-    test = dk.Dataset.from_arrays(xs[split:], ys[split:])
+    # trainers — SURVEY.md §3.5).  Fit on train, reuse stats for test.
+    scaler = dk.StandardScaleTransformer(input_col="features")
+    train = scaler.transform(dk.Dataset.from_arrays(x[:split], y[:split]))
+    test = scaler.transform(dk.Dataset.from_arrays(x[split:], y[split:]))
 
     n = len(devices)
     mk = lambda: higgs_mlp(seed=0)

@@ -1,0 +1,83 @@
+// Native input-pipeline kernels for distkeras_tpu.
+//
+// The reference framework's data plane is Spark: partition iterators in
+// JVM executors feed Python workers row by row (reference:
+// distkeras/workers.py batching rows out of mapPartitions iterators).
+// The TPU rebuild's data plane is host-local numpy columns; its hot
+// path is forming shuffled batches — a strided gather — and converting
+// uint8 image bytes to normalized float32.  Both are memory-bandwidth
+// problems that single-threaded numpy leaves on the table, so they live
+// here as a small C++ library driven over ctypes
+// (distkeras_tpu/native/__init__.py), with numpy as the fallback when
+// no compiler is present.
+//
+// Build: g++ -O3 -shared -fPIC -pthread dataloader.cc -o libdkt_data.so
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Run fn(first_row, last_row) over [0, n) split across n_threads.
+template <typename F>
+void parallel_rows(int64_t n, int n_threads, F fn) {
+  if (n_threads <= 1 || n < 2 * n_threads) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    workers.emplace_back([=] { fn(lo, hi); });
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// dst[i, :] = src[idx[i], :] for float32 rows.
+void dkt_gather_f32(const float* src, const int64_t* idx, float* dst,
+                    int64_t n_out, int64_t row_elems, int n_threads) {
+  parallel_rows(n_out, n_threads, [=](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(dst + i * row_elems, src + idx[i] * row_elems,
+                  row_elems * sizeof(float));
+    }
+  });
+}
+
+// Generic byte-wise row gather (any fixed row size, any dtype).
+void dkt_gather_bytes(const uint8_t* src, const int64_t* idx, uint8_t* dst,
+                      int64_t n_out, int64_t row_bytes, int n_threads) {
+  parallel_rows(n_out, n_threads, [=](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+    }
+  });
+}
+
+// dst[i, :] = float(src[idx[i], :]) * scale + bias — fused gather +
+// uint8->f32 normalize (the CIFAR/ImageNet decode hot path).
+void dkt_gather_u8_normalize(const uint8_t* src, const int64_t* idx,
+                             float* dst, int64_t n_out, int64_t row_elems,
+                             float scale, float bias, int n_threads) {
+  parallel_rows(n_out, n_threads, [=](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* s = src + idx[i] * row_elems;
+      float* d = dst + i * row_elems;
+      for (int64_t j = 0; j < row_elems; ++j) {
+        d[j] = static_cast<float>(s[j]) * scale + bias;
+      }
+    }
+  });
+}
+
+}  // extern "C"
