@@ -12,56 +12,28 @@ nothing for its parameter-server overhead.  Measured on this machine
 2026-07-29: 267.1 samples/sec single-process -> 2137 samples/sec
 8-executor proxy (see BASELINE.md).
 
-TPU-side setup: bf16 compute (MXU-native), batch 1024, jitted
-train step with donated state, synthetic device-resident data so the
-measurement is pure training throughput.
+Measurement methodology lives in ONE place — scripts/bench_suite.py
+(bf16 policy, jitted donated-state step, device-resident data,
+float(loss) barrier); this driver just wraps its cifar_cnn config with
+the vs_baseline ratio.
 """
 
 import json
 import os
-import time
+import sys
 
 os.environ.setdefault("KERAS_BACKEND", "jax")
 
-SPARK8_CPU_PROXY_SPS = 2137.0  # samples/sec; provenance in module docstring
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "scripts"))
 
-BATCH = 1024
-WARMUP = 10
-ITERS = 300
+SPARK8_CPU_PROXY_SPS = 2137.0  # samples/sec; provenance in module docstring
 
 
 def main():
-    import jax
-    import numpy as np
-    import keras
+    from bench_suite import bench_cifar_cnn
 
-    keras.mixed_precision.set_global_policy("mixed_bfloat16")
-
-    from distkeras_tpu.models.adapter import ModelAdapter
-    from distkeras_tpu.models.zoo import cifar_cnn
-
-    model = cifar_cnn(seed=0)
-    adapter = ModelAdapter(model, loss="sparse_categorical_crossentropy",
-                           optimizer="sgd", learning_rate=0.01)
-    state = adapter.init_state()
-    step = jax.jit(adapter.make_train_step(), donate_argnums=0)
-
-    rng = np.random.default_rng(0)
-    x = jax.device_put(rng.normal(size=(BATCH, 32, 32, 3)).astype(np.float32))
-    y = jax.device_put(rng.integers(0, 10, BATCH))
-
-    for _ in range(WARMUP):
-        state, loss = step(state, x, y)
-    float(loss)  # device->host transfer: a true barrier (the axon
-    # relay's block_until_ready returns before remote execution drains)
-
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        state, loss = step(state, x, y)
-    float(loss)  # barrier through the sequential state dependency chain
-    dt = time.perf_counter() - t0
-
-    sps = BATCH * ITERS / dt
+    sps, _step_s = bench_cifar_cnn()
     print(json.dumps({
         "metric": "cifar_cnn_train_throughput",
         "value": round(sps, 1),

@@ -1,0 +1,168 @@
+"""Full benchmark suite on the current accelerator (one JSON line per
+config; bench.py stays the single-headline driver).
+
+Methodology (same as bench.py): bf16 compute policy, jitted train step
+with donated state, device-resident synthetic data, warmup, then a
+timed run whose barrier is a device->host float() through the step
+dependency chain (the axon relay's block_until_ready returns early).
+
+Usage: python scripts/bench_suite.py [config ...]
+Configs: mnist_mlp cifar_cnn higgs_mlp imdb_lstm resnet50 transformer
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure_keras(build, shape, classes, batch, iters, warmup=10,
+                  int_input=False, vocab=None):
+    import jax
+    import numpy as np
+    from distkeras_tpu.models.adapter import ModelAdapter
+
+    model = build()
+    adapter = ModelAdapter(model, loss=(
+        "binary_crossentropy" if classes == 1
+        else "sparse_categorical_crossentropy"),
+        optimizer="sgd", learning_rate=0.01)
+    state = adapter.init_state()
+    step = jax.jit(adapter.make_train_step(), donate_argnums=0)
+
+    rng = np.random.default_rng(0)
+    if int_input:
+        x = jax.device_put(rng.integers(0, vocab, (batch, *shape))
+                           .astype(np.int32))
+    else:
+        x = jax.device_put(rng.normal(size=(batch, *shape))
+                           .astype(np.float32))
+    y = jax.device_put(rng.integers(0, max(classes, 2), batch)
+                       .astype(np.float32 if classes == 1 else np.int64))
+
+    for _ in range(warmup):
+        state, loss = step(state, x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return batch * iters / dt, dt / iters
+
+
+def bench_mnist_mlp():
+    import keras
+    from distkeras_tpu.models.zoo import mnist_mlp
+
+    keras.mixed_precision.set_global_policy("mixed_bfloat16")
+    return measure_keras(lambda: mnist_mlp(seed=0), (784,), 10,
+                         batch=4096, iters=300)
+
+
+def bench_cifar_cnn():
+    import keras
+    from distkeras_tpu.models.zoo import cifar_cnn
+
+    keras.mixed_precision.set_global_policy("mixed_bfloat16")
+    return measure_keras(lambda: cifar_cnn(seed=0), (32, 32, 3), 10,
+                         batch=1024, iters=300)
+
+
+def bench_higgs_mlp():
+    import keras
+    from distkeras_tpu.models.zoo import higgs_mlp
+
+    keras.mixed_precision.set_global_policy("mixed_bfloat16")
+    return measure_keras(lambda: higgs_mlp(seed=0), (28,), 2,
+                         batch=4096, iters=300)
+
+
+def bench_imdb_lstm():
+    import keras
+    from distkeras_tpu.models.zoo import imdb_lstm
+
+    keras.mixed_precision.set_global_policy("mixed_bfloat16")
+    return measure_keras(
+        lambda: imdb_lstm(vocab_size=20000, maxlen=128, seed=0), (128,), 1,
+        batch=512, iters=100, int_input=True, vocab=20000)
+
+
+def bench_resnet50():
+    import keras
+    from distkeras_tpu.models.zoo import resnet50
+
+    keras.mixed_precision.set_global_policy("mixed_bfloat16")
+    return measure_keras(lambda: resnet50(seed=0), (224, 224, 3), 1000,
+                         batch=128, iters=50, warmup=5)
+
+
+def bench_transformer():
+    """Flagship LM: tokens/sec with the Pallas flash-attention path."""
+    import jax
+    import numpy as np
+    import optax
+    from distkeras_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=32768, d_model=512, n_heads=4, n_layers=4, d_ff=2048,
+        max_len=1025, dtype="bfloat16")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    opt = optax.adamw(3e-4)
+    step = jax.jit(tfm.make_train_step(cfg, opt), donate_argnums=0)
+    carry = (params, opt.init(params))
+
+    batch, seq = 8, 1024
+    rng = np.random.default_rng(0)
+    tokens = jax.device_put(
+        rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32))
+    for _ in range(5):
+        carry, loss = step(carry, tokens)
+    float(loss)
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry, loss = step(carry, tokens)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return batch * seq * iters / dt, dt / iters
+
+
+BENCHES = {
+    "mnist_mlp": (bench_mnist_mlp, "samples/sec/chip"),
+    "cifar_cnn": (bench_cifar_cnn, "samples/sec/chip"),
+    "higgs_mlp": (bench_higgs_mlp, "samples/sec/chip"),
+    "imdb_lstm": (bench_imdb_lstm, "samples/sec/chip"),
+    "resnet50": (bench_resnet50, "samples/sec/chip"),
+    "transformer": (bench_transformer, "tokens/sec/chip"),
+}
+
+
+def main(names):
+    import jax
+
+    unknown = set(names) - set(BENCHES)
+    if unknown:
+        sys.exit(f"unknown config(s) {sorted(unknown)}; "
+                 f"choose from {sorted(BENCHES)}")
+    print(f"# backend={jax.default_backend()} device={jax.devices()[0]}",
+          file=sys.stderr)
+    for name in names or BENCHES:
+        fn, unit = BENCHES[name]
+        try:
+            rate, step_s = fn()
+        except Exception as e:  # keep the suite going; record the failure
+            print(json.dumps({"metric": name, "error": repr(e)[:200]}))
+            continue
+        print(json.dumps({
+            "metric": name, "value": round(rate, 1), "unit": unit,
+            "step_ms": round(step_s * 1e3, 2),
+        }))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
