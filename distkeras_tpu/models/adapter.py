@@ -178,6 +178,29 @@ class ModelAdapter:
 
         return train_step
 
+    def make_multi_train_step(self, n_steps: int) -> Callable:
+        """Build ``step(state, xs, ys) -> (state', losses)`` running
+        ``n_steps`` *optimizer updates* in one XLA call.
+
+        ``xs: [n_steps, B, ...]`` — one minibatch per scanned step (NOT
+        gradient accumulation; compare make_accum_train_step, which
+        takes one update over its window).  Amortizes per-call host
+        dispatch, which dominates for small models (the reference pays
+        a py4j+pickle round trip per batch — reference:
+        distkeras/workers.py; here even the jit dispatch can be folded
+        away).  Returns the per-step losses ``[n_steps]``.
+        """
+        train_step = self.make_train_step()
+
+        def multi(state: TrainState, xs, ys):
+            def body(state, batch):
+                state, loss = train_step(state, *batch)
+                return state, loss
+
+            return jax.lax.scan(body, state, (xs, ys))
+
+        return multi
+
     def make_predict_fn(self) -> Callable:
         """Pure ``f(tv, ntv, x) -> outputs`` (inference mode)."""
         model = self.model

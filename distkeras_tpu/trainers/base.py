@@ -168,22 +168,50 @@ class SingleTrainer(Trainer):
     ``train_on_batch`` loop — SURVEY.md §3.1).  Here the step is one XLA
     program; the loop merely feeds batches and retires device losses
     without forcing a sync every step.
+
+    ``steps_per_call`` > 1 scans that many optimizer updates inside one
+    XLA call (adapter.make_multi_train_step), amortizing host dispatch —
+    the dominant cost for small models.  Checkpoint granularity becomes
+    ``steps_per_call`` steps; a round = one call; like the windowed
+    distributed trainers, each epoch drops its tail remainder of up to
+    ``steps_per_call * batch_size - 1`` rows (shapes must stay static).
     """
 
+    def __init__(self, keras_model, steps_per_call: int = 1, **kw):
+        super().__init__(keras_model, **kw)
+        if steps_per_call < 1:
+            raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+        self.steps_per_call = steps_per_call
+
     def _fit(self, dataset: Dataset):
+        spc = self.steps_per_call
         state = self.adapter.init_state()
         state, start = self._restore_or(state)
-        step = jax.jit(self.adapter.make_train_step(), donate_argnums=0)
+        if start and int(state.step) != start * spc:
+            raise ValueError(
+                f"checkpoint at round {start} holds optimizer step "
+                f"{int(state.step)}, but steps_per_call={spc} implies "
+                f"{start * spc}: the checkpoint was written under a "
+                "different steps_per_call — resume with the original "
+                "value (data skipping is counted in rounds)")
+        if spc == 1:
+            step = jax.jit(self.adapter.make_train_step(), donate_argnums=0)
+            stream = self._epoch_stream(dataset)
+        else:
+            step = jax.jit(self.adapter.make_multi_train_step(spc),
+                           donate_argnums=0)
+            stream = self._epoch_stream(dataset, window=spc)
         losses, rnd = [], start
-        for rnd, (x, y) in enumerate(self._epoch_stream(dataset), 1):
+        for rnd, (x, y) in enumerate(stream, 1):
             if rnd <= start:
                 continue
             state, loss = step(state, x, y)
-            losses.append(loss)  # device array; no sync here
+            # Device array (scalar, or [spc] when scanning); no sync here.
+            losses.append(loss)
             self._checkpoint(state, rnd)
         if start and not losses:  # resumed past the end: nothing left to do
             return state
-        self._require_steps(losses, self.batch_size, len(dataset))
-        self._record(losses)
+        self._require_steps(losses, self.batch_size * spc, len(dataset))
+        self._record(np.concatenate([np.atleast_1d(l) for l in losses]))
         self._checkpoint(state, rnd, final=True)
         return state

@@ -21,7 +21,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def measure_keras(build, shape, classes, batch, iters, warmup=10,
-                  int_input=False, vocab=None):
+                  int_input=False, vocab=None, scan_steps=1):
+    """``scan_steps`` > 1 uses the multi-step scan path
+    (SingleTrainer(steps_per_call=...)): several optimizer updates per
+    XLA call, amortizing host dispatch for small models."""
     import jax
     import numpy as np
     from distkeras_tpu.models.adapter import ModelAdapter
@@ -32,27 +35,34 @@ def measure_keras(build, shape, classes, batch, iters, warmup=10,
         else "sparse_categorical_crossentropy"),
         optimizer="sgd", learning_rate=0.01)
     state = adapter.init_state()
-    step = jax.jit(adapter.make_train_step(), donate_argnums=0)
+    if scan_steps > 1:
+        step = jax.jit(adapter.make_multi_train_step(scan_steps),
+                       donate_argnums=0)
+        lead = (scan_steps, batch)
+    else:
+        step = jax.jit(adapter.make_train_step(), donate_argnums=0)
+        lead = (batch,)
 
     rng = np.random.default_rng(0)
     if int_input:
-        x = jax.device_put(rng.integers(0, vocab, (batch, *shape))
+        x = jax.device_put(rng.integers(0, vocab, (*lead, *shape))
                            .astype(np.int32))
     else:
-        x = jax.device_put(rng.normal(size=(batch, *shape))
+        x = jax.device_put(rng.normal(size=(*lead, *shape))
                            .astype(np.float32))
-    y = jax.device_put(rng.integers(0, max(classes, 2), batch)
+    y = jax.device_put(rng.integers(0, max(classes, 2), lead)
                        .astype(np.float32 if classes == 1 else np.int64))
 
     for _ in range(warmup):
         state, loss = step(state, x, y)
-    float(loss)
+    float(np.asarray(loss).ravel()[-1])  # device->host: the true barrier
     t0 = time.perf_counter()
     for _ in range(iters):
         state, loss = step(state, x, y)
-    float(loss)
+    float(np.asarray(loss).ravel()[-1])
     dt = time.perf_counter() - t0
-    return batch * iters / dt, dt / iters
+    steps = iters * scan_steps
+    return batch * steps / dt, dt / steps
 
 
 def bench_mnist_mlp():
@@ -61,7 +71,7 @@ def bench_mnist_mlp():
 
     keras.mixed_precision.set_global_policy("mixed_bfloat16")
     return measure_keras(lambda: mnist_mlp(seed=0), (784,), 10,
-                         batch=4096, iters=300)
+                         batch=4096, iters=60, scan_steps=8)
 
 
 def bench_cifar_cnn():
@@ -79,7 +89,7 @@ def bench_higgs_mlp():
 
     keras.mixed_precision.set_global_policy("mixed_bfloat16")
     return measure_keras(lambda: higgs_mlp(seed=0), (28,), 2,
-                         batch=4096, iters=300)
+                         batch=4096, iters=60, scan_steps=8)
 
 
 def bench_imdb_lstm():

@@ -160,3 +160,44 @@ def test_ensemble_seed_reproducible(devices):
     for m1, m2 in zip(a, b):
         for w1, w2 in zip(m1.get_weights(), m2.get_weights()):
             np.testing.assert_array_equal(w1, w2)
+
+
+def test_single_trainer_steps_per_call_matches_plain(devices):
+    """steps_per_call scans the same update sequence: same final weights."""
+    x, y = make_blobs(n=512)
+    ds = Dataset.from_arrays(x, y)
+
+    def run(spc):
+        t = SingleTrainer(make_mlp(), steps_per_call=spc,
+                          loss="sparse_categorical_crossentropy",
+                          learning_rate=0.1, batch_size=16, num_epoch=2)
+        model = t.train(ds)
+        return model, t
+
+    m1, t1 = run(1)
+    m4, t4 = run(4)
+    assert len(t1.history) == len(t4.history)  # per-step losses either way
+    np.testing.assert_allclose(t1.history, t4.history, atol=1e-5, rtol=1e-5)
+    for w1, w4 in zip(m1.get_weights(), m4.get_weights()):
+        np.testing.assert_allclose(w1, w4, atol=1e-5, rtol=1e-5)
+
+
+def test_single_trainer_steps_per_call_validation(devices):
+    with pytest.raises(ValueError, match="steps_per_call"):
+        SingleTrainer(make_mlp(), steps_per_call=0)
+
+
+def test_single_trainer_resume_rejects_spc_mismatch(devices, tmp_path):
+    x, y = make_blobs(n=512)
+    ds = Dataset.from_arrays(x, y)
+    ck = str(tmp_path / "ck")
+    t = SingleTrainer(make_mlp(), loss="sparse_categorical_crossentropy",
+                      learning_rate=0.1, batch_size=16, num_epoch=1,
+                      checkpoint_dir=ck, checkpoint_every=8)
+    t.train(ds)
+    t2 = SingleTrainer(make_mlp(), steps_per_call=4,
+                       loss="sparse_categorical_crossentropy",
+                       learning_rate=0.1, batch_size=16, num_epoch=1,
+                       checkpoint_dir=ck, resume=True)
+    with pytest.raises(ValueError, match="different steps_per_call"):
+        t2.train(ds)
