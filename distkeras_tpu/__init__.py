@@ -83,6 +83,7 @@ from distkeras_tpu.trainers import (
     DynSGD,
     AveragingTrainer,
     EnsembleTrainer,
+    LMTrainer,
 )
 
 __all__ = [
@@ -116,4 +117,5 @@ __all__ = [
     "DynSGD",
     "AveragingTrainer",
     "EnsembleTrainer",
+    "LMTrainer",
 ]

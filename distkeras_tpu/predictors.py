@@ -60,18 +60,38 @@ class ModelPredictor(Predictor):
         self._ntv = jax.device_put(
             [np.asarray(v.value) for v in self.adapter.model.non_trainable_variables], rep)
 
-    def predict(self, dataset: Dataset) -> Dataset:
+    def _predict_array(self, x: np.ndarray) -> np.ndarray:
         bs = self._bs
-        predict_fn, tv, ntv = self._predict_fn, self._tv, self._ntv
-        data_sh = self._data_sh
-
-        x = dataset[self.features_col]
+        if len(x) == 0:
+            # Empty poll (routine on streams): run one padded batch to
+            # learn the output shape, return its 0-row slice.
+            zero = np.zeros((bs,) + x.shape[1:], x.dtype)
+            out = np.asarray(self._predict_fn(
+                self._tv, self._ntv, jax.device_put(zero, self._data_sh)))
+            return out[:0]
         outs = []
         for i in range(0, len(x), bs):
             xb = x[i:i + bs]
             pad = bs - len(xb)
             if pad:
                 xb = np.concatenate([xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
-            yb = predict_fn(tv, ntv, jax.device_put(xb, data_sh))
+            yb = self._predict_fn(self._tv, self._ntv,
+                                  jax.device_put(xb, self._data_sh))
             outs.append(np.asarray(yb)[:len(xb) - pad if pad else bs])
-        return dataset.with_column(self.output_col, np.concatenate(outs))
+        return np.concatenate(outs)
+
+    def predict(self, dataset: Dataset) -> Dataset:
+        return dataset.with_column(
+            self.output_col, self._predict_array(dataset[self.features_col]))
+
+    def predict_stream(self, batches):
+        """Yield predictions for an unbounded stream of feature arrays.
+
+        The reference ships a Spark-Streaming/Kafka inference demo
+        (reference: examples — streaming predictor over a DStream); the
+        TPU-native equivalent is this generator: each incoming numpy
+        array of features yields its prediction array, reusing the one
+        jitted program and device-resident weights across the stream.
+        """
+        for xb in batches:
+            yield self._predict_array(np.asarray(xb))

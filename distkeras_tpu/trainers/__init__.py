@@ -4,6 +4,7 @@ from distkeras_tpu.trainers.distributed import (
     ADAG,
     DynSGD,
 )
+from distkeras_tpu.trainers.lm import LMTrainer
 from distkeras_tpu.trainers.elastic import (
     AEASGD,
     EAMSGD,
@@ -23,4 +24,5 @@ __all__ = [
     "DOWNPOUR",
     "AveragingTrainer",
     "EnsembleTrainer",
+    "LMTrainer",
 ]

@@ -177,8 +177,11 @@ class SingleTrainer(Trainer):
     ``steps_per_call * batch_size - 1`` rows (shapes must stay static).
     """
 
-    def __init__(self, keras_model, steps_per_call: int = 1, **kw):
-        super().__init__(keras_model, **kw)
+    def __init__(self, keras_model, loss="categorical_crossentropy", *,
+                 steps_per_call: int = 1, **kw):
+        # steps_per_call is keyword-only so the parent's positional
+        # contract (keras_model, loss, ...) is preserved.
+        super().__init__(keras_model, loss=loss, **kw)
         if steps_per_call < 1:
             raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
         self.steps_per_call = steps_per_call

@@ -1,0 +1,124 @@
+"""LMTrainer: the transformer under the trainer-family contract, across
+mesh configurations, plus streaming prediction."""
+
+import jax
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.models import transformer as tfm
+from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_len=32)
+
+
+def tokens(rng, n=64, s=16):
+    return rng.integers(0, 64, (n, s + 1)).astype(np.int32)
+
+
+def _loss_falls(history):
+    assert history[-1] < history[0] * 0.85, history[::max(1, len(history)//5)]
+
+
+def test_lm_trainer_dp(devices, rng):
+    mesh = make_mesh(MeshSpec(data=8), devices=devices)
+    t = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16, num_epoch=8,
+                     mesh=mesh)
+    params = t.train(dk.Dataset({"tokens": tokens(rng)}))
+    assert t.training_time > 0 and len(t.history) == 32
+    _loss_falls(t.history)
+    assert params["tok_emb"].shape == (64, 32)
+
+
+def test_lm_trainer_tp_sp(devices, rng):
+    mesh = make_mesh(MeshSpec(data=2, model=2, seq=2), devices=devices)
+    t = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16, num_epoch=8,
+                     mesh=mesh)
+    t.train(tokens(rng))
+    _loss_falls(t.history)
+
+
+def test_lm_trainer_pp_ep(devices, rng):
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                num_experts=2, capacity_factor=2.0)
+    mesh = make_mesh(MeshSpec(data=2, pipeline=2, expert=2), devices=devices)
+    t = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=16, num_epoch=8,
+                     mesh=mesh)
+    t.train(tokens(rng))
+    _loss_falls(t.history)
+
+
+def test_lm_trainer_rejects_pp_plus_sp(devices):
+    mesh = make_mesh(MeshSpec(data=2, pipeline=2, seq=2), devices=devices)
+    with pytest.raises(ValueError, match="pipeline and seq"):
+        dk.LMTrainer(CFG, mesh=mesh)
+
+
+def test_lm_trainer_validates_batch(devices, rng):
+    mesh = make_mesh(MeshSpec(data=8), devices=devices)
+    with pytest.raises(ValueError, match="batch_size"):
+        dk.LMTrainer(CFG, batch_size=12, mesh=mesh).train(tokens(rng))
+
+
+def test_lm_trainer_unknown_optimizer(devices):
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        dk.LMTrainer(CFG, optimizer="lion")
+
+
+def test_predict_stream(devices, rng):
+    import keras
+
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([keras.Input((8,)),
+                              keras.layers.Dense(4)])
+    pred = dk.ModelPredictor(model, batch_size=16)
+    stream = [rng.normal(size=(n, 8)).astype(np.float32) for n in (5, 16, 33)]
+    outs = list(pred.predict_stream(iter(stream)))
+    assert [len(o) for o in outs] == [5, 16, 33]
+    # Matches the batch path.
+    ref = pred.predict(dk.Dataset.from_arrays(stream[2]))["prediction"]
+    np.testing.assert_allclose(outs[2], ref, atol=1e-6)
+
+
+def test_lm_trainer_accepts_optax_optimizers(devices, rng):
+    import optax
+
+    mesh = make_mesh(MeshSpec(data=2), devices=devices[:2])
+    # Prebuilt GradientTransformation.
+    t = dk.LMTrainer(CFG, optimizer=optax.lion(1e-3), batch_size=8,
+                     num_epoch=1, mesh=mesh)
+    t.train(tokens(rng, n=16))
+    # Factory callable gets learning_rate applied.
+    t2 = dk.LMTrainer(CFG, optimizer=optax.lion, learning_rate=1e-3,
+                      batch_size=8, num_epoch=1, mesh=mesh)
+    t2.train(tokens(rng, n=16))
+
+
+def test_lm_trainer_microbatches_requires_pipeline(devices):
+    mesh = make_mesh(MeshSpec(data=8), devices=devices)
+    with pytest.raises(ValueError, match="pipeline"):
+        dk.LMTrainer(CFG, mesh=mesh, microbatches=4)
+
+
+def test_predict_stream_empty_poll(devices, rng):
+    import keras
+
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([keras.Input((8,)), keras.layers.Dense(4)])
+    pred = dk.ModelPredictor(model, batch_size=16)
+    outs = list(pred.predict_stream([np.zeros((0, 8), np.float32),
+                                     rng.normal(size=(3, 8)).astype(np.float32)]))
+    assert outs[0].shape == (0, 4)
+    assert outs[1].shape == (3, 4)
+
+
+def test_single_trainer_loss_positional_not_shadowed(devices):
+    from tests.conftest import make_mlp
+    from distkeras_tpu import SingleTrainer
+
+    t = SingleTrainer(make_mlp(), "sparse_categorical_crossentropy",
+                      learning_rate=0.1, batch_size=16)
+    assert t.steps_per_call == 1
