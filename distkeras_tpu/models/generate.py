@@ -1,0 +1,143 @@
+"""Autoregressive decoding for the transformer LM (KV-cached).
+
+Inference counterpart of models/transformer.py — the LM analogue of
+the reference's ModelPredictor batch-inference path (reference:
+distkeras/predictors.py), which only covers fixed-shape feedforward
+outputs.  Decoding is XLA-shaped: the KV cache is a static [B, max_len,
+H, D] buffer per layer, the loop is ``lax.scan`` over positions (one
+compiled program regardless of prompt/output length), and sampling is
+functional over an explicit PRNG key.
+
+Greedy (temperature=0) and temperature sampling are supported; batch
+decoding shards over the mesh ``data`` axis like every other batch op.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.models.transformer import (
+    TransformerConfig,
+    _rms_norm,
+)
+
+
+def init_cache(cfg: TransformerConfig, batch: int, dtype=None):
+    """Per-layer KV buffers [L, B, max_len, H, head_dim]."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (cfg.n_layers, batch, cfg.max_len, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
+    """One position: tokens [B] at position ``pos`` -> (logits [B, V], cache).
+
+    Attention reads the cache up to ``pos`` with a position mask (static
+    shapes; masked slots contribute exp(NEG_INF-ish) = 0).
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    b = tokens.shape[0]
+    x = params["tok_emb"][tokens].astype(dtype)  # [B, D]
+    x = x + jax.lax.dynamic_index_in_dim(
+        params["pos_emb"], pos, axis=0, keepdims=False).astype(dtype)
+
+    new_cache_k, new_cache_v = [], []
+    for i in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+        h = _rms_norm(x, lp["ln1_scale"])
+        q = jnp.einsum("bd,dhk->bhk", h, lp["attn"]["wq"])
+        # Cache dtype: the einsum promotes bf16 activations x f32 weights
+        # to f32; the cache stays in the compute dtype.
+        k = jnp.einsum("bd,dhk->bhk", h, lp["attn"]["wk"])
+        v = jnp.einsum("bd,dhk->bhk", h, lp["attn"]["wv"])
+        ck = jax.lax.dynamic_update_index_in_dim(
+            cache["k"][i], k.astype(cache["k"].dtype), pos, axis=1)
+        cv = jax.lax.dynamic_update_index_in_dim(
+            cache["v"][i], v.astype(cache["v"].dtype), pos, axis=1)
+        new_cache_k.append(ck)
+        new_cache_v.append(cv)
+
+        logits = jnp.einsum("bhk,bshk->bhs", q.astype(jnp.float32),
+                            ck.astype(jnp.float32))
+        logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
+        mask = jnp.arange(cfg.max_len)[None, None, :] <= pos
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bhs,bshk->bhk", probs, cv.astype(jnp.float32))
+        x = x + jnp.einsum("bhk,hkd->bd", attn.astype(dtype),
+                           lp["attn"]["wo"])
+
+        h = _rms_norm(x, lp["ln2_scale"])
+        if cfg.num_experts:
+            # Decode-time MoE: dense top-1 without capacity (batch is
+            # small; correctness over dispatch efficiency).
+            router = jnp.einsum("bd,de->be", h.astype(jnp.float32),
+                                lp["moe"]["wg"])
+            gate = jax.nn.softmax(router, axis=-1)
+            expert = gate.argmax(axis=-1)
+            w1 = lp["moe"]["w1"][expert]  # [B, D, F]
+            w2 = lp["moe"]["w2"][expert]  # [B, F, D]
+            y = jnp.einsum(
+                "bf,bfd->bd",
+                jax.nn.gelu(jnp.einsum("bd,bdf->bf", h, w1.astype(dtype))),
+                w2.astype(dtype)) * gate.max(-1, keepdims=True).astype(dtype)
+        else:
+            y = jnp.einsum(
+                "bf,fd->bd",
+                jax.nn.gelu(jnp.einsum("bd,df->bf", h, lp["ffn"]["w1"])),
+                lp["ffn"]["w2"])
+        x = x + y
+
+    x = _rms_norm(x, params["ln_f_scale"])
+    out = jnp.einsum("bd,vd->bv", x, params["tok_emb"].astype(dtype))
+    cache = {"k": jnp.stack(new_cache_k), "v": jnp.stack(new_cache_v)}
+    return out.astype(jnp.float32), cache
+
+
+def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
+             temperature: float = 0.0, key=None):
+    """Decode ``max_new_tokens`` past ``prompt [B, P]``; returns [B, P+N].
+
+    One compiled scan: prompt positions run through the same cached
+    step (teacher-forced), then sampling continues from the last
+    prompt token.  temperature == 0 is greedy argmax.
+    """
+    b, p = prompt.shape
+    if p < 1:
+        raise ValueError(
+            "prompt must contain at least one token (decoding starts from "
+            "its last position; pass a BOS token for unconditional samples)")
+    total = p + max_new_tokens
+    if total > cfg.max_len:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_len={cfg.max_len}")
+    if temperature > 0 and key is None:
+        raise ValueError("temperature sampling needs an explicit PRNG key")
+    key = key if key is not None else jax.random.key(0)
+
+    # Buffer of emitted tokens; prompt occupies [0, p).
+    buf = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
+    cache = init_cache(cfg, b)
+
+    def body(carry, pos):
+        buf, cache, key = carry
+        tok = jax.lax.dynamic_index_in_dim(buf, pos, axis=1, keepdims=False)
+        logits, cache = _decode_step(params, cache, tok, pos, cfg)
+        key, sub = jax.random.split(key)
+        if temperature > 0:
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = logits.argmax(axis=-1)
+        # Only write past the prompt (prompt positions are forced).
+        write_pos = jnp.minimum(pos + 1, total - 1)
+        keep = jax.lax.dynamic_index_in_dim(buf, write_pos, axis=1,
+                                            keepdims=False)
+        nxt = jnp.where(write_pos >= p, nxt.astype(jnp.int32), keep)
+        buf = jax.lax.dynamic_update_index_in_dim(buf, nxt, write_pos, axis=1)
+        return (buf, cache, key), None
+
+    (buf, _, _), _ = jax.lax.scan(body, (buf, cache, key),
+                                  jnp.arange(total - 1))
+    return buf
