@@ -116,7 +116,15 @@ class ModelAdapter:
         return out, ntv2
 
     def make_loss_fn(self) -> Callable:
-        """Pure ``f(tv, ntv, x, y) -> (loss, ntv')`` for value_and_grad."""
+        """Pure ``f(tv, ntv, x, y) -> (loss, ntv')`` for value_and_grad.
+
+        Rematerialization note: checkpointing this whole function would
+        be a peak-memory no-op (the backward's recompute materializes
+        every residual at once); useful remat needs sub-function
+        granularity, which requires model structure — the functional
+        transformer does it per block (models/transformer.py
+        TransformerConfig.remat).
+        """
         model, loss_fn = self.model, self.loss_fn
 
         def compute_loss(tv, ntv, x, y):

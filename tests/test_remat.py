@@ -1,0 +1,40 @@
+"""Rematerialization: identical numerics, O(1)-block activation memory."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.models import transformer as tfm
+
+
+def test_transformer_remat_matches_plain(rng):
+    base = dict(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                d_ff=64, max_len=32)
+    cfg = tfm.TransformerConfig(**base)
+    cfg_r = tfm.TransformerConfig(**base, remat=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    toks = jnp.asarray(rng.integers(0, 64, (4, 17)).astype(np.int32))
+
+    l1, g1 = jax.value_and_grad(tfm.lm_loss)(params, toks, cfg)
+    l2, g2 = jax.value_and_grad(tfm.lm_loss)(params, toks, cfg_r)
+    np.testing.assert_allclose(l1, l2, atol=1e-6, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_transformer_pipelined_remat(devices, rng):
+    """remat composes with the pipelined trunk."""
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    base = dict(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                d_ff=64, max_len=32)
+    cfg = tfm.TransformerConfig(**base)
+    cfg_r = tfm.TransformerConfig(**base, remat=True)
+    mesh = make_mesh(MeshSpec(data=2, pipeline=2), devices=devices[:4])
+    params = tfm.init_params(jax.random.key(0), cfg)
+    toks = jnp.asarray(rng.integers(0, 64, (4, 16)).astype(np.int32))
+    ref, _ = jax.jit(lambda p, t: tfm.apply_pipelined(p, t, cfg, mesh, 2))(
+        params, toks)
+    out, _ = jax.jit(lambda p, t: tfm.apply_pipelined(p, t, cfg_r, mesh, 2))(
+        params, toks)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
