@@ -14,8 +14,11 @@ one set of semantics so tests can pin them against each other:
   of ring attention (distkeras_tpu.parallel.ring).
 - :func:`flash_attention` — Pallas TPU kernel (MXU-tiled, VMEM-resident
   online softmax) on TPU backends; falls back to blockwise elsewhere.
-  Backward pass recomputes through the blockwise implementation
-  (flash-style rematerialization: O(L) residuals instead of O(L^2)).
+  On the Pallas path the backward is the FA2 construction (dQ and
+  dK/dV kernels rebuilding probabilities per tile from the forward's
+  saved log-sum-exp); the fallback backward recomputes through the
+  blockwise implementation under ``jax.vjp``.  O(L) residuals either
+  way.
 
 All take ``q: [B, Lq, H, D]``, ``k/v: [B, Lkv, H, D]`` and return
 ``[B, Lq, H, D]``.  ``q_offset``/``kv_offset`` give the global positions
@@ -143,8 +146,8 @@ def blockwise_attention(q, k, v, causal: bool = False,
 # ------------------------------------------------------------- Pallas kernel
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  causal: bool, scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
+                  with_lse: bool):
     """Flash-attention forward for one (batch*head, q-block, kv-block) cell.
 
     KV streams through the grid's innermost dimension so VMEM holds only
@@ -154,7 +157,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     initialized at j == 0 and the normalized output is written at the
     last j.  ``m``/``l`` are stored lane-broadcast ([block_q, 128]) to
     respect the f32 (8, 128) tile.
+
+    With ``with_lse`` (the training path) it also writes the per-row
+    log-sum-exp (``lse = m + log l``), the residual the FA2-style
+    backward kernels need to rebuild softmax probabilities tile-by-tile
+    without O(L^2) memory; inference omits the output (and its HBM
+    writes) entirely.
     """
+    if with_lse:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
     j = pl.program_id(2)
     n_kb = pl.num_programs(2)
     block_q = q_ref.shape[1]
@@ -202,6 +215,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l = l_scr[:, :1]
         out = acc_scr[:] / jnp.where(l == 0, 1.0, l)
         o_ref[0] = out.astype(o_ref.dtype)
+        if with_lse:
+            # Lane-broadcast [block_q, 128]: rank-2 (1, block_q) blocks
+            # break the TPU (8, 128) tiling; a trailing lane dim is the
+            # idiom.
+            lse_ref[0] = jnp.broadcast_to(
+                m_scr[:, :1] + jnp.log(jnp.where(l == 0, 1.0, l)),
+                lse_ref.shape[1:])
 
 
 try:  # Pallas import is cheap but keep non-TPU environments working.
@@ -213,7 +233,10 @@ except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
 
-def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False):
+def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False,
+                  with_lse=True):
+    """Returns (out, lse) with ``with_lse`` (training), else (out, None) —
+    inference skips the lse buffer's HBM writes entirely."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
     block_q = min(block_q, lq)
@@ -221,7 +244,17 @@ def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False):
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
-    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
+    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
+                               with_lse=with_lse)
+
+    o_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
+                          memory_space=pltpu.VMEM)
+    o_shape = jax.ShapeDtypeStruct((b * h, lq, d), q.dtype)
+    lse_spec = pl.BlockSpec((1, block_q, 128), lambda bh, i, j: (bh, i, 0),
+                            memory_space=pltpu.VMEM)
+    lse_shape = jax.ShapeDtypeStruct((b * h, lq, 128), jnp.float32)
+    out_bytes = o_shape.size * q.dtype.itemsize + (
+        lse_shape.size * 4 if with_lse else 0)
 
     def call(): return pl.pallas_call(
         kernel,
@@ -234,9 +267,8 @@ def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False):
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        out_specs=(o_spec, lse_spec) if with_lse else o_spec,
+        out_shape=(o_shape, lse_shape) if with_lse else o_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # m (lane-broadcast)
             pltpu.VMEM((block_q, 128), jnp.float32),  # l
@@ -244,7 +276,7 @@ def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False):
         ],
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * lq * lk * d,
-            bytes_accessed=(qf.size + kf.size + vf.size) * q.dtype.itemsize,
+            bytes_accessed=(qf.nbytes + kf.nbytes + vf.nbytes + out_bytes),
             transcendentals=b * h * lq * lk,
         ),
     )(qf, kf, vf)
@@ -254,10 +286,180 @@ def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False):
         # program_id, memory spaces) on CPU in tests.  The mode is
         # captured at pallas_call *construction*, hence the thunk.
         with pltpu.force_tpu_interpret_mode():
-            out = call()
+            res = call()
     else:
-        out = call()
-    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+        res = call()
+    out, lse = res if with_lse else (res, None)
+    out = out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+    return out, (lse[:, :, 0] if with_lse else None)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, causal: bool, scale: float):
+    """dQ for one (batch*head, q-block, kv-block) cell.
+
+    FA2 backward: probabilities are rebuilt per tile from the saved
+    log-sum-exp (p = exp(s - lse)); ``delta = rowsum(dO * O)`` folds the
+    softmax normalizer's gradient.  dq accumulates across the inner
+    kv-block dimension in VMEM scratch.
+    """
+    j = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    row0 = pl.program_id(1) * block_q
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = (not causal) or (j * block_k <= row0 + block_q - 1)
+
+    @pl.when(live)
+    def _update():
+        qi = jax.lax.convert_element_type(q_ref[0], jnp.float32)
+        kj = jax.lax.convert_element_type(k_ref[0], jnp.float32)
+        vj = jax.lax.convert_element_type(v_ref[0], jnp.float32)
+        do = jax.lax.convert_element_type(do_ref[0], jnp.float32)
+        s = jax.lax.dot_general(qi, kj, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + row0)
+            cols = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                    + j * block_k)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(do, vj, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, kj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_kb - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                          scale: float):
+    """dK/dV for one (batch*head, kv-block, q-block) cell; q streams on
+    the inner grid dimension, accumulating into the kv block's scratch."""
+    jq = pl.program_id(2)
+    n_qb = pl.num_programs(2)
+    block_k = k_ref.shape[1]
+    block_q = q_ref.shape[1]
+    col0 = pl.program_id(1) * block_k
+    row0 = jq * block_q
+
+    @pl.when(jq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # Causal: a q block contributes unless entirely above the diagonal.
+    live = (not causal) or (row0 + block_q - 1 >= col0)
+
+    @pl.when(live)
+    def _update():
+        qi = jax.lax.convert_element_type(q_ref[0], jnp.float32)
+        kj = jax.lax.convert_element_type(k_ref[0], jnp.float32)
+        vj = jax.lax.convert_element_type(v_ref[0], jnp.float32)
+        do = jax.lax.convert_element_type(do_ref[0], jnp.float32)
+        s = jax.lax.dot_general(qi, kj, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + row0)
+            cols = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + col0)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])  # [block_q, block_k]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vj, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, qi, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jq == n_qb - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                      interpret=False):
+    """Pallas dQ/dK/dV from the saved (out, lse) residuals."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    flat = lambda a, L: a.transpose(0, 2, 1, 3).reshape(b * h, L, d)
+    qf, kf, vf = flat(q, lq), flat(k, lk), flat(v, lk)
+    dof, of = flat(g, lq), flat(out, lq)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    # Lane-broadcast row vectors (TPU tiling; see _flash_kernel note).
+    lane = lambda a: jnp.broadcast_to(a[:, :, None], (*a.shape, 128))
+    lse_l, delta_l = lane(lse), lane(delta)
+
+    vspec = lambda f: pl.BlockSpec(*f, memory_space=pltpu.VMEM)
+    q_at = ((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    kv_at_inner = ((1, block_k, d), lambda bh, i, j: (bh, j, 0))
+    row_at = ((1, block_q, 128), lambda bh, i, j: (bh, i, 0))
+
+    def call_dq():
+        return pl.pallas_call(
+            functools.partial(_flash_bwd_dq_kernel, causal=causal,
+                              scale=scale),
+            grid=(b * h, lq // block_q, lk // block_k),
+            in_specs=[vspec(q_at), vspec(kv_at_inner), vspec(kv_at_inner),
+                      vspec(q_at), vspec(row_at), vspec(row_at)],
+            out_specs=vspec(q_at),
+            out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            cost_estimate=pl.CostEstimate(
+                flops=6 * b * h * lq * lk * d,
+                bytes_accessed=(qf.nbytes + kf.nbytes + vf.nbytes
+                                + dof.nbytes + lse_l.nbytes + delta_l.nbytes),
+                transcendentals=b * h * lq * lk),
+        )(qf, kf, vf, dof, lse_l, delta_l)
+
+    kv_at = ((1, block_k, d), lambda bh, i, j: (bh, i, 0))
+    q_at_inner = ((1, block_q, d), lambda bh, i, j: (bh, j, 0))
+    row_at_inner = ((1, block_q, 128), lambda bh, i, j: (bh, j, 0))
+
+    def call_dkv():
+        return pl.pallas_call(
+            functools.partial(_flash_bwd_dkv_kernel, causal=causal,
+                              scale=scale),
+            grid=(b * h, lk // block_k, lq // block_q),
+            in_specs=[vspec(q_at_inner), vspec(kv_at), vspec(kv_at),
+                      vspec(q_at_inner), vspec(row_at_inner),
+                      vspec(row_at_inner)],
+            out_specs=(vspec(kv_at), vspec(kv_at)),
+            out_shape=(jax.ShapeDtypeStruct((b * h, lk, d), k.dtype),
+                       jax.ShapeDtypeStruct((b * h, lk, d), v.dtype)),
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+            cost_estimate=pl.CostEstimate(
+                flops=6 * b * h * lq * lk * d,
+                bytes_accessed=(qf.nbytes + kf.nbytes + vf.nbytes
+                                + dof.nbytes + lse_l.nbytes + delta_l.nbytes),
+                transcendentals=b * h * lq * lk),
+        )(qf, kf, vf, dof, lse_l, delta_l)
+
+    if interpret:
+        with pltpu.force_tpu_interpret_mode():
+            dq = call_dq()
+            dk, dv = call_dkv()
+    else:
+        dq = call_dq()
+        dk, dv = call_dkv()
+    unflat = lambda a, L: a.reshape(b, h, L, d).transpose(0, 2, 1, 3)
+    return unflat(dq, lq), unflat(dk, lk), unflat(dv, lk)
 
 
 def _use_pallas(q, k, block_q, block_k) -> bool:
@@ -274,27 +476,39 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
                     block_q: int = 256, block_k: int = 512):
     """Fused attention: Pallas kernel on TPU, blockwise jnp elsewhere.
 
-    Differentiable via flash-style rematerialization: the backward pass
-    re-runs the blockwise forward under ``jax.vjp`` (O(L) residual
-    memory, trading FLOPs for HBM — the right trade on TPU).
+    Differentiable with O(L) residuals both ways: on the Pallas path
+    the backward is the FA2 construction — dQ/dK/dV kernels that
+    rebuild probabilities per tile from the forward's saved
+    log-sum-exp; on the fallback path the backward re-runs the
+    blockwise forward under ``jax.vjp``.
     """
     s = _scale_for(q, scale)
     if _use_pallas(q, k, block_q, block_k):
-        return _flash_pallas(q, k, v, causal, s, block_q, block_k)
+        return _flash_pallas(q, k, v, causal, s, block_q, block_k,
+                             with_lse=False)[0]
     return blockwise_attention(q, k, v, causal=causal, scale=s,
                                block_k=block_k)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
-    return flash_attention(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+    s = _scale_for(q, scale)
+    if _use_pallas(q, k, block_q, block_k):
+        out, lse = _flash_pallas(q, k, v, causal, s, block_q, block_k)
+        return out, (q, k, v, out, lse)
+    out = blockwise_attention(q, k, v, causal=causal, scale=s,
+                              block_k=block_k)
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
+    s = _scale_for(q, scale)
+    if lse is not None:
+        return _flash_pallas_bwd(q, k, v, out, lse, g, causal, s,
+                                 block_q, block_k)
     _, vjp = jax.vjp(
         lambda q, k, v: blockwise_attention(
-            q, k, v, causal=causal, scale=_scale_for(q, scale),
-            block_k=block_k),
+            q, k, v, causal=causal, scale=s, block_k=block_k),
         q, k, v)
     return vjp(g)
 

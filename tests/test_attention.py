@@ -90,9 +90,37 @@ def test_pallas_kernel_interpret(rng, causal):
     """The TPU kernel's logic, run via the Pallas interpreter on CPU."""
     q, k, v = qkv(rng, b=1, l=16, h=1, d=128)
     ref = naive_attention(q, k, v, causal=causal)
-    out = _flash_pallas(q, k, v, causal, 1.0 / np.sqrt(128), block_q=8,
-                        block_k=8, interpret=True)
+    out, lse = _flash_pallas(q, k, v, causal, 1.0 / np.sqrt(128), block_q=8,
+                             block_k=8, interpret=True)
     np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+    # lse residual: matches the materialized logits' logsumexp.
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(128)
+    if causal:
+        mask = np.tril(np.ones((16, 16), bool))
+        logits = np.where(mask[None, None], logits, -1e30)
+    ref_lse = np.log(np.exp(logits).sum(-1)).reshape(1, 16)
+    np.testing.assert_allclose(lse, ref_lse, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_backward_kernels_interpret(rng, causal):
+    """FA2 dQ/dK/dV kernels vs autodiff of the naive oracle (interpreter)."""
+    from distkeras_tpu.ops.attention import _flash_pallas_bwd, _scale_for
+
+    q, k, v = qkv(rng, b=1, l=16, h=2, d=128)
+    scale = _scale_for(q, None)
+    out, lse = _flash_pallas(q, k, v, causal, scale, block_q=8, block_k=8,
+                             interpret=True)
+    g = rng.normal(size=out.shape).astype(np.float32)
+    dq, dk, dv = _flash_pallas_bwd(q, k, v, np.asarray(out), lse, g, causal,
+                                   scale, block_q=8, block_k=8,
+                                   interpret=True)
+    _, vjp = jax.vjp(
+        lambda q, k, v: naive_attention(q, k, v, causal=causal), q, k, v)
+    dq_ref, dk_ref, dv_ref = vjp(jnp.asarray(g))
+    np.testing.assert_allclose(dq, dq_ref, atol=2e-3, rtol=2e-3)
+    np.testing.assert_allclose(dk, dk_ref, atol=2e-3, rtol=2e-3)
+    np.testing.assert_allclose(dv, dv_ref, atol=2e-3, rtol=2e-3)
 
 
 @pytest.mark.parametrize("causal", [False, True])
