@@ -59,7 +59,12 @@ class Prefetcher:
             self._put(self._DONE)
 
     def close(self) -> None:
-        """Stop the producer and release buffered items."""
+        """Stop the producer and release buffered items.
+
+        Also wakes any consumer already blocked in ``__next__`` (the
+        drain below could otherwise swallow the producer's ``_DONE``
+        sentinel and leave that consumer blocked forever).
+        """
         self._stop.set()
         while True:
             try:
@@ -67,6 +72,10 @@ class Prefetcher:
             except queue.Empty:
                 break
         self._finished = True
+        try:
+            self._q.put_nowait(self._DONE)
+        except queue.Full:
+            pass  # a queued item will wake the consumer instead
 
     def __del__(self):  # pragma: no cover - GC timing
         self.close()
@@ -77,7 +86,13 @@ class Prefetcher:
     def __next__(self):
         if self._finished:
             raise StopIteration
-        item = self._q.get()
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+                break
+            except queue.Empty:
+                if self._stop.is_set():
+                    raise StopIteration from None
         if item is self._DONE:
             self._finished = True
             if self._err is not None:

@@ -102,6 +102,14 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     One compiled scan: prompt positions run through the same cached
     step (teacher-forced), then sampling continues from the last
     prompt token.  temperature == 0 is greedy argmax.
+
+    MoE caveat: decode-time routing is dense top-1 *without* expert
+    capacity (see ``step_fn``), so logits diverge from the training
+    forward (``transformer.apply``) for any token the training router
+    would capacity-drop.  Exact train/infer parity holds only when
+    ``capacity_factor`` is large enough that nothing is dropped; if
+    parity matters at realistic capacity factors, evaluate logits with
+    the training ``apply`` instead of the cached step.
     """
     b, p = prompt.shape
     if p < 1:

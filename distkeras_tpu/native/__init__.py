@@ -106,7 +106,6 @@ def gather_rows(src: np.ndarray, idx: np.ndarray,
     """
     handle = lib()
     src = _as_c(src)
-    rows = src.reshape(len(src), -1)
     idx = _check_idx(idx, len(src))
     out_shape = (len(idx), *src.shape[1:])
     if out is not None:
@@ -119,6 +118,11 @@ def gather_rows(src: np.ndarray, idx: np.ndarray,
         return result
     if out is None:
         out = np.empty(out_shape, src.dtype)
+    if idx.size == 0:
+        # the reshape(n, -1)s below raise for size-0 arrays (this also
+        # covers an empty src, where len(src) rows can't reshape either)
+        return out
+    rows = src.reshape(len(src), -1)
     flat_out = out.reshape(len(idx), -1)
     if src.dtype == np.float32:
         handle.dkt_gather_f32(
@@ -152,6 +156,9 @@ def gather_normalize_u8(src: np.ndarray, idx: np.ndarray, scale: float,
         return result
     if out is None:
         out = np.empty(out_shape, np.float32)
+    if idx.size == 0:
+        # reshape(0, -1) below would raise; nothing to copy anyway.
+        return out
     handle.dkt_gather_u8_normalize(
         src.reshape(len(src), -1).ctypes.data, idx.ctypes.data,
         out.reshape(len(idx), -1).ctypes.data,

@@ -19,34 +19,28 @@ from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.adapter import ModelAdapter
 
 
-class Trainer:
-    """Base trainer: owns the adapter and the train() bookkeeping."""
+class CheckpointingBase:
+    """Checkpoint/resume plumbing shared across the whole trainer family.
 
-    def __init__(self, keras_model, loss="categorical_crossentropy",
-                 worker_optimizer="sgd", learning_rate: float | None = None,
-                 batch_size: int = 32, num_epoch: int = 1,
-                 features_col: str = "features", label_col: str = "label",
-                 shuffle: bool = False, seed: int | None = None,
-                 checkpoint_dir: str | None = None, checkpoint_every: int = 0,
-                 max_checkpoints: int = 3, resume: bool = False):
-        self.adapter = ModelAdapter(
-            keras_model, loss=loss, optimizer=worker_optimizer,
-            learning_rate=learning_rate)
-        self.batch_size = batch_size
-        self.num_epoch = num_epoch
-        self.features_col = features_col
-        self.label_col = label_col
-        self.shuffle = shuffle
-        self.seed = seed
-        self.training_time: float = 0.0
-        self.history: list[float] = []
-        # Checkpoint/resume (SURVEY.md §5: the reference has none; here
-        # any trainer can persist its full training state via orbax).
+    The Keras trainers (:class:`Trainer` subclasses) and the flagship
+    :class:`~distkeras_tpu.trainers.lm.LMTrainer` persist training state
+    through the same orbax-backed machinery so the user contract —
+    ``checkpoint_dir`` / ``checkpoint_every`` / ``max_checkpoints`` /
+    ``resume`` — is uniform, the way the reference keeps one contract
+    across its trainer family (reference: distkeras/trainers.py base
+    class).
+    """
+
+    def _setup_checkpointing(self, *, checkpoint_dir: str | None,
+                             checkpoint_every: int, max_checkpoints: int,
+                             resume: bool, shuffle: bool,
+                             seed: int | None) -> None:
         self.checkpoint_every = checkpoint_every
         self.resume = resume
         self.checkpoint_dir = checkpoint_dir
         self.max_checkpoints = max_checkpoints
         self._ckpt = None
+        self._last_saved_round = 0
         if resume and shuffle and seed is None:
             raise ValueError(
                 "resume=True with shuffle=True needs a fixed seed: resume "
@@ -57,65 +51,31 @@ class Trainer:
                 "resume/checkpoint_every need a checkpoint_dir — without one "
                 "nothing is restored or written")
 
-    # -- subclass hook -----------------------------------------------------
-    def _fit(self, dataset: Dataset):  # pragma: no cover
-        raise NotImplementedError
-
-    def train(self, dataset: Dataset, features_col: str | None = None,
-              label_col: str | None = None):
-        """Train and return a fresh Keras model with the learned weights.
-
-        (EnsembleTrainer returns a list of models via its ``_export``.)
-        """
-        if features_col:
-            self.features_col = features_col
-        if label_col:
-            self.label_col = label_col
-        if self.shuffle:
-            dataset = dataset.shuffle(self.seed)
-        t0 = time.perf_counter()
-        if self.checkpoint_dir:
-            from distkeras_tpu.checkpoint import CheckpointManager
-
-            # Opened per run and closed on exit so orbax's async machinery
-            # doesn't outlive the training it serves.
-            self._ckpt = CheckpointManager(
-                self.checkpoint_dir, max_to_keep=self.max_checkpoints)
-            if not self.resume and self._ckpt.latest_step() is not None:
-                self._ckpt.close()
-                self._ckpt = None
-                raise ValueError(
-                    f"checkpoint_dir {self.checkpoint_dir!r} already holds "
-                    "checkpoints; pass resume=True to continue from them or "
-                    "point at a fresh directory (orbax refuses to overwrite "
-                    "an existing step)")
+    def _open_checkpoints(self) -> None:
+        """Open the per-run checkpoint manager (closed by _close_)."""
         self._last_saved_round = 0
-        try:
-            state = self._fit(dataset)
-            jax.block_until_ready(state.tv)
-        finally:
-            if self._ckpt is not None:
-                self._ckpt.close()
-                self._ckpt = None
-        self.training_time = time.perf_counter() - t0
-        return self._export(state)
+        if not self.checkpoint_dir:
+            return
+        from distkeras_tpu.checkpoint import CheckpointManager
 
-    def _export(self, state):
-        return self.adapter.export_model(state)
+        # Opened per run and closed on exit so orbax's async machinery
+        # doesn't outlive the training it serves.
+        self._ckpt = CheckpointManager(
+            self.checkpoint_dir, max_to_keep=self.max_checkpoints)
+        if not self.resume and self._ckpt.latest_step() is not None:
+            self._ckpt.close()
+            self._ckpt = None
+            raise ValueError(
+                f"checkpoint_dir {self.checkpoint_dir!r} already holds "
+                "checkpoints; pass resume=True to continue from them or "
+                "point at a fresh directory (orbax refuses to overwrite "
+                "an existing step)")
 
-    # -- helpers -----------------------------------------------------------
-    def _epoch_stream(self, dataset: Dataset, window: int | None = None):
-        """Yield (x, y) batches across all epochs."""
-        for _ in range(self.num_epoch):
-            ds = dataset
-            yield from ds.batches(
-                self.batch_size, features_col=self.features_col,
-                label_col=self.label_col, drop_remainder=True, window=window)
+    def _close_checkpoints(self) -> None:
+        if self._ckpt is not None:
+            self._ckpt.close()
+            self._ckpt = None
 
-    def _record(self, losses) -> None:
-        self.history.extend(float(l) for l in losses)
-
-    # -- checkpointing -----------------------------------------------------
     def _restore_or(self, pytree):
         """Return (pytree, start_round): latest checkpoint if resuming.
 
@@ -144,6 +104,76 @@ class Trainer:
             self._ckpt.save(pytree, round_idx, force=True)
             self._ckpt.wait_until_finished()
             self._last_saved_round = round_idx
+
+
+class Trainer(CheckpointingBase):
+    """Base trainer: owns the adapter and the train() bookkeeping."""
+
+    def __init__(self, keras_model, loss="categorical_crossentropy",
+                 worker_optimizer="sgd", learning_rate: float | None = None,
+                 batch_size: int = 32, num_epoch: int = 1,
+                 features_col: str = "features", label_col: str = "label",
+                 shuffle: bool = False, seed: int | None = None,
+                 checkpoint_dir: str | None = None, checkpoint_every: int = 0,
+                 max_checkpoints: int = 3, resume: bool = False):
+        self.adapter = ModelAdapter(
+            keras_model, loss=loss, optimizer=worker_optimizer,
+            learning_rate=learning_rate)
+        self.batch_size = batch_size
+        self.num_epoch = num_epoch
+        self.features_col = features_col
+        self.label_col = label_col
+        self.shuffle = shuffle
+        self.seed = seed
+        self.training_time: float = 0.0
+        self.history: list[float] = []
+        # Checkpoint/resume (SURVEY.md §5: the reference has none; here
+        # any trainer can persist its full training state via orbax).
+        self._setup_checkpointing(
+            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+            max_checkpoints=max_checkpoints, resume=resume, shuffle=shuffle,
+            seed=seed)
+
+    # -- subclass hook -----------------------------------------------------
+    def _fit(self, dataset: Dataset):  # pragma: no cover
+        raise NotImplementedError
+
+    def train(self, dataset: Dataset, features_col: str | None = None,
+              label_col: str | None = None):
+        """Train and return a fresh Keras model with the learned weights.
+
+        (EnsembleTrainer returns a list of models via its ``_export``.)
+        """
+        if features_col:
+            self.features_col = features_col
+        if label_col:
+            self.label_col = label_col
+        if self.shuffle:
+            dataset = dataset.shuffle(self.seed)
+        t0 = time.perf_counter()
+        self._open_checkpoints()
+        try:
+            state = self._fit(dataset)
+            jax.block_until_ready(state.tv)
+        finally:
+            self._close_checkpoints()
+        self.training_time = time.perf_counter() - t0
+        return self._export(state)
+
+    def _export(self, state):
+        return self.adapter.export_model(state)
+
+    # -- helpers -----------------------------------------------------------
+    def _epoch_stream(self, dataset: Dataset, window: int | None = None):
+        """Yield (x, y) batches across all epochs."""
+        for _ in range(self.num_epoch):
+            ds = dataset
+            yield from ds.batches(
+                self.batch_size, features_col=self.features_col,
+                label_col=self.label_col, drop_remainder=True, window=window)
+
+    def _record(self, losses) -> None:
+        self.history.extend(float(l) for l in losses)
 
     def _require_steps(self, losses, rows_needed: int, n_rows: int) -> None:
         """Refuse to silently return an untrained model.

@@ -28,6 +28,7 @@ Synchronization rules (SURVEY.md §7.4):
 
 from __future__ import annotations
 
+import warnings
 from functools import partial
 from typing import Callable
 
@@ -197,7 +198,15 @@ class AEASGD(ReplicaTrainer):
         if alpha * n >= 1.0:
             # Keep the center update contractive; the reference's async
             # form hides this with staleness, the sync form must not blow up.
-            alpha = 0.9 / n
+            clamped = 0.9 / n
+            warnings.warn(
+                f"AEASGD elastic coefficient rho*learning_rate = {alpha:g} "
+                f"violates the synchronous stability bound "
+                f"rho*learning_rate*num_workers < 1 (num_workers={n}); "
+                f"clamping to {clamped:g}. Lower rho or learning_rate to "
+                "run the requested coefficient (see docs/algorithms.md).",
+                stacklevel=2)
+            alpha = clamped
         self.alpha = alpha
         self.sync_fn = _easgd_sync(alpha)
 

@@ -29,9 +29,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models import transformer as tfm
-from distkeras_tpu.parallel.mesh import make_mesh
+from distkeras_tpu.parallel.mesh import AXES, make_mesh
 from distkeras_tpu.parallel.ring import make_ring_attention
 from distkeras_tpu.parallel.sharding import ShardingPlan
+from distkeras_tpu.trainers.base import CheckpointingBase
 
 
 _OPTS = {
@@ -41,14 +42,26 @@ _OPTS = {
 }
 
 
-class LMTrainer:
-    """Train a causal transformer LM over a device mesh."""
+class LMTrainer(CheckpointingBase):
+    """Train a causal transformer LM over a device mesh.
+
+    Carries the full trainer-family contract: ``history`` /
+    ``training_time``, ``shuffle`` (+ ``seed``), and orbax
+    checkpoint/resume through ``checkpoint_dir`` / ``checkpoint_every``
+    / ``max_checkpoints`` / ``resume`` — the same knobs as
+    :class:`~distkeras_tpu.trainers.base.Trainer` (reference keeps one
+    uniform contract across its family, distkeras/trainers.py).
+    A checkpoint round is one optimizer step.
+    """
 
     def __init__(self, cfg: tfm.TransformerConfig, optimizer="adamw",
                  learning_rate: float = 3e-4, batch_size: int = 8,
                  num_epoch: int = 1, mesh=None, rules=None,
                  microbatches: int | None = None,
-                 tokens_col: str = "tokens", seed: int = 0):
+                 tokens_col: str = "tokens", seed: int = 0,
+                 shuffle: bool = False,
+                 checkpoint_dir: str | None = None, checkpoint_every: int = 0,
+                 max_checkpoints: int = 3, resume: bool = False):
         self.cfg = cfg
         if hasattr(optimizer, "init"):  # prebuilt optax GradientTransformation
             self.optimizer = optimizer
@@ -68,9 +81,21 @@ class LMTrainer:
             rules=tfm.tp_rules() if rules is None else rules)
         self.tokens_col = tokens_col
         self.seed = seed
+        self.shuffle = shuffle
         self.history: list[float] = []
         self.training_time: float = 0.0
+        self._setup_checkpointing(
+            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+            max_checkpoints=max_checkpoints, resume=resume, shuffle=shuffle,
+            seed=seed)
 
+        missing = [a for a in AXES if a not in self.mesh.shape]
+        if missing:
+            raise ValueError(
+                f"mesh is missing axes {missing}: LMTrainer needs the "
+                f"canonical axis set {AXES} (build the mesh with "
+                "parallel.mesh.make_mesh / MeshSpec, which always carries "
+                "all five, sized 1 when unused)")
         n_pipe = int(self.mesh.shape["pipeline"])
         n_seq = int(self.mesh.shape["seq"])
         if n_pipe > 1 and n_seq > 1:
@@ -103,6 +128,21 @@ class LMTrainer:
         return jax.device_put(
             params, self.plan.tree_shardings(self.mesh, params))
 
+    def _place_opt_state(self, opt_state, params):
+        """Commit optimizer state to the mesh: subtrees mirroring the
+        params structure (adam mu/nu, momentum buffers) take the params'
+        shardings; everything else (step counters) is replicated."""
+        psh = self.plan.tree_shardings(self.mesh, params)
+        rep = NamedSharding(self.mesh, P())
+        p_def = jax.tree.structure(params)
+
+        def params_like(x):
+            return jax.tree.structure(x) == p_def
+
+        return jax.tree.map(
+            lambda x: jax.device_put(x, psh if params_like(x) else rep),
+            opt_state, is_leaf=params_like)
+
     def train(self, dataset: Dataset | np.ndarray, params=None):
         """Train over the token rows; returns the trained params pytree."""
         tokens = (dataset if isinstance(dataset, np.ndarray)
@@ -110,6 +150,13 @@ class LMTrainer:
         if tokens.ndim != 2:
             raise ValueError(f"tokens must be [N, seq+1], got {tokens.shape}")
         n_data = int(self.mesh.shape["data"])
+        n_seq = int(self.mesh.shape["seq"])
+        seq_len = tokens.shape[1] - 1
+        if n_seq > 1 and seq_len % n_seq:
+            raise ValueError(
+                f"sequence length {seq_len} (token rows carry seq+1 = "
+                f"{tokens.shape[1]} positions) must divide by the mesh seq "
+                f"axis ({n_seq}) for ring attention to shard it")
         global_bs = self.batch_size
         # The pipelined path splits each per-data-shard batch into
         # microbatches; without a pipeline axis only data divides it.
@@ -120,11 +167,20 @@ class LMTrainer:
                 f"batch_size={global_bs} must divide by data axis ({n_data})"
                 + (f" x microbatches ({self.microbatches})"
                    if divisor != n_data else ""))
+        if self.shuffle:
+            perm = np.random.default_rng(self.seed).permutation(len(tokens))
+            tokens = np.asarray(tokens)[perm]
 
         t0 = time.perf_counter()
         if params is None:
             params = self.init_params()
-        opt_state = self.optimizer.init(params)
+        # Optimizer state must be *committed* to the mesh: fresh eager
+        # arrays are uncommitted (jit may reshard them freely) but the
+        # checkpoint-restore template takes each leaf's sharding
+        # literally, so adam's scalar count would come back pinned to
+        # one device while params span the mesh — an invalid mix.
+        opt_state = self._place_opt_state(
+            self.optimizer.init(params), params)
         step = jax.jit(self._step_builder(self.optimizer), donate_argnums=0)
         tok_sh = NamedSharding(self.mesh, P("data", None))
 
@@ -133,12 +189,24 @@ class LMTrainer:
         if not n_rows:
             raise ValueError(
                 f"dataset has {len(tokens)} rows; one step needs {global_bs}")
-        for _ in range(self.num_epoch):
-            for i in range(0, n_rows, global_bs):
-                batch = jax.device_put(
-                    np.asarray(tokens[i:i + global_bs], np.int32), tok_sh)
-                carry, loss = step(carry, batch)
-                losses.append(loss)
+        self._open_checkpoints()
+        try:
+            carry, start = self._restore_or(carry)
+            rnd = 0
+            for _ in range(self.num_epoch):
+                for i in range(0, n_rows, global_bs):
+                    rnd += 1
+                    if rnd <= start:
+                        continue
+                    batch = jax.device_put(
+                        np.asarray(tokens[i:i + global_bs], np.int32), tok_sh)
+                    carry, loss = step(carry, batch)
+                    losses.append(loss)
+                    self._checkpoint(carry, rnd)
+            if losses:
+                self._checkpoint(carry, rnd, final=True)
+        finally:
+            self._close_checkpoints()
         params, _ = carry
         jax.block_until_ready(jax.tree.leaves(params)[0])
         self.history = [float(l) for l in losses]

@@ -122,3 +122,52 @@ def test_single_trainer_loss_positional_not_shadowed(devices):
     t = SingleTrainer(make_mlp(), "sparse_categorical_crossentropy",
                       learning_rate=0.1, batch_size=16)
     assert t.steps_per_call == 1
+
+
+def test_lm_trainer_rejects_mesh_missing_axes(devices):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(devices).reshape(8), ("batch",))
+    with pytest.raises(ValueError, match="missing axes"):
+        dk.LMTrainer(CFG, mesh=mesh)
+
+
+def test_lm_trainer_rejects_indivisible_seq(devices, rng):
+    mesh = make_mesh(MeshSpec(data=4, seq=2), devices=devices)
+    t = dk.LMTrainer(CFG, batch_size=8, mesh=mesh)
+    with pytest.raises(ValueError, match="seq axis"):
+        t.train(tokens(rng, s=15))  # 15 positions, seq axis 2
+
+
+def test_lm_trainer_shuffle_deterministic(devices, rng):
+    mesh = make_mesh(MeshSpec(data=8), devices=devices)
+    toks = tokens(rng, n=64)
+    runs = []
+    for _ in range(2):
+        t = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16, num_epoch=2,
+                         mesh=mesh, shuffle=True, seed=7)
+        runs.append(t.train(toks.copy()))
+    for a, b in zip(jax.tree.leaves(runs[0]), jax.tree.leaves(runs[1])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_lm_trainer_resume_matches_straight_run(tmp_path, devices, rng):
+    mesh = make_mesh(MeshSpec(data=8), devices=devices)
+    toks = tokens(rng, n=64)
+    common = dict(learning_rate=1e-2, batch_size=16, mesh=mesh,
+                  shuffle=True, seed=3)
+
+    straight = dk.LMTrainer(CFG, num_epoch=4, **common)
+    ref = straight.train(dk.Dataset({"tokens": toks}))
+
+    d = str(tmp_path / "ckpt")
+    first = dk.LMTrainer(CFG, num_epoch=2, checkpoint_dir=d, **common)
+    first.train(dk.Dataset({"tokens": toks}))
+    resumed = dk.LMTrainer(CFG, num_epoch=4, checkpoint_dir=d, resume=True,
+                           **common)
+    out = resumed.train(dk.Dataset({"tokens": toks}))
+
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    assert len(resumed.history) == len(straight.history) - len(first.history)

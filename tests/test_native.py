@@ -110,3 +110,50 @@ def test_batches_prefetch_matches_plain(rng):
     for (xa, ya), (xb, yb) in zip(plain, pre):
         np.testing.assert_array_equal(xa, xb)
         np.testing.assert_array_equal(ya, yb)
+
+
+def test_gather_empty_index(rng):
+    """Empty index must return an empty result on both paths (the native
+    path used to crash on reshape(0, -1))."""
+    src = rng.normal(size=(5, 3)).astype(np.float32)
+    empty = np.zeros(0, np.int64)
+    assert native.gather_rows(src, empty).shape == (0, 3)
+    # Empty *source* too (Dataset.shuffle on an empty dataset).
+    assert native.gather_rows(np.empty((0, 3), np.float32), empty).shape == (0, 3)
+    u8 = rng.integers(0, 256, (5, 4, 2)).astype(np.uint8)
+    out = native.gather_normalize_u8(u8, empty, scale=1 / 255.0)
+    assert out.shape == (0, 4, 2) and out.dtype == np.float32
+
+
+def test_prefetcher_close_wakes_blocked_consumer():
+    """close() must wake a consumer already blocked in __next__ (the
+    drain used to swallow the producer's _DONE sentinel)."""
+    import threading
+    import time
+
+    release = threading.Event()
+
+    def slow():
+        yield 1
+        release.wait(timeout=30)  # producer stalls; consumer blocks
+        yield 2
+
+    it = Prefetcher(slow(), depth=1)
+    assert next(it) == 1
+    outcome = []
+
+    def consume():
+        try:
+            next(it)
+            outcome.append("item")
+        except StopIteration:
+            outcome.append("stop")
+
+    th = threading.Thread(target=consume)
+    th.start()
+    time.sleep(0.2)  # let the consumer block in the queue get
+    it.close()
+    th.join(timeout=5)
+    release.set()
+    assert not th.is_alive(), "consumer still blocked after close()"
+    assert outcome == ["stop"]

@@ -201,3 +201,19 @@ def test_single_trainer_resume_rejects_spc_mismatch(devices, tmp_path):
                        checkpoint_dir=ck, resume=True)
     with pytest.raises(ValueError, match="different steps_per_call"):
         t2.train(ds)
+
+
+def test_aeasgd_warns_on_unstable_alpha(devices):
+    # rho*lr*n >= 1 violates the synchronous stability bound; the clamp
+    # must be loud, not a silent algorithm substitution.
+    with pytest.warns(UserWarning, match="stability bound"):
+        t = AEASGD(make_mlp(), loss="sparse_categorical_crossentropy",
+                   rho=5.0, learning_rate=0.05, num_workers=8)
+    assert t.alpha == pytest.approx(0.9 / 8)
+
+
+def test_aeasgd_no_warning_inside_bound(devices, recwarn):
+    t = AEASGD(make_mlp(), loss="sparse_categorical_crossentropy",
+               rho=1.0, learning_rate=0.01, num_workers=8)
+    assert t.alpha == pytest.approx(0.01)
+    assert not [w for w in recwarn if "stability" in str(w.message)]
