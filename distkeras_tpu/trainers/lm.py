@@ -168,29 +168,38 @@ class LMTrainer(CheckpointingBase):
                 + (f" x microbatches ({self.microbatches})"
                    if divisor != n_data else ""))
         if self.shuffle:
+            # Same permutation contract as Dataset.shuffle; the row
+            # gather runs through the native threaded loader when built.
+            from distkeras_tpu.native import gather_rows
+
             perm = np.random.default_rng(self.seed).permutation(len(tokens))
-            tokens = np.asarray(tokens)[perm]
+            tokens = gather_rows(np.ascontiguousarray(tokens), perm)
 
         t0 = time.perf_counter()
-        if params is None:
-            params = self.init_params()
-        # Optimizer state must be *committed* to the mesh: fresh eager
-        # arrays are uncommitted (jit may reshard them freely) but the
-        # checkpoint-restore template takes each leaf's sharding
-        # literally, so adam's scalar count would come back pinned to
-        # one device while params span the mesh — an invalid mix.
-        opt_state = self._place_opt_state(
-            self.optimizer.init(params), params)
-        step = jax.jit(self._step_builder(self.optimizer), donate_argnums=0)
-        tok_sh = NamedSharding(self.mesh, P("data", None))
-
-        carry, losses = (params, opt_state), []
-        n_rows = len(tokens) - (len(tokens) % global_bs)
-        if not n_rows:
-            raise ValueError(
-                f"dataset has {len(tokens)} rows; one step needs {global_bs}")
+        # Fail fast on a bad checkpoint_dir before paying parameter
+        # init and mesh placement.
         self._open_checkpoints()
         try:
+            if params is None:
+                params = self.init_params()
+            # Optimizer state must be *committed* to the mesh: fresh
+            # eager arrays are uncommitted (jit may reshard them freely)
+            # but the checkpoint-restore template takes each leaf's
+            # sharding literally, so adam's scalar count would come back
+            # pinned to one device while params span the mesh — an
+            # invalid mix.
+            opt_state = self._place_opt_state(
+                self.optimizer.init(params), params)
+            step = jax.jit(self._step_builder(self.optimizer),
+                           donate_argnums=0)
+            tok_sh = NamedSharding(self.mesh, P("data", None))
+
+            carry, losses = (params, opt_state), []
+            n_rows = len(tokens) - (len(tokens) % global_bs)
+            if not n_rows:
+                raise ValueError(
+                    f"dataset has {len(tokens)} rows; one step needs "
+                    f"{global_bs}")
             carry, start = self._restore_or(carry)
             rnd = 0
             for _ in range(self.num_epoch):

@@ -140,3 +140,96 @@ def test_periodic_checkpoints_written(tmp_path):
     with CheckpointManager(d) as mngr:
         steps = mngr.all_steps()
     assert steps == [5, 10, 15, 16]  # every 5 rounds + final (16 rounds)
+
+
+CRASH_CHILD = """
+import os, sys
+os.environ["KERAS_BACKEND"] = "jax"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tests!r})
+from conftest import make_blobs, make_mlp
+import distkeras_tpu as dk
+
+x, y = make_blobs(n=128)
+ds = dk.Dataset.from_arrays(x, y)
+t = dk.SingleTrainer(make_mlp(), loss="sparse_categorical_crossentropy",
+                     worker_optimizer="sgd", learning_rate=0.05,
+                     batch_size=16, num_epoch=100,
+                     checkpoint_dir={ckdir!r}, checkpoint_every=1,
+                     max_checkpoints=3)
+t.train(ds)
+print("CHILD FINISHED")  # the parent kills us long before this
+"""
+
+
+def _committed_steps(ckdir):
+    import os
+
+    if not os.path.isdir(ckdir):
+        return []
+    return sorted(int(d) for d in os.listdir(ckdir) if d.isdigit())
+
+
+def test_sigkill_midrun_then_resume_matches_straight(tmp_path):
+    """The SURVEY §5 failure story: durability comes from
+    checkpoint/restart.  A training process is SIGKILLed mid-run (no
+    cleanup, like a preemption); resuming from its checkpoints must land
+    exactly where an uninterrupted run does."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests = os.path.join(repo, "tests")
+    ckdir = str(tmp_path / "ckpt")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # skip TPU-plugin init: faster
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         CRASH_CHILD.format(repo=repo, tests=tests, ckdir=ckdir)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if child.poll() is not None:
+                out = child.stdout.read().decode(errors="replace")
+                raise AssertionError(
+                    f"child exited (rc={child.returncode}) before the kill "
+                    f"— make the run longer.\n{out[-2000:]}")
+            steps = _committed_steps(ckdir)
+            if steps and steps[-1] >= 20:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("no checkpoint reached step 20 in time")
+        child.send_signal(signal.SIGKILL)  # no atexit, no orbax cleanup
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=30)
+    assert child.returncode == -signal.SIGKILL
+
+    killed_at = _committed_steps(ckdir)[-1]
+    assert 0 < killed_at < 800, "child was not killed mid-run"
+
+    x, y = make_blobs(n=128)
+    ds = dk.Dataset.from_arrays(x, y)
+    common = dict(loss="sparse_categorical_crossentropy",
+                  worker_optimizer="sgd", learning_rate=0.05,
+                  batch_size=16, num_epoch=100)
+    ref = dk.SingleTrainer(make_mlp(), **common).train(ds)
+    resumed = dk.SingleTrainer(make_mlp(), checkpoint_dir=ckdir, resume=True,
+                               **common)
+    out = resumed.train(ds)
+    for wr, wo in zip(_weights(ref), _weights(out)):
+        np.testing.assert_allclose(wr, wo, rtol=1e-5, atol=1e-6)
+    # The resume really started from the crash point, not from scratch.
+    assert len(resumed.history) <= 800 - killed_at
