@@ -242,21 +242,44 @@ def apply(params, tokens, cfg: TransformerConfig,
 
 def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
                     microbatches: int, attention_fn: Callable | None = None,
-                    axis_name: str = "pipeline"):
+                    axis_name: str = "pipeline", seq_axis: str | None = None):
     """Forward pass with the layer trunk pipelined over ``axis_name``.
 
     Embedding and the head run outside the pipeline (they change shape);
     the residual trunk — whose stacked [L, ...] params slice naturally
     into ``n_stages`` contiguous stages — runs under
-    parallel.pipeline.make_pipeline.  MoE aux loss is not accumulated
-    under PP (stage outputs are activation-only); use the dense FFN or
-    accept the un-regularized router when pipelining.
+    parallel.pipeline.make_pipeline.  MoE aux loss flows through the
+    pipeline (stage outputs carry ``(activation, aux)``), averaged over
+    microbatches so it sits on the same scale as :func:`apply` — note
+    expert capacity applies per *microbatch* under PP, so routing can
+    drop slightly differently than the un-pipelined forward.
 
-    Returns (logits, aux=0).
+    For PP x SP, pass ``seq_axis="seq"``: the pipeline's shard_map goes
+    manual over {pipeline, seq} and each stage runs the raw
+    :func:`~distkeras_tpu.parallel.ring.ring_attention` body on its
+    sequence shard — one composed shard_map, which (unlike a nested
+    shard_map) transposes cleanly under AD.  MoE routing/capacity then
+    applies per sequence shard.
+
+    Returns (logits, aux).
     """
+    import functools
+
     from distkeras_tpu.parallel.pipeline import make_pipeline
 
-    if attention_fn is None:
+    x_spec = P()
+    if seq_axis is not None and int(mesh.shape[seq_axis]) > 1:
+        if attention_fn is not None:
+            raise ValueError(
+                "pass either attention_fn or seq_axis, not both: under "
+                "seq_axis the pipeline installs the ring attention body "
+                "itself")
+        from distkeras_tpu.parallel.ring import ring_attention
+
+        attention_fn = functools.partial(ring_attention, axis_name=seq_axis,
+                                         causal=True)
+        x_spec = P(None, seq_axis)
+    elif attention_fn is None:
         attention_fn = lambda q, k, v: flash_attention(q, k, v, True)
     n_stages = int(mesh.shape[axis_name])
     if cfg.n_layers % n_stages:
@@ -279,16 +302,19 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
         block = jax.checkpoint(block_apply, static_argnums=(2, 3))
 
     def stage_fn(lp, u):
+        aux_stage = jnp.zeros((), jnp.float32)
         for i in range(per_stage):
             li = jax.tree.map(lambda a: a[i], lp)
-            u, _ = block(li, u, cfg, attention_fn)
-        return u
+            u, aux = block(li, u, cfg, attention_fn)
+            aux_stage = aux_stage + aux
+        return u, aux_stage
 
-    pipe = make_pipeline(stage_fn, mesh, microbatches, axis_name)
-    x = pipe(stage_params, x)
+    pipe = make_pipeline(stage_fn, mesh, microbatches, axis_name,
+                         x_spec=x_spec)
+    x, aux_total = pipe(stage_params, x)
     x = _rms_norm(x, params["ln_f_scale"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["tok_emb"].astype(dtype))
-    return logits.astype(jnp.float32), jnp.zeros((), jnp.float32)
+    return logits.astype(jnp.float32), aux_total
 
 
 def lm_loss(params, tokens, cfg: TransformerConfig,

@@ -28,17 +28,40 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def make_pipeline(stage_fn: Callable, mesh: Mesh, microbatches: int,
-                  axis_name: str = "pipeline"):
-    """Build ``f(stage_params, x) -> y`` running ``stage_fn`` as a pipeline.
+                  axis_name: str = "pipeline", x_spec: P = P()):
+    """Build ``f(stage_params, x) -> (y, aux)`` running ``stage_fn`` as a
+    pipeline.
 
     ``stage_params``: pytree whose leaves have a leading [n_stages] axis
     (stage i consumes slice i).  ``x``: [B, ...] global batch, split
-    into ``microbatches`` equal microbatches.  ``stage_fn(params, u)``
-    must be shape-preserving on ``u`` ([mb, ...] -> [mb, ...]); stages
-    that change activation shape belong outside the pipeline (embed /
-    head), matching how GPipe slices a residual trunk.
+    into ``microbatches`` equal microbatches.
+    ``stage_fn(params, u) -> (u_out, aux)`` must be shape-preserving on
+    ``u`` ([mb, ...] -> [mb, ...]) and return a scalar auxiliary loss
+    (0 when it has none); stages that change activation shape belong
+    outside the pipeline (embed / head), matching how GPipe slices a
+    residual trunk.
+
+    ``aux`` is the per-stage aux summed over stages, averaged over
+    microbatches — each microbatch computes its own full-forward aux, so
+    the mean keeps it on the same scale as an un-pipelined forward.
+    Bubble ticks (a stage holding no real microbatch) are masked out of
+    the accumulation.
+
+    ``x_spec`` extends the manual axis set: a PartitionSpec over ``x``'s
+    dims naming further mesh axes (e.g. ``P(None, 'seq')`` for sequence
+    parallelism) makes the body manual over those too, with ``x``
+    entering as the named shard.  ``stage_fn`` then runs with those axes
+    manual in context, so it may call collective bodies (ring attention)
+    directly — nesting a second shard_map inside the pipeline does not
+    transpose under AD, composing manual axes in one shard_map does.
+    Every other mesh axis (data, model, expert) stays XLA-automatic.
     """
     n_stages = int(mesh.shape[axis_name])
+    extra_axes = {a for dim in x_spec for a in (
+        dim if isinstance(dim, tuple) else (dim,)) if a is not None}
+    if axis_name in extra_axes:
+        raise ValueError(f"x_spec {x_spec} must not name the pipeline "
+                         f"axis {axis_name!r}")
 
     def run(stage_params, x):
         for leaf in jax.tree.leaves(stage_params):
@@ -59,10 +82,14 @@ def make_pipeline(stage_fn: Callable, mesh: Mesh, microbatches: int,
         perm = [(i, i + 1) for i in range(n_stages - 1)]
 
         def tick(carry, t):
-            recv, outputs = carry
+            recv, outputs, aux_acc = carry
             t_in = jnp.clip(t, 0, microbatches - 1)
             inp = jnp.where(idx == 0, x_mb[t_in], recv)
-            out = stage_fn(local, inp)
+            out, aux = stage_fn(local, inp)
+            # Stage `idx` holds real microbatch t - idx at tick t; other
+            # ticks are bubble garbage and must not pollute the aux sum.
+            valid = (t >= idx) & (t - idx < microbatches)
+            aux_acc = aux_acc + jnp.where(valid, aux.astype(jnp.float32), 0.0)
             recv_next = jax.lax.ppermute(out, axis_name, perm)
             # Stage n-1 finishes microbatch t-(n-1) at tick t.
             mb_i = t - (n_stages - 1)
@@ -70,20 +97,30 @@ def make_pipeline(stage_fn: Callable, mesh: Mesh, microbatches: int,
                 outputs, out, jnp.maximum(mb_i, 0), 0)
             outputs = jnp.where((idx == n_stages - 1) & (mb_i >= 0),
                                 upd, outputs)
-            return (recv_next, outputs), None
+            return (recv_next, outputs, aux_acc), None
 
         zero_act = jnp.zeros((mb, *x.shape[1:]), x.dtype)
         zero_out = jnp.zeros((microbatches, mb, *x.shape[1:]), x.dtype)
-        (_, outputs), _ = jax.lax.scan(
-            tick, (zero_act, zero_out), jnp.arange(ticks))
-        # Leading stage axis: only the last stage's slice is the result.
-        return outputs.reshape(b, *x.shape[1:])[None]
+        (_, outputs, aux_acc), _ = jax.lax.scan(
+            tick, (zero_act, zero_out, jnp.zeros((), jnp.float32)),
+            jnp.arange(ticks))
+        # aux differs across the extra manual axes (e.g. each seq shard
+        # routes its own tokens through MoE), but its out_spec only
+        # names the pipeline axis — reduce explicitly so the claimed
+        # replication is real (check_vma=False would not catch it).
+        for ax in sorted(extra_axes):
+            aux_acc = jax.lax.pmean(aux_acc, ax)
+        # Leading stage axis: only the last stage's slice is the result;
+        # aux contributions live on every stage.
+        return outputs.reshape(b, *x.shape[1:])[None], aux_acc[None]
 
-    f = shard_map(run, mesh=mesh, axis_names={axis_name},
-                  in_specs=(P(axis_name), P()), out_specs=P(axis_name),
+    f = shard_map(run, mesh=mesh, axis_names={axis_name} | extra_axes,
+                  in_specs=(P(axis_name), x_spec),
+                  out_specs=(P(axis_name, *x_spec), P(axis_name)),
                   check_vma=False)
 
     def apply(stage_params, x):
-        return f(stage_params, x)[-1]
+        ys, aux = f(stage_params, x)
+        return ys[-1], aux.sum() / microbatches
 
     return apply

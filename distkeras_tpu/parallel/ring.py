@@ -85,10 +85,16 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "seq",
     over ``axis_name``, heads/dim replicated.  Composes under an outer
     jit/pjit — tensor parallelism on the H axis can be layered by
     sharding the projection weights, not this function.
+
+    Do NOT call this wrapper inside another shard_map (a nested
+    shard_map does not transpose under AD): code that is already manual
+    over ``axis_name`` — the PP x SP pipeline — calls the raw
+    :func:`ring_attention` body directly instead
+    (transformer.apply_pipelined's ``seq_axis``).
     """
-    spec = P(batch_axis, axis_name, None, None)
     fn = functools.partial(ring_attention, axis_name=axis_name,
                            causal=causal, scale=scale)
+    spec = P(batch_axis, axis_name, None, None)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)
 

@@ -98,11 +98,6 @@ class LMTrainer(CheckpointingBase):
                 "all five, sized 1 when unused)")
         n_pipe = int(self.mesh.shape["pipeline"])
         n_seq = int(self.mesh.shape["seq"])
-        if n_pipe > 1 and n_seq > 1:
-            raise ValueError(
-                "pipeline and seq axes cannot both be >1 in LMTrainer: the "
-                "pipelined trunk is manual over 'pipeline' only and does "
-                "not thread ring attention through stages yet")
         if microbatches is not None and n_pipe <= 1:
             raise ValueError(
                 "microbatches only applies with a pipeline mesh axis > 1 "
@@ -110,8 +105,11 @@ class LMTrainer(CheckpointingBase):
         self.microbatches = microbatches or (2 * n_pipe if n_pipe > 1 else 1)
 
         if n_pipe > 1:
+            # PP x SP: the pipeline shard_map goes manual over
+            # {pipeline, seq} and runs the ring attention body per stage.
             apply_fn = lambda p, t: tfm.apply_pipelined(
-                p, t, cfg, self.mesh, microbatches=self.microbatches)
+                p, t, cfg, self.mesh, microbatches=self.microbatches,
+                seq_axis="seq" if n_seq > 1 else None)
             self._step_builder = lambda opt: tfm.make_train_step(
                 cfg, opt, apply_fn=apply_fn)
         elif n_seq > 1:

@@ -51,10 +51,13 @@ def test_lm_trainer_pp_ep(devices, rng):
     _loss_falls(t.history)
 
 
-def test_lm_trainer_rejects_pp_plus_sp(devices):
+def test_lm_trainer_pp_sp(devices, rng):
+    """PP x SP composed: pipelined trunk with the nested ring inside."""
     mesh = make_mesh(MeshSpec(data=2, pipeline=2, seq=2), devices=devices)
-    with pytest.raises(ValueError, match="pipeline and seq"):
-        dk.LMTrainer(CFG, mesh=mesh)
+    t = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16, num_epoch=8,
+                     mesh=mesh)
+    t.train(tokens(rng))
+    _loss_falls(t.history)
 
 
 def test_lm_trainer_validates_batch(devices, rng):
