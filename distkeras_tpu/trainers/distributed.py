@@ -75,6 +75,20 @@ class DistributedTrainer(Trainer):
         spec = (P(None, "data") if leading_window else P("data"))
         return NamedSharding(self.mesh, spec)
 
+    def _global_batch(self, arr, sharding):
+        """Host batch -> device batch across the (possibly multi-host) mesh.
+
+        Single-process: hand the numpy array straight to jit (it places
+        it under the in_sharding).  Multi-process SPMD (the Spark-
+        executor analogue, SURVEY.md §5): every process holds only its
+        Dataset.shard's rows, so the global array is assembled from the
+        process-local slab — each host's rows land on its own devices,
+        and the all-reduce over ``data`` does the rest.
+        """
+        if jax.process_count() == 1:
+            return arr
+        return jax.make_array_from_process_local_data(sharding, arr)
+
 
 class ADAG(DistributedTrainer):
     """Asynchronous Distributed Adaptive Gradients, synchronously.
@@ -102,23 +116,49 @@ class ADAG(DistributedTrainer):
         )
 
         # Global batch = num_workers * batch_size rows per microbatch;
-        # one jitted call consumes `window` microbatches.
+        # one jitted call consumes `window` microbatches.  Each process
+        # feeds its share of the global batch from its dataset shard.
         global_bs = self.batch_size * self.num_workers
+        pcount = jax.process_count()
+        if global_bs % pcount:
+            raise ValueError(
+                f"global batch {global_bs} (batch_size x num_workers) must "
+                f"divide by the process count ({pcount})")
+        feed_bs = global_bs // pcount
+        if pcount > 1:
+            # Every process must dispatch the same number of steps or
+            # the all-reduce deadlocks: check shard balance up front
+            # (the allgather is itself collective, but it sits before
+            # the loop, where every process still agrees).
+            from jax.experimental import multihost_utils
+
+            local_rounds = len(dataset) // (feed_bs * w)
+            all_rounds = [int(r) for r in
+                          multihost_utils.process_allgather(
+                              np.asarray(local_rounds, np.int64))]
+            if len(set(all_rounds)) != 1:
+                raise ValueError(
+                    f"unequal step counts across processes: {all_rounds} — "
+                    "every host's Dataset.shard must yield the same number "
+                    f"of window batches ({feed_bs * w} rows each); pad or "
+                    "trim the dataset to a multiple")
         losses, rnd = [], 0
         state, start = self._restore_or(state)
         for _ in range(self.num_epoch):
             for xs, ys in dataset.batches(
-                    global_bs, features_col=self.features_col,
+                    feed_bs, features_col=self.features_col,
                     label_col=self.label_col, window=w):
                 rnd += 1
                 if rnd <= start:
                     continue
+                xs = self._global_batch(xs, batch_sh)
+                ys = self._global_batch(ys, batch_sh)
                 state, loss = step(state, xs, ys)
                 losses.append(loss)
                 self._checkpoint(state, rnd)
         if start and not losses:
             return state
-        self._require_steps(losses, global_bs * w, len(dataset))
+        self._require_steps(losses, feed_bs * w, len(dataset))
         self._record(losses)
         self._checkpoint(state, rnd, final=True)
         return state

@@ -149,6 +149,13 @@ class ReplicaTrainer(DistributedTrainer):
         return self.communication_window
 
     def _fit(self, dataset: Dataset):
+        if jax.process_count() > 1:
+            raise ValueError(
+                f"{type(self).__name__} does not support the multi-process "
+                "runtime yet: its stacked per-replica state is placed with "
+                "plain device_put, which cannot span non-addressable "
+                "devices. Use ADAG/DynSGD for multi-host data parallelism, "
+                "or run this trainer single-process.")
         window = self._window(dataset)
         stacked = self._replica_states()
         center_tv = self.adapter.init_state().tv

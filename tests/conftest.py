@@ -25,6 +25,11 @@ if os.environ.get("DKT_TEST_PLATFORM", "cpu") == "cpu":
 import numpy as np
 import pytest
 
+# Re-exported so tests keep importing them from conftest; helpers.py is
+# the conftest-free home (subprocess tests import it without triggering
+# the env mutation above).
+from helpers import make_blobs, make_mlp  # noqa: F401
+
 
 @pytest.fixture(scope="session")
 def devices():
@@ -38,29 +43,9 @@ def rng():
     return np.random.default_rng(0)
 
 
-def make_blobs(n=512, dim=16, classes=4, seed=0):
-    """Linearly separable gaussian blobs — learnable in a few steps."""
-    rng = np.random.default_rng(seed)
-    centers = rng.normal(0, 4.0, (classes, dim))
-    labels = rng.integers(0, classes, n)
-    feats = centers[labels] + rng.normal(0, 0.5, (n, dim))
-    return feats.astype(np.float32), labels.astype(np.int64)
-
-
 @pytest.fixture()
 def blobs():
     return make_blobs()
-
-
-def make_mlp(dim=16, classes=4, hidden=32, seed=0):
-    import keras
-
-    keras.utils.set_random_seed(seed)
-    return keras.Sequential([
-        keras.Input((dim,)),
-        keras.layers.Dense(hidden, activation="relu"),
-        keras.layers.Dense(classes),
-    ])
 
 
 @pytest.fixture()

@@ -1,0 +1,25 @@
+"""Shared test fixtures' builders, importable without conftest's
+environment mutation (conftest appends XLA_FLAGS at import, which a
+subprocess that configured its own device count must not re-run)."""
+
+import numpy as np
+
+
+def make_blobs(n=512, dim=16, classes=4, seed=0):
+    """Linearly separable gaussian blobs — learnable in a few steps."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 4.0, (classes, dim))
+    labels = rng.integers(0, classes, n)
+    feats = centers[labels] + rng.normal(0, 0.5, (n, dim))
+    return feats.astype(np.float32), labels.astype(np.int64)
+
+
+def make_mlp(dim=16, classes=4, hidden=32, seed=0):
+    import keras
+
+    keras.utils.set_random_seed(seed)
+    return keras.Sequential([
+        keras.Input((dim,)),
+        keras.layers.Dense(hidden, activation="relu"),
+        keras.layers.Dense(classes),
+    ])

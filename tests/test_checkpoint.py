@@ -150,7 +150,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, {repo!r})
 sys.path.insert(0, {tests!r})
-from conftest import make_blobs, make_mlp
+from helpers import make_blobs, make_mlp
 import distkeras_tpu as dk
 
 x, y = make_blobs(n=128)

@@ -72,3 +72,104 @@ def test_standard_scale_transformer():
     out2 = t.transform(Dataset({"features": x2}))["features"]
     np.testing.assert_allclose(out2, out + 100.0 / np.maximum(x.std(0), 1e-12),
                                atol=1e-3)
+
+
+MULTIHOST_CHILD = """
+import os, sys
+os.environ["KERAS_BACKEND"] = "jax"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tests!r})
+from distkeras_tpu.deploy import init_from_env
+init_from_env()  # joins the 2-process runtime from the Job env vars
+
+import numpy as np
+import distkeras_tpu as dk
+from helpers import make_blobs, make_mlp
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, jax.devices()
+assert jax.local_device_count() == 4
+
+x, y = make_blobs(n=256)
+host = int(os.environ["DKT_HOST_ID"])
+ds = dk.Dataset.from_arrays(x, y).shard(host, 2)
+assert len(ds) == 128
+
+t = dk.ADAG(make_mlp(), loss="sparse_categorical_crossentropy",
+            worker_optimizer="sgd", learning_rate=0.05, batch_size=8,
+            communication_window=2, num_workers=8, num_epoch=1)
+trained = t.train(ds)
+assert len(t.history) == 2, t.history
+if host == 0:
+    np.savez({out!r}, *[np.asarray(w) for w in trained.get_weights()],
+             losses=np.asarray(t.history))
+print("HOST", host, "OK", flush=True)
+"""
+
+
+def test_two_process_adag_matches_single_process(tmp_path, devices):
+    """The multi-host runtime for real: two OS processes join via
+    jax.distributed (deploy.Job env contract -> init_from_env), form one
+    8-device global mesh, and train ADAG on Dataset.shard-ed data.  The
+    strided shard makes every global microbatch the same row *set* as
+    the single-process run, and mean-gradients are permutation
+    invariant, so the trained weights must match."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests = os.path.join(repo, "tests")
+    out = str(tmp_path / "host0.npz")
+    with socket.socket() as s:  # free port for the coordinator
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    job = Job(script="<inline>", num_hosts=2, coordinator=f"localhost:{port}")
+
+    procs = []
+    for h in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env.update(job.env_for(h))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             MULTIHOST_CHILD.format(repo=repo, tests=tests, out=out)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    fail = []
+    for h, p in enumerate(procs):
+        try:
+            stdout, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        if p.returncode != 0:
+            fail.append(f"host {h} rc={p.returncode}\n"
+                        f"{stdout.decode(errors='replace')[-3000:]}")
+    assert not fail, "\n---\n".join(fail)
+
+    # Single-process reference: same data, same global batch math.
+    import distkeras_tpu as dk
+    from helpers import make_blobs, make_mlp
+
+    x, y = make_blobs(n=256)
+    ds = dk.Dataset.from_arrays(x, y)
+    t = dk.ADAG(make_mlp(), loss="sparse_categorical_crossentropy",
+                worker_optimizer="sgd", learning_rate=0.05, batch_size=8,
+                communication_window=2, num_workers=8, num_epoch=1)
+    ref = t.train(ds)
+
+    got = np.load(out)
+    ref_w = [np.asarray(w) for w in ref.get_weights()]
+    got_w = [got[k] for k in got.files if k != "losses"]
+    assert len(got_w) == len(ref_w)
+    for a, b in zip(got_w, ref_w):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got["losses"], np.asarray(t.history),
+                               rtol=1e-4)
