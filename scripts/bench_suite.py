@@ -6,8 +6,15 @@ with donated state, device-resident synthetic data, warmup, then a
 timed run whose barrier is a device->host float() through the step
 dependency chain (the axon relay's block_until_ready returns early).
 
+Every line reports ``mfu``: flops from the compiled program's own
+cost_analysis (not an analytic estimate) against the chip's bf16 peak.
+``cifar_cnn_hostdata`` is the end-to-end exception to device-resident
+data: it feeds host uint8 rows through the native gather/normalize +
+Prefetcher + host->device transfer each step.
+
 Usage: python scripts/bench_suite.py [config ...]
-Configs: mnist_mlp cifar_cnn higgs_mlp imdb_lstm resnet50 transformer
+Configs: mnist_mlp cifar_cnn cifar_cnn_hostdata higgs_mlp imdb_lstm
+         resnet50 transformer transformer_long transformer_long_xla
 """
 
 import json
@@ -18,6 +25,30 @@ import time
 os.environ.setdefault("KERAS_BACKEND", "jax")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Peak bf16 TFLOP/s per chip, keyed on jax device_kind.  MFU is reported
+# only for known accelerators (it is meaningless on the CPU fallback).
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+}
+
+
+def peak_flops():
+    import jax
+
+    return PEAK_FLOPS.get(jax.devices()[0].device_kind)
+
+
+def compiled_flops(jitted, *args) -> float:
+    """FLOPs of one call, from the compiled executable's cost model."""
+    try:
+        return float(jitted.lower(*args).compile()
+                     .cost_analysis().get("flops", 0.0))
+    except Exception:
+        return 0.0
 
 
 def measure_keras(build, shape, classes, batch, iters, warmup=10,
@@ -53,6 +84,7 @@ def measure_keras(build, shape, classes, batch, iters, warmup=10,
     y = jax.device_put(rng.integers(0, max(classes, 2), lead)
                        .astype(np.float32 if classes == 1 else np.int64))
 
+    step_flops = compiled_flops(step, state, x, y) / scan_steps
     for _ in range(warmup):
         state, loss = step(state, x, y)
     float(np.asarray(loss).ravel()[-1])  # device->host: the true barrier
@@ -62,7 +94,7 @@ def measure_keras(build, shape, classes, batch, iters, warmup=10,
     float(np.asarray(loss).ravel()[-1])
     dt = time.perf_counter() - t0
     steps = iters * scan_steps
-    return batch * steps / dt, dt / steps
+    return batch * steps / dt, dt / steps, step_flops
 
 
 def bench_mnist_mlp():
@@ -111,44 +143,147 @@ def bench_resnet50():
                          batch=128, iters=50, warmup=5)
 
 
-def bench_transformer():
-    """Flagship LM: tokens/sec with the Pallas flash-attention path."""
+def _measure_lm(cfg, batch, seq, iters, warmup=5, attention_fn=None):
     import jax
     import numpy as np
     import optax
     from distkeras_tpu.models import transformer as tfm
 
-    cfg = tfm.TransformerConfig(
-        vocab_size=32768, d_model=512, n_heads=4, n_layers=4, d_ff=2048,
-        max_len=1025, dtype="bfloat16")
     params = tfm.init_params(jax.random.key(0), cfg)
     opt = optax.adamw(3e-4)
-    step = jax.jit(tfm.make_train_step(cfg, opt), donate_argnums=0)
+    step = jax.jit(tfm.make_train_step(cfg, opt, attention_fn=attention_fn),
+                   donate_argnums=0)
     carry = (params, opt.init(params))
 
-    batch, seq = 8, 1024
     rng = np.random.default_rng(0)
     tokens = jax.device_put(
         rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32))
-    for _ in range(5):
+    step_flops = compiled_flops(step, carry, tokens)
+    for _ in range(warmup):
         carry, loss = step(carry, tokens)
     float(loss)
-    iters = 50
     t0 = time.perf_counter()
     for _ in range(iters):
         carry, loss = step(carry, tokens)
     float(loss)
     dt = time.perf_counter() - t0
-    return batch * seq * iters / dt, dt / iters
+    return batch * seq * iters / dt, dt / iters, step_flops
+
+
+def bench_transformer():
+    """Flagship LM, short-sequence config (head-dominated at seq 1024)."""
+    from distkeras_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=32768, d_model=512, n_heads=4, n_layers=4, d_ff=2048,
+        max_len=1025, dtype="bfloat16")
+    return _measure_lm(cfg, batch=8, seq=1024, iters=50)
+
+
+def _long_cfg():
+    from distkeras_tpu.models import transformer as tfm
+
+    # Attention-dominated: at seq 4096 / d_model 1024 the S^2 term is
+    # ~2x the matmul term per layer, and the 32k-vocab head is <10% of
+    # the step.  remat keeps activations in budget at this depth.
+    return tfm.TransformerConfig(
+        vocab_size=32768, d_model=1024, n_heads=8, n_layers=8, d_ff=4096,
+        max_len=4097, dtype="bfloat16", remat=True)
+
+
+def bench_transformer_long():
+    """Long-context LM on the Pallas flash-attention path."""
+    return _measure_lm(_long_cfg(), batch=8, seq=4096, iters=20)
+
+
+def bench_transformer_long_noremat():
+    """Same config without per-block rematerialization (fits at this
+    size; remat trades ~13% step time for O(1)-block activations)."""
+    import dataclasses
+
+    return _measure_lm(dataclasses.replace(_long_cfg(), remat=False),
+                       batch=8, seq=4096, iters=20)
+
+
+def bench_transformer_long_xla():
+    """Same config on the blockwise-jnp XLA fallback (no Pallas).
+
+    batch 4: the fallback's backward (re-run forward under jax.vjp)
+    fails to compile at batch 8 on a 16 GB chip — itself part of the
+    comparison; tokens/sec is batch-normalized.
+    """
+    from distkeras_tpu.ops.attention import blockwise_attention
+
+    return _measure_lm(
+        _long_cfg(), batch=4, seq=4096, iters=20,
+        attention_fn=lambda q, k, v: blockwise_attention(q, k, v, causal=True))
+
+
+def bench_cifar_cnn_hostdata():
+    """End-to-end input pipeline: host uint8 rows -> native fused
+    gather+normalize -> Prefetcher -> host->device transfer -> step.
+
+    The honest counterpart of ``cifar_cnn`` (device-resident synthetic
+    data): same model and batch, but every batch is produced the way
+    Dataset.batches produces it in training (SURVEY.md §7.3 #4).
+    """
+    import jax
+    import numpy as np
+    import keras
+    from distkeras_tpu import native
+    from distkeras_tpu.data.prefetch import Prefetcher
+    from distkeras_tpu.models.adapter import ModelAdapter
+    from distkeras_tpu.models.zoo import cifar_cnn
+
+    keras.mixed_precision.set_global_policy("mixed_bfloat16")
+    batch, iters, warmup = 1024, 120, 10
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (50_000, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, 50_000).astype(np.int64)
+
+    adapter = ModelAdapter(cifar_cnn(seed=0),
+                           loss="sparse_categorical_crossentropy",
+                           optimizer="sgd", learning_rate=0.01)
+    state = adapter.init_state()
+    step = jax.jit(adapter.make_train_step(), donate_argnums=0)
+
+    def batches(n):
+        order = rng.permutation(len(images))
+        i = 0
+        for _ in range(n):
+            if i + batch > len(order):
+                order, i = rng.permutation(len(images)), 0
+            idx = order[i:i + batch]
+            i += batch
+            x = native.gather_normalize_u8(images, idx, scale=1 / 255.0)
+            y = native.gather_rows(labels, idx)
+            yield x, y
+
+    x0, y0 = next(iter(batches(1)))
+    step_flops = compiled_flops(step, state, x0, y0)
+    for x, y in Prefetcher(batches(warmup), depth=2):
+        state, loss = step(state, x, y)
+    float(np.asarray(loss).ravel()[-1])
+    t0 = time.perf_counter()
+    for x, y in Prefetcher(batches(iters), depth=2):
+        state, loss = step(state, x, y)
+    float(np.asarray(loss).ravel()[-1])
+    dt = time.perf_counter() - t0
+    return batch * iters / dt, dt / iters, step_flops
 
 
 BENCHES = {
     "mnist_mlp": (bench_mnist_mlp, "samples/sec/chip"),
     "cifar_cnn": (bench_cifar_cnn, "samples/sec/chip"),
+    "cifar_cnn_hostdata": (bench_cifar_cnn_hostdata, "samples/sec/chip"),
     "higgs_mlp": (bench_higgs_mlp, "samples/sec/chip"),
     "imdb_lstm": (bench_imdb_lstm, "samples/sec/chip"),
     "resnet50": (bench_resnet50, "samples/sec/chip"),
     "transformer": (bench_transformer, "tokens/sec/chip"),
+    "transformer_long": (bench_transformer_long, "tokens/sec/chip"),
+    "transformer_long_noremat": (bench_transformer_long_noremat,
+                                 "tokens/sec/chip"),
+    "transformer_long_xla": (bench_transformer_long_xla, "tokens/sec/chip"),
 }
 
 
@@ -161,17 +296,22 @@ def main(names):
                  f"choose from {sorted(BENCHES)}")
     print(f"# backend={jax.default_backend()} device={jax.devices()[0]}",
           file=sys.stderr)
+    peak = peak_flops()
     for name in names or BENCHES:
         fn, unit = BENCHES[name]
         try:
-            rate, step_s = fn()
+            rate, step_s, step_flops = fn()
         except Exception as e:  # keep the suite going; record the failure
             print(json.dumps({"metric": name, "error": repr(e)[:200]}))
             continue
-        print(json.dumps({
+        line = {
             "metric": name, "value": round(rate, 1), "unit": unit,
             "step_ms": round(step_s * 1e3, 2),
-        }))
+            "gflops_per_step": round(step_flops / 1e9, 1),
+        }
+        if peak and step_flops:
+            line["mfu"] = round(step_flops / step_s / peak, 4)
+        print(json.dumps(line))
 
 
 if __name__ == "__main__":
