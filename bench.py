@@ -31,15 +31,19 @@ SPARK8_CPU_PROXY_SPS = 2137.0  # samples/sec; provenance in module docstring
 
 
 def main():
-    from bench_suite import bench_cifar_cnn
+    from bench_suite import bench_cifar_cnn, peak_flops
 
-    sps, _step_s = bench_cifar_cnn()
-    print(json.dumps({
+    sps, step_s, step_flops = bench_cifar_cnn()[:3]
+    line = {
         "metric": "cifar_cnn_train_throughput",
         "value": round(sps, 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps / SPARK8_CPU_PROXY_SPS, 2),
-    }))
+    }
+    peak = peak_flops()
+    if peak and step_flops:
+        line["mfu"] = round(step_flops / step_s / peak, 4)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

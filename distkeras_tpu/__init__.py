@@ -59,7 +59,8 @@ from distkeras_tpu.utils.serialization import (
 )
 from distkeras_tpu.models.adapter import ModelAdapter, TrainState
 from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
-from distkeras_tpu.parallel.sharding import ShardingPlan
+from distkeras_tpu.parallel.sharding import (ShardingPlan, dp_plan,
+                                              fsdp_plan, tp_plan)
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.data.transformers import (
     Transformer,
@@ -95,6 +96,9 @@ __all__ = [
     "MeshSpec",
     "make_mesh",
     "ShardingPlan",
+    "dp_plan",
+    "fsdp_plan",
+    "tp_plan",
     "Dataset",
     "Transformer",
     "OneHotTransformer",

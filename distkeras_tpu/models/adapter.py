@@ -59,7 +59,8 @@ class ModelAdapter:
 
     def __init__(self, keras_model, loss="categorical_crossentropy",
                  optimizer="sgd", learning_rate: float | None = None,
-                 metrics: Sequence[str] = ()):
+                 metrics: Sequence[str] = (),
+                 preprocess: Callable | None = None):
         import keras  # deferred so KERAS_BACKEND is already forced
 
         if keras.backend.backend() != "jax":  # pragma: no cover
@@ -75,6 +76,15 @@ class ModelAdapter:
         self.loss_fn = resolve_loss(loss)
         self.optimizer = resolve_optimizer(optimizer, learning_rate)
         self.metrics = tuple(metrics)
+        # On-device input transform, traced into every step/predict
+        # program (e.g. ``lambda x: x.astype("float32") / 255``).  Lets
+        # the host ship the smallest wire dtype — uint8 pixels are 4x
+        # fewer h2d bytes than the normalized f32 — and XLA fuses the
+        # expansion into the first consumer.  The reference normalizes
+        # host-side in Spark transformers (reference:
+        # distkeras/transformers.py MinMaxTransformer), which quadruples
+        # its wire traffic; on TPU the link is the scarce resource.
+        self.preprocess = preprocess
         # Variable paths, for sharding rules keyed on names.
         self.tv_paths = [v.path for v in keras_model.trainable_variables]
         self.ntv_paths = [v.path for v in keras_model.non_trainable_variables]
@@ -104,7 +114,23 @@ class ModelAdapter:
 
         Mirrors the reference trainers returning a fresh deserialized
         model to the driver (distkeras/trainers.py Trainer.train).
+
+        When the adapter has a ``preprocess`` hook the exported Keras
+        model does NOT contain it (it is a jax transform, not a layer):
+        callers must apply the same transform to inputs — or predict
+        through :meth:`make_predict_fn` / ModelPredictor built from
+        this adapter, which do.  A warning marks the hazard.
         """
+        if self.preprocess is not None:
+            import warnings
+
+            warnings.warn(
+                "export_model: the trained weights expect inputs "
+                "transformed by this adapter's preprocess hook, but the "
+                "exported Keras model does not embed it. Apply the same "
+                "transform before model.predict, or run inference "
+                "through the adapter's predict fn.", UserWarning,
+                stacklevel=2)
         self.write_back(state)
         return deserialize_keras_model(serialize_keras_model(self.model))
 
@@ -112,6 +138,8 @@ class ModelAdapter:
 
     def stateless_apply(self, tv, ntv, x, training: bool = False):
         """Pure forward pass: returns (outputs, updated_ntv)."""
+        if self.preprocess is not None:
+            x = self.preprocess(x)
         out, ntv2 = self.model.stateless_call(tv, ntv, x, training=training)
         return out, ntv2
 
@@ -125,9 +153,11 @@ class ModelAdapter:
         transformer does it per block (models/transformer.py
         TransformerConfig.remat).
         """
-        model, loss_fn = self.model, self.loss_fn
+        model, loss_fn, pre = self.model, self.loss_fn, self.preprocess
 
         def compute_loss(tv, ntv, x, y):
+            if pre is not None:
+                x = pre(x)
             preds, ntv2 = model.stateless_call(tv, ntv, x, training=True)
             return loss_fn(y, preds), ntv2
 
@@ -209,11 +239,47 @@ class ModelAdapter:
 
         return multi
 
+    def make_indexed_train_step(self, n_steps: int) -> Callable:
+        """Build ``step(state, X, Y, idx) -> (state', losses)`` for
+        device-resident datasets.
+
+        ``X``/``Y`` are the *whole* dataset staged in HBM (ship them
+        once, in their wire dtype — uint8 pixels cost 4x less than f32
+        and ``preprocess`` expands on device); ``idx: [n_steps, B]``
+        selects each scanned step's minibatch with an on-device gather.
+        Per window only the tiny index block crosses the host->device
+        link, so epoch shuffling costs ~nothing no matter how slow the
+        link is.  This inverts the reference's data plane — Spark ships
+        every batch to the worker as pickled rows (reference:
+        distkeras/workers.py iterating mapPartitions) — into the
+        TPU-native form: data parked in HBM, the program comes to it.
+        """
+        train_step = self.make_train_step()
+
+        def window(state: TrainState, X, Y, idx):
+            if idx.shape[0] != n_steps:
+                raise ValueError(
+                    f"index block carries {idx.shape[0]} steps but this "
+                    f"program was built for n_steps={n_steps}; the step "
+                    "counter and checkpoint-round bookkeeping depend on "
+                    "them agreeing")
+
+            def body(st, ix):
+                st, loss = train_step(
+                    st, jnp.take(X, ix, axis=0), jnp.take(Y, ix, axis=0))
+                return st, loss
+
+            return jax.lax.scan(body, state, idx)
+
+        return window
+
     def make_predict_fn(self) -> Callable:
         """Pure ``f(tv, ntv, x) -> outputs`` (inference mode)."""
-        model = self.model
+        model, pre = self.model, self.preprocess
 
         def predict(tv, ntv, x):
+            if pre is not None:
+                x = pre(x)
             out, _ = model.stateless_call(tv, ntv, x, training=False)
             return out
 

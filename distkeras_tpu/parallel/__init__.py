@@ -1,4 +1,6 @@
 from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh, local_device_count
-from distkeras_tpu.parallel.sharding import ShardingPlan
+from distkeras_tpu.parallel.sharding import (ShardingPlan, dp_plan,
+                                              fsdp_plan, tp_plan)
 
-__all__ = ["MeshSpec", "make_mesh", "local_device_count", "ShardingPlan"]
+__all__ = ["MeshSpec", "make_mesh", "local_device_count", "ShardingPlan",
+           "dp_plan", "fsdp_plan", "tp_plan"]

@@ -19,29 +19,78 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _augment_fsdp(spec: P, shape, axis_size: int, axis: str) -> P:
+    """Add ``axis`` to the largest still-unsharded dimension of ``shape``
+    that divides evenly; leave small/indivisible params replicated.
+
+    This is the ZeRO-3 placement rule expressed as sharding: parameters
+    (and, via :meth:`ShardingPlan.state_shardings`, their optimizer-state
+    mirrors) live scattered over the data axis, and GSPMD materializes
+    them with an all-gather at use and a reduce-scatter on the gradient
+    — the XLA-native form of FSDP, no hand-written collectives.
+    """
+    if axis_size <= 1 or shape is None:
+        return spec
+    spec_t = tuple(spec) + (None,) * (len(shape) - len(spec))
+    used = set()
+    for s in spec_t:
+        for a in (s if isinstance(s, tuple) else (s,)):
+            if a is not None:
+                used.add(a)
+    if axis in used:
+        return spec
+    best, best_size = None, 0
+    for i, (dim, s) in enumerate(zip(shape, spec_t)):
+        if s is None and dim % axis_size == 0 and dim > best_size:
+            best, best_size = i, dim
+    if best is None:
+        return spec
+    new = list(spec_t)
+    new[best] = axis
+    while new and new[-1] is None:
+        new.pop()
+    return P(*new)
+
+
 class ShardingPlan:
     """Ordered (regex, PartitionSpec) rules; first match wins.
 
     Unmatched variables are replicated.  Rules match against the Keras
     variable path (e.g. ``"dense_1/kernel"``).
+
+    ``fsdp_axis`` layers fully-sharded data parallelism on top of the
+    rule-derived spec: each parameter's largest still-free dimension is
+    sharded over that mesh axis (see :func:`_augment_fsdp`).  Rules and
+    FSDP compose — a Megatron-TP rule can claim one dimension and FSDP
+    takes another.
     """
 
     def __init__(self, rules: Sequence[tuple[str, P]] = (),
-                 batch_spec: P = P("data")):
+                 batch_spec: P = P("data"), fsdp_axis: str | None = None):
         self.rules = [(re.compile(pat), spec) for pat, spec in rules]
         self.batch_spec = batch_spec
+        self.fsdp_axis = fsdp_axis
 
-    def spec_for(self, path: str, ndim: int | None = None) -> P:
-        for pat, spec in self.rules:
+    def spec_for(self, path: str, shape=None, mesh: Mesh | None = None) -> P:
+        spec = P()
+        for pat, rule_spec in self.rules:
             if pat.search(path):
-                return spec
-        return P()
+                spec = rule_spec
+                break
+        if self.fsdp_axis is not None and mesh is not None:
+            spec = _augment_fsdp(spec, shape,
+                                 int(mesh.shape[self.fsdp_axis]),
+                                 self.fsdp_axis)
+        return spec
 
     # ------------------------------------------------------------- builders
 
-    def param_shardings(self, mesh: Mesh, paths: Sequence[str]):
+    def param_shardings(self, mesh: Mesh, paths: Sequence[str],
+                        shapes: Sequence | None = None):
         """NamedShardings for a list-of-arrays pytree ordered like ``paths``."""
-        return [NamedSharding(mesh, self.spec_for(p)) for p in paths]
+        shapes = shapes if shapes is not None else [None] * len(paths)
+        return [NamedSharding(mesh, self.spec_for(p, shape=s, mesh=mesh))
+                for p, s in zip(paths, shapes)]
 
     def state_shardings(self, mesh: Mesh, state, tv_paths: Sequence[str]):
         """Shardings pytree matching a :class:`TrainState`.
@@ -51,7 +100,8 @@ class ShardingPlan:
         array leaves mirror parameter shapes (mu/nu in adam etc.) or are
         scalars; we map any leaf whose shape matches a param positionally.
         """
-        tv_sh = self.param_shardings(mesh, tv_paths)
+        tv_sh = self.param_shardings(
+            mesh, tv_paths, [tuple(v.shape) for v in state.tv])
         rep = NamedSharding(mesh, P())
 
         # Optax states embed subtrees mirroring the param pytree (our tv
@@ -95,9 +145,11 @@ class ShardingPlan:
         leading separator stripped), so the same regex rule language
         covers Keras variable paths and functional-model dicts.
         """
-        def leaf(path, _):
+        def leaf(path, x):
             name = jax.tree_util.keystr(path, simple=True, separator="/")
-            return NamedSharding(mesh, self.spec_for(name))
+            shape = tuple(x.shape) if hasattr(x, "shape") else None
+            return NamedSharding(mesh, self.spec_for(name, shape=shape,
+                                                     mesh=mesh))
 
         return jax.tree_util.tree_map_with_path(leaf, pytree)
 
@@ -105,6 +157,22 @@ class ShardingPlan:
 def dp_plan() -> ShardingPlan:
     """Pure data parallelism: replicate weights, split batch on ``data``."""
     return ShardingPlan(rules=(), batch_spec=P("data"))
+
+
+def fsdp_plan(extra_rules: Sequence[tuple[str, P]] = (),
+              axis: str = "data") -> ShardingPlan:
+    """Fully-sharded data parallelism (ZeRO-3): weights and optimizer
+    state scattered over the ``data`` axis, gathered on use.
+
+    Same batch semantics as :func:`dp_plan`; per-device parameter and
+    optimizer-state memory drops by ~the data-axis size, at the cost of
+    an all-gather per use and a reduce-scatter per gradient (both ride
+    the ICI).  The reference cannot express this at all — every worker
+    and the parameter server hold full weight copies
+    (distkeras/parameter_servers.py center variable).
+    """
+    return ShardingPlan(rules=extra_rules, batch_spec=P("data"),
+                        fsdp_axis=axis)
 
 
 def tp_plan(extra_rules: Sequence[tuple[str, P]] = ()) -> ShardingPlan:

@@ -115,10 +115,11 @@ class Trainer(CheckpointingBase):
                  features_col: str = "features", label_col: str = "label",
                  shuffle: bool = False, seed: int | None = None,
                  checkpoint_dir: str | None = None, checkpoint_every: int = 0,
-                 max_checkpoints: int = 3, resume: bool = False):
+                 max_checkpoints: int = 3, resume: bool = False,
+                 preprocess=None):
         self.adapter = ModelAdapter(
             keras_model, loss=loss, optimizer=worker_optimizer,
-            learning_rate=learning_rate)
+            learning_rate=learning_rate, preprocess=preprocess)
         self.batch_size = batch_size
         self.num_epoch = num_epoch
         self.features_col = features_col
@@ -205,16 +206,26 @@ class SingleTrainer(Trainer):
     ``steps_per_call`` steps; a round = one call; like the windowed
     distributed trainers, each epoch drops its tail remainder of up to
     ``steps_per_call * batch_size - 1`` rows (shapes must stay static).
+
+    ``device_data=True`` stages the dataset columns in device memory
+    once and feeds each round an int32 index block instead of batch
+    payloads (adapter.make_indexed_train_step): after the one-time
+    staging transfer, only ~4 bytes/sample/epoch cross the
+    host->device link.  The right mode whenever the dataset fits in
+    HBM (CIFAR-scale and far beyond) — the host link is the input
+    pipeline's narrow point, especially on remote-attached devices.
+    Identical math and data order to the streaming path.
     """
 
     def __init__(self, keras_model, loss="categorical_crossentropy", *,
-                 steps_per_call: int = 1, **kw):
+                 steps_per_call: int = 1, device_data: bool = False, **kw):
         # steps_per_call is keyword-only so the parent's positional
         # contract (keras_model, loss, ...) is preserved.
         super().__init__(keras_model, loss=loss, **kw)
         if steps_per_call < 1:
             raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
         self.steps_per_call = steps_per_call
+        self.device_data = device_data
 
     def _fit(self, dataset: Dataset):
         spc = self.steps_per_call
@@ -227,7 +238,22 @@ class SingleTrainer(Trainer):
                 f"{start * spc}: the checkpoint was written under a "
                 "different steps_per_call — resume with the original "
                 "value (data skipping is counted in rounds)")
-        if spc == 1:
+        if self.device_data:
+            step = jax.jit(self.adapter.make_indexed_train_step(spc),
+                           donate_argnums=0)
+            X = jax.device_put(dataset[self.features_col])
+            Y = jax.device_put(dataset[self.label_col])
+            n = len(dataset)
+            rows = self.batch_size * spc
+
+            def stream():
+                for _ in range(self.num_epoch):
+                    for i in range(0, n - (n % rows), rows):
+                        yield (X, Y,
+                               np.arange(i, i + rows, dtype=np.int32)
+                               .reshape(spc, self.batch_size))
+            stream = stream()
+        elif spc == 1:
             step = jax.jit(self.adapter.make_train_step(), donate_argnums=0)
             stream = self._epoch_stream(dataset)
         else:
@@ -235,10 +261,10 @@ class SingleTrainer(Trainer):
                            donate_argnums=0)
             stream = self._epoch_stream(dataset, window=spc)
         losses, rnd = [], start
-        for rnd, (x, y) in enumerate(stream, 1):
+        for rnd, args in enumerate(stream, 1):
             if rnd <= start:
                 continue
-            state, loss = step(state, x, y)
+            state, loss = step(state, *args)
             # Device array (scalar, or [spc] when scanning); no sync here.
             losses.append(loss)
             self._checkpoint(state, rnd)

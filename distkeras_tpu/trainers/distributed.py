@@ -29,7 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
-from distkeras_tpu.parallel.sharding import ShardingPlan, dp_plan
+from distkeras_tpu.parallel.sharding import ShardingPlan, dp_plan, fsdp_plan
 from distkeras_tpu.trainers.base import Trainer
 
 
@@ -40,18 +40,24 @@ class DistributedTrainer(Trainer):
     = size of the mesh's ``data`` axis.  Defaults to all visible
     devices.  A :class:`ShardingPlan` may add tensor parallelism on the
     ``model`` axis on top (something the reference cannot do at all).
+    ``fsdp=True`` is shorthand for ``plan=fsdp_plan()``: weights and
+    optimizer state scatter over the data axis (ZeRO-3) instead of
+    replicating — identical training math, ~num_workers x less
+    parameter memory per device.
     """
 
     def __init__(self, keras_model, loss="categorical_crossentropy",
                  worker_optimizer="sgd", learning_rate: float | None = None,
                  batch_size: int = 32, num_epoch: int = 1,
                  num_workers: int | None = None, mesh=None,
-                 plan: ShardingPlan | None = None, **kw):
+                 plan: ShardingPlan | None = None, fsdp: bool = False, **kw):
         super().__init__(keras_model, loss=loss,
                          worker_optimizer=worker_optimizer,
                          learning_rate=learning_rate, batch_size=batch_size,
                          num_epoch=num_epoch, **kw)
-        self.plan = plan or dp_plan()
+        if fsdp and plan is not None:
+            raise ValueError("pass either plan= or fsdp=True, not both")
+        self.plan = plan or (fsdp_plan() if fsdp else dp_plan())
         if mesh is not None:
             self.mesh = mesh
         else:

@@ -82,6 +82,18 @@ class ReplicaTrainer(DistributedTrainer):
 
     sync_fn: SyncFn = staticmethod(_no_sync)
 
+    def __init__(self, keras_model, loss="categorical_crossentropy", **kw):
+        plan = kw.get("plan")
+        if kw.pop("fsdp", False) or (
+                plan is not None and getattr(plan, "fsdp_axis", None)):
+            raise ValueError(
+                f"{type(self).__name__} cannot use FSDP: each replica "
+                "holds intentionally divergent full weights (that is the "
+                "algorithm), so there is no single parameter set to "
+                "scatter. Use ADAG/DynSGD with fsdp=True for "
+                "memory-sharded data parallelism.")
+        super().__init__(keras_model, loss=loss, **kw)
+
     # ------------------------------------------------------------ state
 
     def _stack_state(self, states: list[TrainState]) -> TrainState:

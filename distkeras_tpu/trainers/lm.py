@@ -57,7 +57,7 @@ class LMTrainer(CheckpointingBase):
     def __init__(self, cfg: tfm.TransformerConfig, optimizer="adamw",
                  learning_rate: float = 3e-4, batch_size: int = 8,
                  num_epoch: int = 1, mesh=None, rules=None,
-                 microbatches: int | None = None,
+                 microbatches: int | None = None, fsdp: bool = False,
                  tokens_col: str = "tokens", seed: int = 0,
                  shuffle: bool = False,
                  checkpoint_dir: str | None = None, checkpoint_every: int = 0,
@@ -77,8 +77,10 @@ class LMTrainer(CheckpointingBase):
         self.batch_size = batch_size
         self.num_epoch = num_epoch
         self.mesh = mesh if mesh is not None else make_mesh()
+        self.fsdp = fsdp
         self.plan = ShardingPlan(
-            rules=tfm.tp_rules() if rules is None else rules)
+            rules=tfm.tp_rules() if rules is None else rules,
+            fsdp_axis="data" if fsdp else None)
         self.tokens_col = tokens_col
         self.seed = seed
         self.shuffle = shuffle
@@ -98,6 +100,13 @@ class LMTrainer(CheckpointingBase):
                 "all five, sized 1 when unused)")
         n_pipe = int(self.mesh.shape["pipeline"])
         n_seq = int(self.mesh.shape["seq"])
+        if fsdp and n_pipe > 1:
+            raise ValueError(
+                "fsdp=True cannot compose with a pipeline axis > 1: the "
+                "pipelined trunk runs in a manual shard_map over "
+                "{pipeline, seq} whose in_specs take the stage-stacked "
+                "parameters whole. Shard memory across pipeline stages "
+                "instead (that is what PP does), or drop the pipeline axis.")
         if microbatches is not None and n_pipe <= 1:
             raise ValueError(
                 "microbatches only applies with a pipeline mesh axis > 1 "
@@ -126,10 +135,11 @@ class LMTrainer(CheckpointingBase):
         return jax.device_put(
             params, self.plan.tree_shardings(self.mesh, params))
 
-    def _place_opt_state(self, opt_state, params):
-        """Commit optimizer state to the mesh: subtrees mirroring the
-        params structure (adam mu/nu, momentum buffers) take the params'
-        shardings; everything else (step counters) is replicated."""
+    def _state_shardings(self, params, opt_state):
+        """Sharding trees for (params, opt_state): subtrees of the
+        optimizer state mirroring the params structure (adam mu/nu,
+        momentum buffers) take the params' shardings; everything else
+        (step counters) is replicated."""
         psh = self.plan.tree_shardings(self.mesh, params)
         rep = NamedSharding(self.mesh, P())
         p_def = jax.tree.structure(params)
@@ -137,9 +147,9 @@ class LMTrainer(CheckpointingBase):
         def params_like(x):
             return jax.tree.structure(x) == p_def
 
-        return jax.tree.map(
-            lambda x: jax.device_put(x, psh if params_like(x) else rep),
-            opt_state, is_leaf=params_like)
+        osh = jax.tree.map(lambda x: psh if params_like(x) else rep,
+                           opt_state, is_leaf=params_like)
+        return psh, osh
 
     def train(self, dataset: Dataset | np.ndarray, params=None):
         """Train over the token rows; returns the trained params pytree."""
@@ -186,11 +196,23 @@ class LMTrainer(CheckpointingBase):
             # sharding literally, so adam's scalar count would come back
             # pinned to one device while params span the mesh — an
             # invalid mix.
-            opt_state = self._place_opt_state(
-                self.optimizer.init(params), params)
-            step = jax.jit(self._step_builder(self.optimizer),
-                           donate_argnums=0)
+            opt_state = self.optimizer.init(params)
+            psh, osh = self._state_shardings(params, opt_state)
+            opt_state = jax.device_put(opt_state, osh)
             tok_sh = NamedSharding(self.mesh, P("data", None))
+            jit_kw = {}
+            if int(self.mesh.shape["pipeline"]) == 1:
+                # Pin the carry layout so XLA keeps the plan's placement
+                # (scattered params under FSDP, Megatron splits under TP)
+                # across steps instead of resharding at its own whim.
+                # The pipelined trunk is exempt: its manual shard_map
+                # governs placement internally.
+                jit_kw = dict(
+                    in_shardings=((psh, osh), tok_sh),
+                    out_shardings=((psh, osh),
+                                   NamedSharding(self.mesh, P())))
+            step = jax.jit(self._step_builder(self.optimizer),
+                           donate_argnums=0, **jit_kw)
 
             carry, losses = (params, opt_state), []
             n_rows = len(tokens) - (len(tokens) % global_bs)

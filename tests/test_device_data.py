@@ -1,0 +1,140 @@
+"""Device-resident datasets, on-device preprocessing, and DeviceFeed —
+the input-pipeline pieces that keep the host->device link off the
+critical path (SURVEY.md §7.3 #4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.data.prefetch import DeviceFeed
+from helpers import make_blobs, make_mlp
+
+
+def _dataset(blobs):
+    feats, labels = blobs
+    return dk.Dataset({"features": feats, "label": labels})
+
+
+def test_device_data_matches_streaming(blobs):
+    ds = _dataset(blobs)
+
+    def run(**kw):
+        t = dk.SingleTrainer(make_mlp(), loss="sparse_categorical_crossentropy",
+                             worker_optimizer="sgd", learning_rate=0.05,
+                             batch_size=16, num_epoch=2, steps_per_call=4,
+                             **kw)
+        t.train(ds)
+        return t.history
+
+    np.testing.assert_allclose(run(device_data=True), run(), rtol=1e-6)
+
+
+def test_device_data_single_step_per_call(blobs):
+    ds = _dataset(blobs)
+    t = dk.SingleTrainer(make_mlp(), loss="sparse_categorical_crossentropy",
+                         worker_optimizer="sgd", learning_rate=0.05,
+                         batch_size=16, num_epoch=2, device_data=True)
+    t.train(ds)
+    assert t.history[-1] < t.history[0]
+
+
+def test_device_data_checkpoint_resume(blobs, tmp_path):
+    ds = _dataset(blobs)
+    d = str(tmp_path / "ck")
+
+    def make(num_epoch, **kw):
+        return dk.SingleTrainer(
+            make_mlp(), loss="sparse_categorical_crossentropy",
+            worker_optimizer="sgd", learning_rate=0.05, batch_size=16,
+            num_epoch=num_epoch, steps_per_call=4, device_data=True,
+            checkpoint_dir=d, checkpoint_every=1, **kw)
+
+    full = dk.SingleTrainer(make_mlp(), loss="sparse_categorical_crossentropy",
+                            worker_optimizer="sgd", learning_rate=0.05,
+                            batch_size=16, num_epoch=2, steps_per_call=4,
+                            device_data=True)
+    full.train(ds)
+    make(1).train(ds)
+    resumed = make(2, resume=True)
+    resumed.train(ds)
+    n_first = len(full.history) // 2
+    np.testing.assert_allclose(resumed.history, full.history[n_first:],
+                               rtol=1e-6)
+
+
+def test_preprocess_u8_matches_f32(blobs):
+    """uint8 wire dtype + on-device normalize == host-normalized f32."""
+    feats, labels = blobs
+    # Quantize features to u8 so both paths see identical values.
+    lo, hi = feats.min(), feats.max()
+    q = np.round((feats - lo) / (hi - lo) * 255).astype(np.uint8)
+    f32 = q.astype(np.float32) / 255.0
+
+    def run(data, preprocess=None):
+        from distkeras_tpu.models.adapter import ModelAdapter
+
+        ad = ModelAdapter(make_mlp(), loss="sparse_categorical_crossentropy",
+                          optimizer="sgd", learning_rate=0.05,
+                          preprocess=preprocess)
+        state = ad.init_state()
+        step = ad.make_train_step()
+        import jax
+
+        jstep = jax.jit(step, donate_argnums=0)
+        losses = []
+        for i in range(0, 128, 16):
+            state, loss = jstep(state, data[i:i + 16], labels[i:i + 16])
+            losses.append(float(loss))
+        return losses
+
+    np.testing.assert_allclose(
+        run(q, preprocess=lambda x: x.astype(jnp.float32) / 255.0),
+        run(f32), rtol=1e-5)
+
+
+def test_trainer_preprocess_passthrough(blobs):
+    """SingleTrainer(preprocess=...) + device_data trains uint8 data
+    identically to host-normalized f32 data."""
+    feats, labels = blobs
+    lo, hi = feats.min(), feats.max()
+    q = np.round((feats - lo) / (hi - lo) * 255).astype(np.uint8)
+
+    def run(data, preprocess=None):
+        t = dk.SingleTrainer(make_mlp(), loss="sparse_categorical_crossentropy",
+                             worker_optimizer="sgd", learning_rate=0.05,
+                             batch_size=16, num_epoch=2, steps_per_call=4,
+                             device_data=True, preprocess=preprocess)
+        t.train(dk.Dataset({"features": data, "label": labels}))
+        return t.history
+
+    np.testing.assert_allclose(
+        run(q, preprocess=lambda x: x.astype(jnp.float32) / 255.0),
+        run(q.astype(np.float32) / 255.0), rtol=1e-5)
+
+
+def test_stateless_apply_uses_preprocess(blobs):
+    from distkeras_tpu.models.adapter import ModelAdapter
+
+    feats, _ = blobs
+    ad = ModelAdapter(make_mlp(), preprocess=lambda x: x * 0.5)
+    plain = ModelAdapter(make_mlp(), )
+    st = ad.init_state()
+    out, _ = ad.stateless_apply(st.tv, st.ntv, feats[:8])
+    ref, _ = plain.stateless_apply(st.tv, st.ntv, feats[:8] * 0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_device_feed_order_and_depth(blobs):
+    items = [(np.full((2, 2), i, np.float32), np.full((2,), i, np.int32))
+             for i in range(7)]
+    out = list(DeviceFeed(iter(items), depth=3))
+    assert len(out) == 7
+    for i, (x, y) in enumerate(out):
+        assert float(np.asarray(x)[0, 0]) == i
+        assert int(np.asarray(y)[0]) == i
+
+
+def test_device_feed_rejects_bad_depth():
+    with pytest.raises(ValueError, match="depth"):
+        DeviceFeed([], depth=0)

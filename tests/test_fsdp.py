@@ -1,0 +1,144 @@
+"""FSDP (ZeRO-3 via GSPMD sharding): identical math to plain DP, with
+parameters and optimizer state scattered over the data axis.
+
+The reference cannot shard parameter memory at all — every worker and
+the parameter server hold full weight copies (reference:
+distkeras/parameter_servers.py center variable); FSDP is pure rebuild
+surface, tested the same way the trainer family is: exactness against
+the replicated path on the 8-CPU mesh.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.models import transformer as tfm
+from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+from distkeras_tpu.parallel.sharding import _augment_fsdp
+from jax.sharding import PartitionSpec as P
+
+
+CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_len=32)
+
+
+def tokens(rng, n=64, s=16):
+    return rng.integers(0, 64, (n, s + 1)).astype(np.int32)
+
+
+# ------------------------------------------------------------ spec rule
+
+
+def test_augment_fsdp_picks_largest_free_dim():
+    assert _augment_fsdp(P(), (64, 128), 8, "data") == P(None, "data")
+    assert _augment_fsdp(P(), (128, 64), 8, "data") == P("data")
+    # TP already owns the big dim -> FSDP takes the other one.
+    assert _augment_fsdp(P(None, "model"), (64, 128), 8, "data") == \
+        P("data", "model")
+    # Nothing divisible -> stays as-is (small params replicate).
+    assert _augment_fsdp(P(), (5, 3), 8, "data") == P()
+    # Axis already present (user rule) -> untouched.
+    assert _augment_fsdp(P("data"), (64, 64), 8, "data") == P("data")
+    # Trivial axis -> no-op.
+    assert _augment_fsdp(P(), (64, 64), 1, "data") == P()
+
+
+# ------------------------------------------------------------ LMTrainer
+
+
+def _lm_losses(mesh, rng, **kw):
+    t = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16, num_epoch=4,
+                     mesh=mesh, **kw)
+    t.train(tokens(rng))
+    return t
+
+
+def test_lm_fsdp_matches_dp(devices):
+    mesh = make_mesh(MeshSpec(data=8), devices=devices)
+    base = _lm_losses(mesh, np.random.default_rng(0))
+    fsdp = _lm_losses(mesh, np.random.default_rng(0), fsdp=True)
+    np.testing.assert_allclose(fsdp.history, base.history, rtol=2e-4)
+
+
+def test_lm_fsdp_shards_param_memory(devices, rng):
+    mesh = make_mesh(MeshSpec(data=8), devices=devices)
+    t = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16, mesh=mesh,
+                     fsdp=True)
+    params = t.train(tokens(rng))
+    emb = params["tok_emb"]  # [64, 32]: vocab dim shards 8-way
+    assert "data" in tuple(emb.sharding.spec)
+    shard = emb.addressable_shards[0].data
+    assert shard.size == emb.size // 8
+
+
+def test_lm_fsdp_composes_with_tp(devices):
+    mesh = make_mesh(MeshSpec(data=4, model=2), devices=devices)
+    base = _lm_losses(mesh, np.random.default_rng(0))
+    fsdp = _lm_losses(mesh, np.random.default_rng(0), fsdp=True)
+    np.testing.assert_allclose(fsdp.history, base.history, rtol=2e-4)
+
+
+def test_lm_fsdp_rejects_pipeline(devices):
+    mesh = make_mesh(MeshSpec(data=2, pipeline=2, seq=2), devices=devices)
+    with pytest.raises(ValueError, match="fsdp.*pipeline"):
+        dk.LMTrainer(CFG, mesh=mesh, fsdp=True)
+
+
+def test_lm_fsdp_checkpoint_resume(devices, tmp_path):
+    """FSDP state round-trips through orbax with its scattered layout."""
+    d = str(tmp_path / "ck")
+    mesh = make_mesh(MeshSpec(data=8), devices=devices)
+    rng = np.random.default_rng(0)
+    data = tokens(rng)
+    full = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16, num_epoch=2,
+                        mesh=mesh, fsdp=True)
+    full.train(data)
+
+    first = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16, num_epoch=1,
+                         mesh=mesh, fsdp=True, checkpoint_dir=d,
+                         checkpoint_every=1)
+    first.train(data)
+    resumed = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16,
+                           num_epoch=2, mesh=mesh, fsdp=True,
+                           checkpoint_dir=d, checkpoint_every=1, resume=True)
+    p2 = resumed.train(data)
+    np.testing.assert_allclose(
+        resumed.history, full.history[len(first.history):], rtol=1e-5)
+    jax.block_until_ready(jax.tree.leaves(p2)[0])
+
+
+# ------------------------------------------------------------ Keras side
+
+
+def test_adag_fsdp_matches_dp(devices, blobs):
+    from helpers import make_mlp
+
+    feats, labels = blobs
+    ds = dk.Dataset({"features": feats, "label": labels})
+
+    def run(**kw):
+        t = dk.ADAG(make_mlp(), loss="sparse_categorical_crossentropy",
+                    worker_optimizer="sgd", learning_rate=0.05,
+                    batch_size=8, num_epoch=2, communication_window=4, **kw)
+        t.train(ds)
+        return t.history
+
+    np.testing.assert_allclose(run(fsdp=True), run(), rtol=2e-4)
+
+
+def test_fsdp_plan_and_plan_conflict(blobs):
+    from helpers import make_mlp
+
+    with pytest.raises(ValueError, match="plan.*fsdp|fsdp.*plan"):
+        dk.ADAG(make_mlp(), plan=dk.dp_plan(), fsdp=True)
+
+
+def test_replica_trainers_reject_fsdp():
+    from helpers import make_mlp
+
+    with pytest.raises(ValueError, match="FSDP"):
+        dk.AEASGD(make_mlp(), fsdp=True)
+    # The explicit-plan spelling of the same forbidden configuration.
+    with pytest.raises(ValueError, match="FSDP"):
+        dk.AEASGD(make_mlp(), plan=dk.fsdp_plan())
