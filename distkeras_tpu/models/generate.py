@@ -95,13 +95,46 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     return out.astype(jnp.float32), cache
 
 
+def top_k_mask(logits, k: int):
+    """Keep the k highest logits per row; the rest go to -inf.
+
+    Static ``k`` (a Python int): the mask is a compare against the k-th
+    value from ``lax.top_k`` — no dynamic shapes, scan/jit friendly.
+    """
+    if k < 1:
+        raise ValueError(f"top_k must be >= 1, got {k}")
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def top_p_mask(logits, p: float):
+    """Nucleus filtering: keep the smallest set of tokens whose
+    probability mass reaches ``p``; the rest go to -inf.
+
+    Sort-based with an exclusive cumulative sum, so the top token is
+    always kept (exclusive mass 0 < p) — static shapes throughout.
+    """
+    if not 0.0 < p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {p}")
+    sl = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sl, axis=-1)
+    exclusive = jnp.cumsum(probs, axis=-1) - probs
+    keep = exclusive < p
+    thr = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits < thr, -jnp.inf, logits)
+
+
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
-             temperature: float = 0.0, key=None):
+             temperature: float = 0.0, key=None,
+             top_k: int | None = None, top_p: float | None = None):
     """Decode ``max_new_tokens`` past ``prompt [B, P]``; returns [B, P+N].
 
     One compiled scan: prompt positions run through the same cached
     step (teacher-forced), then sampling continues from the last
-    prompt token.  temperature == 0 is greedy argmax.
+    prompt token.  temperature == 0 is greedy argmax; with temperature
+    > 0, ``top_k`` and/or ``top_p`` (nucleus) restrict the sampling
+    support — both applied to the temperature-scaled logits, top-k
+    first, the standard composition.
 
     MoE caveat: decode-time routing is dense top-1 *without* expert
     capacity (see ``step_fn``), so logits diverge from the training
@@ -123,6 +156,16 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
             f"max_len={cfg.max_len}")
     if temperature > 0 and key is None:
         raise ValueError("temperature sampling needs an explicit PRNG key")
+    if (top_k is not None or top_p is not None) and temperature <= 0:
+        raise ValueError(
+            "top_k/top_p filter a sampling distribution; they need "
+            "temperature > 0 (greedy decoding always takes the single "
+            "best token, so filtering would be a no-op)")
+    if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
+        raise ValueError(
+            f"top_k must be in [1, vocab_size={cfg.vocab_size}], got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     key = key if key is not None else jax.random.key(0)
 
     # Buffer of emitted tokens; prompt occupies [0, p).
@@ -135,7 +178,12 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
         logits, cache = _decode_step(params, cache, tok, pos, cfg)
         key, sub = jax.random.split(key)
         if temperature > 0:
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            scaled = logits / temperature
+            if top_k is not None:
+                scaled = top_k_mask(scaled, top_k)
+            if top_p is not None:
+                scaled = top_p_mask(scaled, top_p)
+            nxt = jax.random.categorical(sub, scaled, axis=-1)
         else:
             nxt = logits.argmax(axis=-1)
         # Only write past the prompt (prompt positions are forced).

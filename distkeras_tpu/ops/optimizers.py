@@ -21,6 +21,13 @@ def resolve_optimizer(spec, learning_rate: float | None = None
         (the set the reference's Keras 1/2 accepted for ``worker_optimizer``)
       * an ``optax.GradientTransformation`` (passed through)
     ``learning_rate`` overrides the per-name default (the Keras default).
+    It may also be an optax *schedule* — any ``step -> lr`` callable,
+    e.g. ``optax.warmup_cosine_decay_schedule(...)`` — which every optax
+    factory consumes natively; the schedule evaluates on-device from the
+    optimizer's own step count, so warmup/decay live inside the jitted
+    train step with no per-step host traffic.  (The reference has no
+    schedule support at all — a fixed ``learning_rate`` kwarg per
+    trainer, reference: distkeras/trainers.py.)
     """
     if isinstance(spec, optax.GradientTransformation):
         return spec
@@ -42,6 +49,8 @@ def resolve_optimizer(spec, learning_rate: float | None = None
         raise ValueError(
             f"Unknown optimizer {spec!r}; known: {sorted(defaults)}")
     lr = learning_rate if learning_rate is not None else defaults[name]
+    if not callable(lr) and lr <= 0:
+        raise ValueError(f"learning_rate must be positive, got {lr}")
     factory = {
         "sgd": optax.sgd,
         "adam": optax.adam,

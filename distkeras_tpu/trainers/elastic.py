@@ -209,6 +209,14 @@ class AEASGD(ReplicaTrainer):
 
     def __init__(self, keras_model, communication_window: int = 32,
                  rho: float = 5.0, learning_rate: float = 0.01, **kw):
+        if callable(learning_rate):
+            raise ValueError(
+                "AEASGD/EAMSGD need a scalar learning_rate: the elastic "
+                "coefficient alpha = rho * learning_rate is part of the "
+                "algorithm's fixed-point math (reference elastic force), "
+                "not just an optimizer step size, so an optax schedule "
+                "has no single value to derive it from. Use a scalar "
+                "here, or ADAG/DOWNPOUR/SingleTrainer for scheduled LR.")
         super().__init__(keras_model, learning_rate=learning_rate, **kw)
         self.communication_window = communication_window
         self.rho = rho

@@ -63,6 +63,9 @@ class LMTrainer(CheckpointingBase):
                  checkpoint_dir: str | None = None, checkpoint_every: int = 0,
                  max_checkpoints: int = 3, resume: bool = False):
         self.cfg = cfg
+        if not callable(learning_rate) and learning_rate <= 0:
+            raise ValueError(
+                f"learning_rate must be positive, got {learning_rate}")
         if hasattr(optimizer, "init"):  # prebuilt optax GradientTransformation
             self.optimizer = optimizer
         elif callable(optimizer):  # optax factory: optax.lion etc.

@@ -79,3 +79,68 @@ def test_generate_length_guard(rng):
         generate(params, jnp.zeros((1, 10), jnp.int32), CFG, 10)
     with pytest.raises(ValueError, match="at least one token"):
         generate(params, jnp.zeros((1, 0), jnp.int32), CFG, 4)
+
+
+def test_top_k_mask_keeps_exactly_k():
+    from distkeras_tpu.models.generate import top_k_mask
+
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0]])
+    out = np.asarray(top_k_mask(logits, 2))
+    assert np.isfinite(out).sum() == 2
+    assert np.isfinite(out[0, [1, 4]]).all()  # the two largest survive
+
+
+def test_top_p_mask_nucleus():
+    from distkeras_tpu.models.generate import top_p_mask
+
+    # probs ~ [0.643, 0.236, 0.087, 0.032, 0.002]
+    logits = jnp.log(jnp.asarray([[0.643, 0.236, 0.087, 0.032, 0.002]]))
+    out = np.asarray(top_p_mask(logits, 0.8))
+    # exclusive mass: 0, .643, .879 -> first two kept, rest dropped
+    assert np.isfinite(out[0, :2]).all() and not np.isfinite(out[0, 2:]).any()
+    # top token always survives even with tiny p
+    out = np.asarray(top_p_mask(logits, 1e-6))
+    assert np.isfinite(out[0, 0]) and not np.isfinite(out[0, 1:]).any()
+
+
+def test_generate_topk1_equals_greedy(rng):
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)).astype(np.int32))
+    greedy = generate(params, prompt, CFG, max_new_tokens=6)
+    k1 = generate(params, prompt, CFG, max_new_tokens=6, temperature=0.7,
+                  top_k=1, key=jax.random.key(7))
+    np.testing.assert_array_equal(greedy, k1)
+
+
+def test_generate_tiny_top_p_equals_greedy(rng):
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)).astype(np.int32))
+    greedy = generate(params, prompt, CFG, max_new_tokens=6)
+    p0 = generate(params, prompt, CFG, max_new_tokens=6, temperature=1.3,
+                  top_p=1e-9, key=jax.random.key(11))
+    np.testing.assert_array_equal(greedy, p0)
+
+
+def test_generate_sampling_deterministic_per_key(rng):
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (1, 3)).astype(np.int32))
+
+    def g(seed):
+        return generate(params, prompt, CFG, 5, temperature=1.0,
+                        top_k=8, top_p=0.9, key=jax.random.key(seed))
+
+    np.testing.assert_array_equal(g(3), g(3))
+    assert not np.array_equal(np.asarray(g(3)), np.asarray(g(4)))
+
+
+def test_generate_sampling_validation(rng):
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    with pytest.raises(ValueError, match="temperature > 0"):
+        generate(params, prompt, CFG, 4, top_k=5)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(params, prompt, CFG, 4, temperature=1.0, top_k=0,
+                 key=jax.random.key(0))
+    with pytest.raises(ValueError, match="top_p"):
+        generate(params, prompt, CFG, 4, temperature=1.0, top_p=1.5,
+                 key=jax.random.key(0))

@@ -1,0 +1,82 @@
+"""Learning-rate schedules through the trainer family: any optax
+schedule (step -> lr callable) is accepted by the optimizer-backed
+trainers (Single/ADAG/DynSGD/LMTrainer), evaluated on-device inside the
+jitted step.  The elastic trainers need a scalar (alpha = rho * lr is
+part of their fixed-point math) and say so."""
+
+import numpy as np
+import optax
+import pytest
+
+import distkeras_tpu as dk
+from helpers import make_mlp
+
+
+def test_schedule_freezes_params_when_lr_hits_zero(blobs):
+    """A piecewise schedule dropping to 0 after 2 steps must stop
+    parameter movement exactly there — proof the schedule drives the
+    update, not just the first step's value."""
+    import jax
+    from distkeras_tpu.models.adapter import ModelAdapter
+
+    feats, labels = blobs
+    sched = optax.piecewise_constant_schedule(0.05, {2: 0.0})
+    ad = ModelAdapter(make_mlp(), loss="sparse_categorical_crossentropy",
+                      optimizer="sgd", learning_rate=sched)
+    state = ad.init_state()
+    step = jax.jit(ad.make_train_step(), donate_argnums=0)
+    snaps = []
+    for i in range(4):
+        state, _ = step(state, feats[:32], labels[:32])
+        snaps.append(np.asarray(state.tv[0]))
+    assert not np.array_equal(snaps[0], snaps[1])  # lr 0.05: moving
+    np.testing.assert_array_equal(snaps[2], snaps[3])  # lr 0: frozen
+
+
+def test_warmup_cosine_through_single_trainer(blobs):
+    feats, labels = blobs
+    ds = dk.Dataset({"features": feats, "label": labels})
+    sched = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=0.05, warmup_steps=8,
+        decay_steps=64, end_value=1e-3)
+    t = dk.SingleTrainer(make_mlp(), loss="sparse_categorical_crossentropy",
+                         worker_optimizer="sgd", learning_rate=sched,
+                         batch_size=16, num_epoch=2)
+    t.train(ds)
+    assert t.history[-1] < t.history[0] * 0.8
+
+
+def test_schedule_through_lm_trainer(devices):
+    import jax
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    rng = np.random.default_rng(0)
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32)
+    mesh = make_mesh(MeshSpec(data=8), devices=devices)
+    sched = optax.warmup_cosine_decay_schedule(0.0, 1e-2, 4, 32)
+    t = dk.LMTrainer(cfg, optimizer="adamw", learning_rate=sched,
+                     batch_size=16, num_epoch=8, mesh=mesh)
+    t.train(rng.integers(0, 64, (64, 17)).astype(np.int32))
+    assert t.history[-1] < t.history[0] * 0.85
+
+
+def test_negative_lr_rejected():
+    with pytest.raises(ValueError, match="positive"):
+        dk.SingleTrainer(make_mlp(), worker_optimizer="sgd",
+                         learning_rate=-0.1)
+    from distkeras_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=1, d_ff=64, max_len=32)
+    with pytest.raises(ValueError, match="positive"):
+        dk.LMTrainer(cfg, learning_rate=-1.0)
+
+
+def test_elastic_trainers_reject_schedules():
+    sched = optax.warmup_cosine_decay_schedule(0.0, 0.05, 4, 32)
+    with pytest.raises(ValueError, match="scalar learning_rate"):
+        dk.AEASGD(make_mlp(), learning_rate=sched)
+    with pytest.raises(ValueError, match="scalar learning_rate"):
+        dk.EAMSGD(make_mlp(), learning_rate=sched)
