@@ -62,6 +62,7 @@ from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
 from distkeras_tpu.parallel.sharding import (ShardingPlan, dp_plan,
                                               fsdp_plan, tp_plan)
 from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.tokenizer import BPETokenizer
 from distkeras_tpu.data.transformers import (
     Transformer,
     OneHotTransformer,
@@ -100,6 +101,7 @@ __all__ = [
     "fsdp_plan",
     "tp_plan",
     "Dataset",
+    "BPETokenizer",
     "Transformer",
     "OneHotTransformer",
     "LabelIndexTransformer",

@@ -1,4 +1,5 @@
 from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.tokenizer import BPETokenizer
 from distkeras_tpu.data.transformers import (
     Transformer,
     OneHotTransformer,
@@ -10,6 +11,7 @@ from distkeras_tpu.data.transformers import (
 
 __all__ = [
     "Dataset",
+    "BPETokenizer",
     "Transformer",
     "OneHotTransformer",
     "LabelIndexTransformer",

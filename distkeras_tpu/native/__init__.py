@@ -17,10 +17,11 @@ import threading
 
 import numpy as np
 
-_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)))), "native", "dataloader.cc")
-_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                   "_libdkt_data.so")
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_NATIVE_DIR, "dataloader.cc")
+_SO = os.path.join(_PKG_DIR, "_libdkt_data.so")
 
 _lock = threading.Lock()
 _lib = None
@@ -29,15 +30,57 @@ _tried = False
 _DEF_THREADS = min(8, os.cpu_count() or 1)
 
 
-def _build() -> str | None:
-    if not os.path.exists(_SRC):
+def _compile(src: str, so: str) -> str | None:
+    """g++ one source file into a shared library; None on any failure
+    (no compiler, bad toolchain) — callers fall back to numpy/python."""
+    if not os.path.exists(src):
         return None
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", _SRC, "-o", _SO]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", src, "-o", so]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (OSError, subprocess.SubprocessError):
         return None
-    return _SO
+    return so
+
+
+def _build() -> str | None:
+    return _compile(_SRC, _SO)
+
+
+_BPE_SRC = os.path.join(_NATIVE_DIR, "tokenizer.cc")
+_BPE_SO = os.path.join(_PKG_DIR, "_libdkt_bpe.so")
+_bpe_lib = None
+_bpe_tried = False
+
+
+def bpe_lib():
+    """The BPE tokenizer library (native/tokenizer.cc), or None."""
+    global _bpe_lib, _bpe_tried
+    with _lock:
+        if _bpe_lib is not None or _bpe_tried:
+            return _bpe_lib
+        _bpe_tried = True
+        path = (_BPE_SO if os.path.exists(_BPE_SO)
+                else _compile(_BPE_SRC, _BPE_SO))
+        if not path:
+            return None
+        try:
+            handle = ctypes.CDLL(path)
+        except OSError:
+            return None
+        handle.dkt_bpe_train.restype = ctypes.c_int32
+        handle.dkt_bpe_train.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p]
+        handle.dkt_bpe_encode.restype = ctypes.c_int64
+        handle.dkt_bpe_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_void_p]
+        handle.dkt_bpe_decode.restype = ctypes.c_int64
+        handle.dkt_bpe_decode.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]
+        _bpe_lib = handle
+        return _bpe_lib
 
 
 def lib():

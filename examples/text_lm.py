@@ -1,0 +1,80 @@
+"""Text in, text out: the full LM pipeline on one chip.
+
+Train a byte-level BPE tokenizer on a corpus (here: this repository's
+own source files — real text, no download), encode it into LMTrainer
+rows, train the transformer with a warmup-cosine schedule, and sample
+continuations with nucleus sampling.  The reference has no analogue of
+any stage of this (its pipeline starts at pre-vectorized DataFrame
+columns, reference: workflow.ipynb); this is the rebuild's flagship
+path end to end.
+
+Run: python examples/text_lm.py [--steps N]
+"""
+
+import argparse
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import distkeras_tpu as dk  # noqa: E402  (forces KERAS_BACKEND=jax)
+
+
+def load_corpus() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    files = sorted(glob.glob(os.path.join(root, "distkeras_tpu/**/*.py"),
+                             recursive=True))
+    return "\n\n".join(open(f).read() for f in files)
+
+
+def main():
+    import jax
+    import numpy as np
+    import optax
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.models.generate import generate
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=1024)
+    args = ap.parse_args()
+
+    corpus = load_corpus()
+    print(f"corpus: {len(corpus):,} chars")
+    tok = dk.BPETokenizer.train(corpus, vocab_size=args.vocab)
+    rows = tok.encode_corpus(corpus, seq_len=args.seq_len)
+    sample = corpus[:100000]
+    print(f"tokenizer: vocab {tok.vocab_size}, "
+          f"{rows.shape[0]:,} rows of {args.seq_len}+1 tokens "
+          f"({len(sample) / len(tok.encode(sample)):.2f} chars/token "
+          "on a sample)")
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=tok.vocab_size, d_model=256, n_heads=4, n_layers=4,
+        d_ff=1024, max_len=args.seq_len + 1,
+        dtype="bfloat16" if jax.default_backend() == "tpu" else "float32")
+    batch = 32
+    epochs = max(1, args.steps // max(1, len(rows) // batch))
+    sched = optax.warmup_cosine_decay_schedule(0.0, 3e-3, 20,
+                                               args.steps, 1e-4)
+    trainer = dk.LMTrainer(cfg, optimizer="adamw", learning_rate=sched,
+                           batch_size=batch, num_epoch=epochs, shuffle=True,
+                           seed=0)
+    params = trainer.train(rows)
+    print(f"trained {len(trainer.history)} steps in "
+          f"{trainer.training_time:.1f}s: loss "
+          f"{trainer.history[0]:.3f} -> {trainer.history[-1]:.3f}")
+
+    prompt_text = "def train("
+    prompt = np.tile(tok.encode(prompt_text), (2, 1)).astype(np.int32)
+    out = generate(params, prompt, cfg,
+                   max_new_tokens=min(48, cfg.max_len - prompt.shape[1]),
+                   temperature=0.8, top_p=0.95, key=jax.random.key(0))
+    for row in np.asarray(out):
+        print("sample:", repr(tok.decode(row)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,169 @@
+// Byte-level BPE tokenizer for distkeras_tpu's LM data path.
+//
+// The reference framework has no text tokenizer at all — its examples
+// consume pre-vectorized Spark DataFrames (reference: workflow.ipynb
+// feature columns).  The TPU rebuild's flagship is a causal LM, so the
+// framework owes the text->tokens edge of the pipeline; it lives here
+// as a small C++ library (ctypes-driven, numpy/python fallback in
+// distkeras_tpu/data/tokenizer.py) because encoding is the CPU-hot
+// part of any real text pipeline.
+//
+// Algorithm: byte-level BPE (GPT-2 family).  Base vocabulary is the
+// 256 bytes; training greedily merges the most frequent adjacent pair
+// for n_merges rounds; encoding applies merges in rank order via a
+// linked-list + heap in O(len log len) — not the naive O(merges*len)
+// rescan.
+//
+// Build: g++ -O3 -shared -fPIC -pthread tokenizer.cc -o libdkt_bpe.so
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <queue>
+#include <utility>
+#include <vector>
+
+namespace {
+
+using Pair = std::pair<int32_t, int32_t>;
+
+// Count adjacent pairs in `toks`, return the most frequent (ties break
+// toward the smaller pair for determinism).  Returns count 0 if empty.
+int64_t most_frequent_pair(const std::vector<int32_t>& toks, Pair* best) {
+  std::map<Pair, int64_t> counts;
+  for (size_t i = 0; i + 1 < toks.size(); ++i) {
+    ++counts[{toks[i], toks[i + 1]}];
+  }
+  int64_t best_count = 0;
+  for (const auto& kv : counts) {
+    if (kv.second > best_count) {
+      best_count = kv.second;
+      *best = kv.first;
+    }
+  }
+  return best_count;
+}
+
+void merge_inplace(std::vector<int32_t>* toks, Pair pair, int32_t new_id) {
+  size_t w = 0;
+  for (size_t r = 0; r < toks->size(); ++r) {
+    if (r + 1 < toks->size() && (*toks)[r] == pair.first &&
+        (*toks)[r + 1] == pair.second) {
+      (*toks)[w++] = new_id;
+      ++r;
+    } else {
+      (*toks)[w++] = (*toks)[r];
+    }
+  }
+  toks->resize(w);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Learn `n_merges` byte-level BPE merges from `corpus`.
+// out_merges: [n_merges * 2] int32 (left, right) in merge order; token
+// id of merge i is 256 + i.  Returns the number of merges actually
+// learned (< n_merges when the corpus runs out of repeated pairs).
+int32_t dkt_bpe_train(const uint8_t* corpus, int64_t len, int32_t n_merges,
+                      int32_t* out_merges) {
+  std::vector<int32_t> toks(corpus, corpus + len);
+  int32_t learned = 0;
+  for (int32_t m = 0; m < n_merges; ++m) {
+    Pair best;
+    if (most_frequent_pair(toks, &best) < 2) break;  // nothing repeats
+    out_merges[2 * m] = best.first;
+    out_merges[2 * m + 1] = best.second;
+    merge_inplace(&toks, best, 256 + m);
+    ++learned;
+  }
+  return learned;
+}
+
+// Encode `text` with `n_merges` ranked merges. out: caller-allocated
+// [len] int32 (worst case: no merge applies). Returns encoded length.
+int64_t dkt_bpe_encode(const int32_t* merges, int32_t n_merges,
+                       const uint8_t* text, int64_t len, int32_t* out) {
+  if (len == 0) return 0;
+  // rank lookup: pair -> (rank, new_id)
+  std::map<Pair, std::pair<int32_t, int32_t>> rank;
+  for (int32_t m = 0; m < n_merges; ++m) {
+    rank[{merges[2 * m], merges[2 * m + 1]}] = {m, 256 + m};
+  }
+  // Doubly linked list over token slots.
+  std::vector<int32_t> tok(text, text + len);
+  std::vector<int64_t> prev(len), next(len);
+  for (int64_t i = 0; i < len; ++i) {
+    prev[i] = i - 1;
+    next[i] = i + 1 < len ? i + 1 : -1;
+  }
+  std::vector<uint8_t> dead(len, 0);
+
+  // Min-heap of (rank, left_pos); stale entries are skipped on pop by
+  // re-checking that the pair at left_pos still matches the rank.
+  using Item = std::pair<int32_t, int64_t>;
+  std::priority_queue<Item, std::vector<Item>, std::greater<Item>> heap;
+  auto push_pair = [&](int64_t i) {
+    if (i < 0 || dead[i]) return;
+    int64_t j = next[i];
+    if (j < 0) return;
+    auto it = rank.find({tok[i], tok[j]});
+    if (it != rank.end()) heap.push({it->second.first, i});
+  };
+  for (int64_t i = 0; i + 1 < len; ++i) push_pair(i);
+
+  while (!heap.empty()) {
+    auto [r, i] = heap.top();
+    heap.pop();
+    if (dead[i]) continue;
+    int64_t j = next[i];
+    if (j < 0 || dead[j]) continue;
+    auto it = rank.find({tok[i], tok[j]});
+    if (it == rank.end() || it->second.first != r) continue;  // stale
+    // Merge j into i.
+    tok[i] = it->second.second;
+    dead[j] = 1;
+    next[i] = next[j];
+    if (next[j] >= 0) prev[next[j]] = i;
+    // New neighbours form new candidate pairs.
+    push_pair(prev[i]);
+    push_pair(i);
+  }
+
+  int64_t w = 0;
+  for (int64_t i = 0; i >= 0; i = next[i]) {
+    if (!dead[i]) out[w++] = tok[i];
+  }
+  return w;
+}
+
+// Decode `ids` back to bytes.  out: caller-allocated buffer of
+// capacity `out_cap`; returns bytes written, or -1 if out_cap is too
+// small or an id is out of range.
+int64_t dkt_bpe_decode(const int32_t* merges, int32_t n_merges,
+                       const int32_t* ids, int64_t n_ids, uint8_t* out,
+                       int64_t out_cap) {
+  // Expand each merge id to its byte string once, memoized bottom-up.
+  std::vector<std::vector<uint8_t>> table(256 + n_merges);
+  for (int32_t b = 0; b < 256; ++b) table[b] = {static_cast<uint8_t>(b)};
+  for (int32_t m = 0; m < n_merges; ++m) {
+    int32_t l = merges[2 * m], r = merges[2 * m + 1];
+    if (l < 0 || l >= 256 + m || r < 0 || r >= 256 + m) return -1;
+    table[256 + m] = table[l];
+    table[256 + m].insert(table[256 + m].end(), table[r].begin(),
+                          table[r].end());
+  }
+  int64_t w = 0;
+  for (int64_t i = 0; i < n_ids; ++i) {
+    int32_t id = ids[i];
+    if (id < 0 || id >= 256 + n_merges) return -1;
+    const auto& bytes = table[id];
+    if (w + static_cast<int64_t>(bytes.size()) > out_cap) return -1;
+    std::memcpy(out + w, bytes.data(), bytes.size());
+    w += bytes.size();
+  }
+  return w;
+}
+
+}  // extern "C"

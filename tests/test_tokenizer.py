@@ -1,0 +1,99 @@
+"""Byte-level BPE tokenizer: native/python parity, losslessness, and
+the LMTrainer packing contract."""
+
+import numpy as np
+import pytest
+
+import distkeras_tpu  # noqa: F401  (package import path)
+from distkeras_tpu.data.tokenizer import BPETokenizer
+
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "the quicker brown foxes jump over the lazier dogs. "
+    "pack my box with five dozen liquor jugs. "
+) * 50
+
+
+def test_train_encode_decode_roundtrip():
+    tok = BPETokenizer.train(CORPUS, vocab_size=400)
+    assert 256 < tok.vocab_size <= 400
+    ids = tok.encode("the quick brown fox")
+    assert ids.dtype == np.int32
+    assert len(ids) < len("the quick brown fox")  # merges compress
+    assert tok.decode(ids) == "the quick brown fox"
+
+
+def test_unseen_and_unicode_text_is_lossless():
+    tok = BPETokenizer.train(CORPUS, vocab_size=300)
+    for text in ["zebra! @#$%", "héllo wörld é中文", ""]:
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_native_and_python_paths_agree(monkeypatch):
+    tok_native = BPETokenizer.train(CORPUS, vocab_size=350)
+
+    import distkeras_tpu.native as native
+
+    monkeypatch.setattr(native, "_bpe_lib", None)
+    monkeypatch.setattr(native, "_bpe_tried", True)  # force fallback
+    tok_py = BPETokenizer.train(CORPUS, vocab_size=350)
+    np.testing.assert_array_equal(tok_native.merges, tok_py.merges)
+
+    text = "the lazy liquor jugs jumped over my box"
+    ids_py = tok_py.encode(text)
+    assert tok_py.decode(ids_py) == text
+    monkeypatch.undo()
+    np.testing.assert_array_equal(tok_native.encode(text), ids_py)
+
+
+def test_save_load_roundtrip(tmp_path):
+    tok = BPETokenizer.train(CORPUS, vocab_size=300)
+    p = str(tmp_path / "bpe.json")
+    tok.save(p)
+    tok2 = BPETokenizer.load(p)
+    np.testing.assert_array_equal(tok.merges, tok2.merges)
+    text = "five dozen foxes"
+    np.testing.assert_array_equal(tok.encode(text), tok2.encode(text))
+
+
+def test_encode_corpus_packs_lm_rows():
+    tok = BPETokenizer.train(CORPUS, vocab_size=300)
+    rows = tok.encode_corpus(CORPUS, seq_len=16)
+    assert rows.shape[1] == 17 and rows.dtype == np.int32
+    ids = tok.encode(CORPUS)
+    # Consecutive rows overlap by one token (input/target shift).
+    np.testing.assert_array_equal(rows[0], ids[:17])
+    np.testing.assert_array_equal(rows[1], ids[16:33])
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="vocab_size"):
+        BPETokenizer.train("abc", vocab_size=100)
+    with pytest.raises(ValueError, match="do not exist"):
+        BPETokenizer(np.asarray([[999, 0]], np.int32))
+    tok = BPETokenizer.train(CORPUS, vocab_size=300)
+    with pytest.raises(ValueError, match="out of range"):
+        tok.decode(np.asarray([tok.vocab_size], np.int32))
+    with pytest.raises(ValueError, match="needs"):
+        tok.encode_corpus("x", seq_len=64)
+
+
+def test_empty_merge_table_is_raw_bytes():
+    tok = BPETokenizer(np.empty((0, 2), np.int32))
+    ids = tok.encode("abc")
+    np.testing.assert_array_equal(ids, [97, 98, 99])
+    assert tok.decode(ids) == "abc"
+
+
+def test_tokenizer_feeds_lm_trainer(devices):
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import transformer as tfm
+
+    tok = BPETokenizer.train(CORPUS, vocab_size=300)
+    rows = tok.encode_corpus(CORPUS, seq_len=16)
+    cfg = tfm.TransformerConfig(vocab_size=tok.vocab_size, d_model=32,
+                                n_heads=2, n_layers=2, d_ff=64, max_len=32)
+    t = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=16, num_epoch=2)
+    t.train(rows)
+    assert t.history[-1] < t.history[0]
