@@ -219,13 +219,10 @@ class BPETokenizer:
         the tail remainder is dropped.
         """
         ids = self.encode(text)
-        stride = seq_len
-        n = (len(ids) - 1) // stride
+        n = (len(ids) - 1) // seq_len
         if n < 1:
             raise ValueError(
                 f"corpus encodes to {len(ids)} tokens; one row needs "
                 f"{seq_len + 1}")
-        rows = np.empty((n, seq_len + 1), np.int32)
-        for i in range(n):
-            rows[i] = ids[i * stride:i * stride + seq_len + 1]
-        return rows
+        windows = np.lib.stride_tricks.sliding_window_view(ids, seq_len + 1)
+        return np.ascontiguousarray(windows[::seq_len][:n])

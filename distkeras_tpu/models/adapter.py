@@ -76,6 +76,10 @@ class ModelAdapter:
         self.loss_fn = resolve_loss(loss)
         self.optimizer = resolve_optimizer(optimizer, learning_rate)
         self.metrics = tuple(metrics)
+        unknown = [m for m in self.metrics if m != "accuracy"]
+        if unknown:  # fail at construction, not after a whole run
+            raise ValueError(
+                f"unknown metric(s) {unknown}; known: ['accuracy']")
         # On-device input transform, traced into every step/predict
         # program (e.g. ``lambda x: x.astype("float32") / 255``).  Lets
         # the host ship the smallest wire dtype — uint8 pixels are 4x
@@ -272,6 +276,49 @@ class ModelAdapter:
             return jax.lax.scan(body, state, idx)
 
         return window
+
+    def make_eval_fn(self) -> Callable:
+        """Pure ``f(tv, ntv, x, y) -> {"loss": ..., metric...}``.
+
+        Inference-mode loss plus every metric named in ``metrics``
+        (currently ``"accuracy"``: argmax match for multiclass logits,
+        0.5-threshold for a single binary logit).  The trainers jit this
+        for their ``eval_every`` hook — the reference's only mid-train
+        signal is the worker-side loss history (reference:
+        distkeras/workers.py yielding training histories).
+        """
+        model, loss_fn, pre = self.model, self.loss_fn, self.preprocess
+        names = self.metrics
+
+        def class_labels(y, preds):
+            """Integer class per row from sparse, one-hot, or [N,1]
+            binary labels — explicit, so no shape ever broadcasts to
+            [N, N] garbage (same hazard ops/losses.py _align guards)."""
+            if y.ndim == preds.ndim and y.shape[-1] == preds.shape[-1] > 1:
+                return y.argmax(-1)  # one-hot
+            if y.ndim == preds.ndim and y.shape[-1] == 1:
+                y = y[..., 0]  # [N, 1] binary/sparse
+            if y.ndim != preds.ndim - 1:
+                raise ValueError(
+                    f"label shape {y.shape} incompatible with prediction "
+                    f"shape {preds.shape} for accuracy")
+            return y.astype(jnp.int32)
+
+        def evaluate(tv, ntv, x, y):
+            if pre is not None:
+                x = pre(x)
+            preds, _ = model.stateless_call(tv, ntv, x, training=False)
+            out = {"loss": loss_fn(y, preds)}
+            for name in names:  # names validated in __init__
+                labels = class_labels(y, preds)
+                if preds.shape[-1] == 1:
+                    hit = (preds[..., 0] > 0).astype(jnp.int32) == labels
+                else:
+                    hit = preds.argmax(-1) == labels
+                out["accuracy"] = jnp.mean(hit.astype(jnp.float32))
+            return out
+
+        return evaluate
 
     def make_predict_fn(self) -> Callable:
         """Pure ``f(tv, ntv, x) -> outputs`` (inference mode)."""

@@ -116,10 +116,19 @@ class Trainer(CheckpointingBase):
                  shuffle: bool = False, seed: int | None = None,
                  checkpoint_dir: str | None = None, checkpoint_every: int = 0,
                  max_checkpoints: int = 3, resume: bool = False,
-                 preprocess=None):
+                 preprocess=None, metrics=(), eval_every: int = 0):
         self.adapter = ModelAdapter(
             keras_model, loss=loss, optimizer=worker_optimizer,
-            learning_rate=learning_rate, preprocess=preprocess)
+            learning_rate=learning_rate, preprocess=preprocess,
+            metrics=metrics)
+        # Mid-training evaluation: every ``eval_every`` rounds (and once
+        # at the end) the trainer runs the adapter's eval fn over the
+        # eval dataset passed to train(), appending
+        # ``(round, {"loss": ..., metric...})`` to ``eval_history``.
+        self.eval_every = eval_every
+        self.eval_history: list[tuple[int, dict]] = []
+        self._eval_batch = None
+        self._eval_fn = None
         self.batch_size = batch_size
         self.num_epoch = num_epoch
         self.features_col = features_col
@@ -140,10 +149,13 @@ class Trainer(CheckpointingBase):
         raise NotImplementedError
 
     def train(self, dataset: Dataset, features_col: str | None = None,
-              label_col: str | None = None):
+              label_col: str | None = None,
+              eval_dataset: Dataset | None = None):
         """Train and return a fresh Keras model with the learned weights.
 
         (EnsembleTrainer returns a list of models via its ``_export``.)
+        ``eval_dataset`` feeds the ``eval_every`` hook (see __init__);
+        passing one without ``eval_every`` evaluates once, at the end.
         """
         if features_col:
             self.features_col = features_col
@@ -151,15 +163,60 @@ class Trainer(CheckpointingBase):
             self.label_col = label_col
         if self.shuffle:
             dataset = dataset.shuffle(self.seed)
+        self.eval_history = []
+        self._eval_batch = None
+        if eval_dataset is not None:
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "eval_dataset is not supported in the multi-process "
+                    "runtime yet: each process holds only its "
+                    "Dataset.shard, so per-host evaluation would report "
+                    "divergent metrics. Evaluate after training on one "
+                    "host (ModelPredictor + AccuracyEvaluator).")
+            self._eval_batch = (eval_dataset[self.features_col],
+                                eval_dataset[self.label_col])
+            self._eval_fn = jax.jit(self.adapter.make_eval_fn())
+        elif self.eval_every:
+            raise ValueError(
+                "eval_every is set but train() got no eval_dataset")
         t0 = time.perf_counter()
         self._open_checkpoints()
         try:
             state = self._fit(dataset)
+            self._eval_hook(state, rnd=None, final=True)
             jax.block_until_ready(state.tv)
         finally:
             self._close_checkpoints()
         self.training_time = time.perf_counter() - t0
         return self._export(state)
+
+    # -- evaluation hook ---------------------------------------------------
+    def _eval_state_view(self, pytree):
+        """(tv, ntv) of the evaluable model inside a fit-loop pytree."""
+        return pytree.tv, pytree.ntv
+
+    def _eval_hook(self, pytree, rnd, final: bool = False) -> None:
+        """Record eval metrics at round ``rnd``; the end-of-training
+        call records round -1 (always runs when an eval set exists)."""
+        if self._eval_batch is None:
+            return
+        if not final and not (self.eval_every and rnd % self.eval_every == 0):
+            return
+        tv, ntv = self._eval_state_view(pytree)
+        x, y = self._eval_batch
+        # Mini-batch the eval set (at the training batch size) so a
+        # large eval split never materializes all activations at once;
+        # at most two compiled shapes (full batches + one remainder).
+        bs = min(self.batch_size, len(x))
+        sums, n = {}, 0
+        for i in range(0, len(x), bs):
+            xb, yb = x[i:i + bs], y[i:i + bs]
+            part = self._eval_fn(tv, ntv, xb, yb)
+            for k, v in part.items():
+                sums[k] = sums.get(k, 0.0) + float(v) * len(xb)
+            n += len(xb)
+        out = {k: v / n for k, v in sums.items()}
+        self.eval_history.append((-1 if final else rnd, out))
 
     def _export(self, state):
         return self.adapter.export_model(state)
@@ -268,6 +325,7 @@ class SingleTrainer(Trainer):
             # Device array (scalar, or [spc] when scanning); no sync here.
             losses.append(loss)
             self._checkpoint(state, rnd)
+            self._eval_hook(state, rnd)
         if start and not losses:  # resumed past the end: nothing left to do
             return state
         self._require_steps(losses, self.batch_size * spc, len(dataset))

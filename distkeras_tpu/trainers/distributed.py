@@ -162,6 +162,7 @@ class ADAG(DistributedTrainer):
                 state, loss = step(state, xs, ys)
                 losses.append(loss)
                 self._checkpoint(state, rnd)
+                self._eval_hook(state, rnd)
         if start and not losses:
             return state
         self._require_steps(losses, feed_bs * w, len(dataset))

@@ -113,6 +113,14 @@ class ReplicaTrainer(DistributedTrainer):
         center_tv = jax.device_put(center_tv, rep)
         return stacked, center_tv
 
+    def _eval_state_view(self, pytree):
+        if isinstance(pytree, dict):  # mid-fit round pytree
+            # Evaluate the center variable (the algorithm's product);
+            # aux state (BatchNorm stats) from replica 0.
+            ntv = jax.tree.map(lambda a: a[0], pytree["stacked"].ntv)
+            return pytree["center_tv"], ntv
+        return super()._eval_state_view(pytree)
+
     # ------------------------------------------------------------ round
 
     def _make_round(self, window: int):
@@ -185,6 +193,7 @@ class ReplicaTrainer(DistributedTrainer):
             stacked, center_tv, loss = round_fn(stacked, center_tv, xs, ys)
             losses.append(loss)
             self._checkpoint({"stacked": stacked, "center_tv": center_tv}, rnd)
+            self._eval_hook({"stacked": stacked, "center_tv": center_tv}, rnd)
         if losses or not start:  # resumed-past-the-end runs skip straight to export
             self._require_steps(
                 losses, self.batch_size * self.num_workers * window,
@@ -313,11 +322,27 @@ class EnsembleTrainer(ReplicaTrainer):
 
     def __init__(self, keras_model, num_models: int | None = None, **kw):
         window = kw.pop("communication_window", 8)
+        if kw.get("eval_every"):
+            raise ValueError(
+                "EnsembleTrainer has no single model to evaluate "
+                "mid-training (its members are intentionally "
+                "independent); evaluate the returned models with "
+                "ModelPredictor + AccuracyEvaluator instead")
         if num_models is not None:
             kw.setdefault("num_workers", num_models)
         super().__init__(keras_model, **kw)
         self.num_models = self.num_workers
         self.communication_window = window
+
+    def train(self, dataset, features_col=None, label_col=None,
+              eval_dataset=None):
+        if eval_dataset is not None:
+            raise ValueError(
+                "EnsembleTrainer returns k independent models; evaluate "
+                "them individually (ModelPredictor + AccuracyEvaluator) "
+                "rather than through eval_dataset")
+        return super().train(dataset, features_col=features_col,
+                             label_col=label_col)
 
     def _replica_states(self) -> TrainState:
         # Independent initializations per member, derived from the
