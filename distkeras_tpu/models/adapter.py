@@ -309,7 +309,7 @@ class ModelAdapter:
                 x = pre(x)
             preds, _ = model.stateless_call(tv, ntv, x, training=False)
             out = {"loss": loss_fn(y, preds)}
-            for name in names:  # names validated in __init__
+            if "accuracy" in names:  # names validated in __init__
                 labels = class_labels(y, preds)
                 if preds.shape[-1] == 1:
                     hit = (preds[..., 0] > 0).astype(jnp.int32) == labels

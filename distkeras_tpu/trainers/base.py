@@ -173,6 +173,8 @@ class Trainer(CheckpointingBase):
                     "Dataset.shard, so per-host evaluation would report "
                     "divergent metrics. Evaluate after training on one "
                     "host (ModelPredictor + AccuracyEvaluator).")
+            if len(eval_dataset) == 0:
+                raise ValueError("eval_dataset is empty")
             self._eval_batch = (eval_dataset[self.features_col],
                                 eval_dataset[self.label_col])
             self._eval_fn = jax.jit(self.adapter.make_eval_fn())

@@ -100,17 +100,36 @@ class ReplicaTrainer(DistributedTrainer):
         """Stack k host-side TrainStates into one [k, ...] pytree."""
         return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
 
+    def _n_local(self) -> int:
+        """Replicas this process owns (all of them single-process)."""
+        return self.num_workers // jax.process_count()
+
     def _replica_states(self) -> TrainState:
+        """The *local* replica stack ``[n_local, ...]``; single-process
+        that is the whole thing, multi-process each host builds only its
+        slice (assembled into the global array by :meth:`_put`)."""
         base = self.adapter.init_state()
         return jax.tree.map(
-            lambda a: jnp.broadcast_to(a[None], (self.num_workers,) + a.shape),
+            lambda a: jnp.broadcast_to(a[None], (self._n_local(),) + a.shape),
             base)
 
     def _put(self, stacked: TrainState, center_tv):
         repl_sh = NamedSharding(self.mesh, P("data"))
         rep = NamedSharding(self.mesh, P())
-        stacked = jax.tree.map(lambda a: jax.device_put(a, repl_sh), stacked)
-        center_tv = jax.device_put(center_tv, rep)
+        if jax.process_count() == 1:
+            stacked = jax.tree.map(
+                lambda a: jax.device_put(a, repl_sh), stacked)
+            return stacked, jax.device_put(center_tv, rep)
+        # Multi-process: each host contributes its local replicas' slab;
+        # the global [n, ...] array spans all hosts' devices.  The
+        # center variable is replicated from identical local copies.
+        n = self.num_workers
+        stacked = jax.tree.map(
+            lambda a: jax.make_array_from_process_local_data(
+                repl_sh, np.asarray(a), (n,) + tuple(a.shape[1:])), stacked)
+        center_tv = jax.tree.map(
+            lambda a: jax.make_array_from_process_local_data(
+                rep, np.asarray(a), tuple(a.shape)), center_tv)
         return stacked, center_tv
 
     def _eval_state_view(self, pytree):
@@ -155,8 +174,15 @@ class ReplicaTrainer(DistributedTrainer):
     # ------------------------------------------------------------ fit
 
     def _round_stream(self, dataset: Dataset, window: int):
-        """Yield [n, w, B, ...] stacks covering each epoch."""
-        n = self.num_workers
+        """Yield this host's [n_local, w, B, ...] stacks per epoch.
+
+        Single-process that is the full [n, w, B, ...] round; in the
+        multi-process runtime each host streams its ``Dataset.shard``
+        to its local replicas (replica ``h * n_local + i`` trains on
+        host h's i-th slab — document/construct shards accordingly when
+        exact replica assignment matters).
+        """
+        n = self._n_local()
         for _ in range(self.num_epoch):
             for xs, ys in dataset.batches(
                     self.batch_size, features_col=self.features_col,
@@ -169,18 +195,41 @@ class ReplicaTrainer(DistributedTrainer):
         return self.communication_window
 
     def _fit(self, dataset: Dataset):
-        if jax.process_count() > 1:
+        pcount = jax.process_count()
+        if pcount > 1 and self.num_workers % pcount:
             raise ValueError(
-                f"{type(self).__name__} does not support the multi-process "
-                "runtime yet: its stacked per-replica state is placed with "
-                "plain device_put, which cannot span non-addressable "
-                "devices. Use ADAG/DynSGD for multi-host data parallelism, "
-                "or run this trainer single-process.")
+                f"num_workers={self.num_workers} must divide by the "
+                f"process count ({pcount}): each host owns an equal "
+                "share of the replica stack")
         window = self._window(dataset)
         stacked = self._replica_states()
         center_tv = self.adapter.init_state().tv
         stacked, center_tv = self._put(stacked, center_tv)
         round_fn = self._make_round(window)
+        batch_sh = NamedSharding(self.mesh, P("data"))
+
+        def globalize(a):
+            if pcount == 1:
+                return a
+            return jax.make_array_from_process_local_data(
+                batch_sh, a, (self.num_workers,) + tuple(a.shape[1:]))
+
+        if pcount > 1:
+            # Every process must run the same number of rounds or the
+            # sync collective deadlocks; check before the loop (the
+            # allgather is itself collective but runs while all
+            # processes still agree).
+            from jax.experimental import multihost_utils
+
+            rows = self.batch_size * self._n_local() * window
+            local_rounds = (len(dataset) // rows) * self.num_epoch
+            all_rounds = [int(r) for r in multihost_utils.process_allgather(
+                np.asarray(local_rounds, np.int64))]
+            if len(set(all_rounds)) != 1:
+                raise ValueError(
+                    f"unequal round counts across processes: {all_rounds} "
+                    f"— every host's Dataset.shard must yield the same "
+                    f"number of {rows}-row windows; pad or trim shards")
 
         restored, start = self._restore_or(
             {"stacked": stacked, "center_tv": center_tv})
@@ -190,21 +239,25 @@ class ReplicaTrainer(DistributedTrainer):
             rnd += 1
             if rnd <= start:
                 continue
-            stacked, center_tv, loss = round_fn(stacked, center_tv, xs, ys)
+            stacked, center_tv, loss = round_fn(
+                stacked, center_tv, globalize(xs), globalize(ys))
             losses.append(loss)
             self._checkpoint({"stacked": stacked, "center_tv": center_tv}, rnd)
             self._eval_hook({"stacked": stacked, "center_tv": center_tv}, rnd)
         if losses or not start:  # resumed-past-the-end runs skip straight to export
             self._require_steps(
-                losses, self.batch_size * self.num_workers * window,
+                losses, self.batch_size * self._n_local() * window,
                 len(dataset))
             self._record(losses)
             self._checkpoint({"stacked": stacked, "center_tv": center_tv},
                              rnd, final=True)
         self._final_stacked = stacked  # kept for ensemble export
         # Export the center variable; aux state (BatchNorm stats etc.)
-        # taken from replica 0.
-        first = jax.tree.map(lambda a: a[0], stacked)
+        # taken from replica 0.  The slice is compiled with replicated
+        # output so every host can materialize it (an eager a[0] cannot
+        # read non-addressable shards in the multi-process runtime).
+        first = jax.jit(lambda s: jax.tree.map(lambda a: a[0], s),
+                        out_shardings=NamedSharding(self.mesh, P()))(stacked)
         return first.replace(tv=center_tv)
 
 
@@ -346,10 +399,14 @@ class EnsembleTrainer(ReplicaTrainer):
 
     def _replica_states(self) -> TrainState:
         # Independent initializations per member, derived from the
-        # trainer seed for reproducibility.
+        # trainer seed for reproducibility.  Seeds are keyed on the
+        # *global* member index, so a multi-process run initializes the
+        # same ensemble as a single-process one.
         states = []
         original = self.adapter.model.get_weights()
-        for i in range(self.num_workers):
+        host = jax.process_index()
+        nl = self._n_local()
+        for i in range(host * nl, (host + 1) * nl):
             seed = None if self.seed is None else self.seed + i
             self.adapter.model.set_weights(_reinit_weights(original, seed))
             states.append(self.adapter.init_state())
@@ -357,9 +414,18 @@ class EnsembleTrainer(ReplicaTrainer):
         return self._stack_state(states)
 
     def _export(self, state) -> list:
+        # Single-process: every shard is addressable, slice eagerly
+        # (holds one member at a time).  Multi-process: replicate the
+        # stack once (compiled all-gather) so every host can
+        # materialize every member — the per-device cost is the price
+        # of returning all k models on all hosts.
+        full = self._final_stacked
+        if jax.process_count() > 1:
+            full = jax.jit(lambda s: s,
+                           out_shardings=NamedSharding(self.mesh, P()))(full)
         models = []
         for i in range(self.num_workers):
-            st = jax.tree.map(lambda a: a[i], self._final_stacked)
+            st = jax.tree.map(lambda a: a[i], full)
             models.append(self.adapter.export_model(st))
         return models
 

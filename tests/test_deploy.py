@@ -173,3 +173,109 @@ def test_two_process_adag_matches_single_process(tmp_path, devices):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(got["losses"], np.asarray(t.history),
                                rtol=1e-4)
+
+
+MULTIHOST_ELASTIC_CHILD = """
+import os, sys
+os.environ["KERAS_BACKEND"] = "jax"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tests!r})
+from distkeras_tpu.deploy import init_from_env
+init_from_env()
+
+import numpy as np
+import distkeras_tpu as dk
+from helpers import make_blobs, make_mlp
+
+assert jax.process_count() == 2
+host = int(os.environ["DKT_HOST_ID"])
+
+x, y = make_blobs(n=512)
+# Exact replica assignment: single-process round r gives replica i the
+# rows block[r, i]; host h owns replicas [h*4, h*4+4), so its stream is
+# the same blocks restricted to its replica range, in round order.
+n, w, B = 8, 2, 8
+R = len(x) // (n * w * B)
+xb = x[:R*n*w*B].reshape(R, n, w*B, -1)
+yb = y[:R*n*w*B].reshape(R, n, w*B)
+nl = n // 2
+xh = xb[:, host*nl:(host+1)*nl].reshape(-1, x.shape[1])
+yh = yb[:, host*nl:(host+1)*nl].reshape(-1)
+ds = dk.Dataset.from_arrays(xh, yh)
+
+t = dk.DOWNPOUR(make_mlp(), loss="sparse_categorical_crossentropy",
+                worker_optimizer="sgd", learning_rate=0.05, batch_size=B,
+                communication_window=w, num_workers=n, num_epoch=1)
+trained = t.train(ds)
+assert len(t.history) == R, t.history
+if host == 0:
+    np.savez({out!r}, *[np.asarray(wt) for wt in trained.get_weights()],
+             losses=np.asarray(t.history))
+print("HOST", host, "OK", flush=True)
+"""
+
+
+def test_two_process_downpour_matches_single_process(tmp_path, devices):
+    """The replica-stacked elastic family on the real multi-process
+    runtime: per-host local replica slabs assembled into the global
+    stacked state, sync collective spanning both hosts.  With the
+    replica->host row assignment made explicit, the trained center must
+    equal the single-process run's bitwise-ish (same math, same order).
+    """
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests = os.path.join(repo, "tests")
+    out = str(tmp_path / "host0.npz")
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    job = Job(script="<inline>", num_hosts=2, coordinator=f"localhost:{port}")
+
+    procs = []
+    for h in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env.update(job.env_for(h))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             MULTIHOST_ELASTIC_CHILD.format(repo=repo, tests=tests, out=out)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    fail = []
+    for h, p in enumerate(procs):
+        try:
+            stdout, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        if p.returncode != 0:
+            fail.append(f"host {h} rc={p.returncode}\n"
+                        f"{stdout.decode(errors='replace')[-3000:]}")
+    assert not fail, "\n---\n".join(fail)
+
+    import distkeras_tpu as dk
+    from helpers import make_blobs, make_mlp
+
+    x, y = make_blobs(n=512)
+    t = dk.DOWNPOUR(make_mlp(), loss="sparse_categorical_crossentropy",
+                    worker_optimizer="sgd", learning_rate=0.05, batch_size=8,
+                    communication_window=2, num_workers=8, num_epoch=1)
+    ref = t.train(dk.Dataset.from_arrays(x, y))
+
+    got = np.load(out)
+    ref_w = [np.asarray(w) for w in ref.get_weights()]
+    got_w = [got[k] for k in got.files if k != "losses"]
+    assert len(ref_w) == len(got_w)
+    for a, b in zip(ref_w, got_w):
+        np.testing.assert_allclose(a, b, rtol=5e-5, atol=5e-6)
+    np.testing.assert_allclose(got["losses"], np.asarray(t.history),
+                               rtol=1e-5)
