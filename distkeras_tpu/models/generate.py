@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from distkeras_tpu.models.transformer import (
     TransformerConfig,
     _rms_norm,
+    rope_angles,
+    rope_rotate,
 )
 
 
@@ -39,8 +41,14 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     dtype = jnp.dtype(cfg.dtype)
     b = tokens.shape[0]
     x = params["tok_emb"][tokens].astype(dtype)  # [B, D]
-    x = x + jax.lax.dynamic_index_in_dim(
-        params["pos_emb"], pos, axis=0, keepdims=False).astype(dtype)
+    rope_ang = None
+    if cfg.rope:
+        # [half] angles for this single position; broadcasts over [B,H].
+        rope_ang = rope_angles(jnp.asarray(pos), cfg.head_dim,
+                               cfg.rope_theta)[None, None, :]
+    else:
+        x = x + jax.lax.dynamic_index_in_dim(
+            params["pos_emb"], pos, axis=0, keepdims=False).astype(dtype)
 
     new_cache_k, new_cache_v = [], []
     for i in range(cfg.n_layers):
@@ -51,6 +59,10 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
         # to f32; the cache stays in the compute dtype.
         k = jnp.einsum("bd,dhk->bhk", h, lp["attn"]["wk"])
         v = jnp.einsum("bd,dhk->bhk", h, lp["attn"]["wv"])
+        if rope_ang is not None:
+            # Keys cache post-rotation (each key's rotation depends only
+            # on its own position), matching the training forward.
+            q, k = rope_rotate(q, rope_ang), rope_rotate(k, rope_ang)
         ck = jax.lax.dynamic_update_index_in_dim(
             cache["k"][i], k.astype(cache["k"].dtype), pos, axis=1)
         cv = jax.lax.dynamic_update_index_in_dim(

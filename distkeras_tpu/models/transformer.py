@@ -50,6 +50,13 @@ class TransformerConfig:
     capacity_factor: float = 1.25
     aux_loss_coef: float = 0.01
     dtype: str = "float32"  # activation/compute dtype (bfloat16 on TPU)
+    # Rotary position embeddings (half-split rotation on q/k) instead of
+    # the learned pos_emb table: position information becomes relative
+    # inside attention, the standard long-context choice (no trained
+    # table capping usable length at max_len — max_len still bounds the
+    # decode KV cache).  Requires an even head_dim.
+    rope: bool = False
+    rope_theta: float = 10000.0
     # Rematerialize each block in the backward pass (jax.checkpoint):
     # activation memory drops from O(layers) to O(1) blocks at ~1/3 more
     # FLOPs — the standard long-context/deep-model trade on TPU, where
@@ -98,14 +105,21 @@ def init_params(rng, cfg: TransformerConfig):
             "w1": stack(keys[7], (d, f), d),
             "w2": stack(keys[8], (f, d), f),
         }
-    return {
+    params = {
         # Tied embedding/unembedding: std 1/sqrt(d) keeps initial logits
         # O(1) so the initial LM loss sits at ~ln(vocab).
         "tok_emb": _dense_init(keys[9], (cfg.vocab_size, d), d),
-        "pos_emb": _dense_init(keys[10], (cfg.max_len, d), 1.0) * 0.02,
         "ln_f_scale": jnp.ones((d,)),
         "layers": layers,
     }
+    if cfg.rope:
+        if hd % 2:
+            raise ValueError(
+                f"rope needs an even head_dim, got {hd} "
+                f"(d_model={d}, n_heads={h})")
+    else:
+        params["pos_emb"] = _dense_init(keys[10], (cfg.max_len, d), 1.0) * 0.02
+    return params
 
 
 def tp_rules():
@@ -130,7 +144,9 @@ def tp_rules():
 
 
 def _check_len(s: int, cfg: TransformerConfig) -> None:
-    if s > cfg.max_len:
+    # RoPE has no trained position table: any training length is valid
+    # (max_len only sizes the decode KV cache, models/generate.py).
+    if not cfg.rope and s > cfg.max_len:
         raise ValueError(
             f"sequence length {s} exceeds max_len={cfg.max_len} (note "
             "lm_loss feeds tokens[:, :-1], so token arrays may carry "
@@ -142,10 +158,30 @@ def _rms_norm(x, scale, eps=1e-6):
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
 
 
-def _attention_block(lp, x, attention_fn):
+def rope_angles(positions, head_dim: int, theta: float):
+    """Rotation angles ``[..., head_dim/2]`` for integer positions."""
+    half = head_dim // 2
+    inv = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    return positions.astype(jnp.float32)[..., None] * inv
+
+
+def rope_rotate(x, ang):
+    """Half-split rotary rotation of the last dim of ``x`` by ``ang``
+    (broadcastable to ``x[..., :half]``); f32 math, input dtype out."""
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    c, s = jnp.cos(ang), jnp.sin(ang)
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c],
+                           axis=-1).astype(x.dtype)
+
+
+def _attention_block(lp, x, attention_fn, rope_ang=None):
     q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"])
     k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
     v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
+    if rope_ang is not None:
+        q, k = rope_rotate(q, rope_ang), rope_rotate(k, rope_ang)
     out = attention_fn(q, k, v)
     return jnp.einsum("bshk,hkd->bsd", out, lp["wo"])
 
@@ -189,10 +225,15 @@ def _moe_block(lp, x, cfg: TransformerConfig):
 
 
 def block_apply(layer_params, x, cfg: TransformerConfig,
-                attention_fn: Callable):
-    """One transformer block (pre-norm).  Returns (x, aux_loss)."""
+                attention_fn: Callable, rope_ang=None):
+    """One transformer block (pre-norm).  Returns (x, aux_loss).
+
+    ``rope_ang`` is a *traced array* argument (not a closure) so the
+    remat wrapper's static_argnums stay (2, 3) — a callable closing
+    over traced angles would leak tracers through jax.checkpoint.
+    """
     h = _rms_norm(x, layer_params["ln1_scale"])
-    x = x + _attention_block(layer_params["attn"], h, attention_fn)
+    x = x + _attention_block(layer_params["attn"], h, attention_fn, rope_ang)
     h = _rms_norm(x, layer_params["ln2_scale"])
     if cfg.num_experts:
         y, aux = _moe_block(layer_params["moe"], h, cfg)
@@ -219,7 +260,12 @@ def apply(params, tokens, cfg: TransformerConfig,
     b, s = tokens.shape
     _check_len(s, cfg)
     x = params["tok_emb"][tokens].astype(dtype)
-    x = x + params["pos_emb"][:s][None].astype(dtype)
+    rope_ang = None
+    if cfg.rope:
+        rope_ang = rope_angles(jnp.arange(s), cfg.head_dim,
+                               cfg.rope_theta)[None, :, None, :]
+    else:
+        x = x + params["pos_emb"][:s][None].astype(dtype)
 
     aux_total = jnp.zeros((), jnp.float32)
 
@@ -232,7 +278,7 @@ def apply(params, tokens, cfg: TransformerConfig,
     # counts at this framework's scale compile fine unrolled.
     for i in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
-        x, aux = block(lp, x, cfg, attention_fn)
+        x, aux = block(lp, x, cfg, attention_fn, rope_ang)
         aux_total = aux_total + aux
 
     x = _rms_norm(x, params["ln_f_scale"])
@@ -291,7 +337,8 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
     b, s = tokens.shape
     _check_len(s, cfg)
     x = params["tok_emb"][tokens].astype(dtype)
-    x = x + params["pos_emb"][:s][None].astype(dtype)
+    if not cfg.rope:
+        x = x + params["pos_emb"][:s][None].astype(dtype)
 
     stage_params = jax.tree.map(
         lambda a: a.reshape(n_stages, per_stage, *a.shape[1:]),
@@ -301,11 +348,22 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
     if cfg.remat:
         block = jax.checkpoint(block_apply, static_argnums=(2, 3))
 
+    seq_sharded = x_spec != P()
+
     def stage_fn(lp, u):
+        rope_ang = None
+        if cfg.rope:
+            # Positions must be *global*: under PP x SP this body runs
+            # on a sequence shard, so offset by the shard's ring index.
+            l_loc = u.shape[1]
+            start = (jax.lax.axis_index(seq_axis) * l_loc
+                     if seq_sharded else 0)
+            rope_ang = rope_angles(start + jnp.arange(l_loc), cfg.head_dim,
+                                   cfg.rope_theta)[None, :, None, :]
         aux_stage = jnp.zeros((), jnp.float32)
         for i in range(per_stage):
             li = jax.tree.map(lambda a: a[i], lp)
-            u, aux = block(li, u, cfg, attention_fn)
+            u, aux = block(li, u, cfg, attention_fn, rope_ang)
             aux_stage = aux_stage + aux
         return u, aux_stage
 
@@ -317,6 +375,19 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
     return logits.astype(jnp.float32), aux_total
 
 
+def _forward_nll(params, tokens, cfg: TransformerConfig,
+                 attention_fn: Callable | None,
+                 apply_fn: Callable | None):
+    """(mean next-token NLL, aux) — shared by train loss and eval."""
+    if apply_fn is None:
+        apply_fn = lambda p, t: apply(p, t, cfg, attention_fn)
+    logits, aux = apply_fn(params, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+    return nll, aux
+
+
 def lm_loss(params, tokens, cfg: TransformerConfig,
             attention_fn: Callable | None = None,
             apply_fn: Callable | None = None):
@@ -326,13 +397,17 @@ def lm_loss(params, tokens, cfg: TransformerConfig,
     :func:`apply`; pass a closure over :func:`apply_pipelined` to train
     the pipelined trunk with the same loss.
     """
-    if apply_fn is None:
-        apply_fn = lambda p, t: apply(p, t, cfg, attention_fn)
-    logits, aux = apply_fn(params, tokens[:, :-1])
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+    nll, aux = _forward_nll(params, tokens, cfg, attention_fn, apply_fn)
     return nll + aux
+
+
+def lm_nll(params, tokens, cfg: TransformerConfig,
+           attention_fn: Callable | None = None,
+           apply_fn: Callable | None = None):
+    """Mean next-token NLL *without* the MoE aux regularizer — the
+    evaluation quantity (``exp`` of it is perplexity; the router load
+    penalty is a training device, not model quality)."""
+    return _forward_nll(params, tokens, cfg, attention_fn, apply_fn)[0]
 
 
 def make_train_step(cfg: TransformerConfig, optimizer,

@@ -59,7 +59,7 @@ class LMTrainer(CheckpointingBase):
                  num_epoch: int = 1, mesh=None, rules=None,
                  microbatches: int | None = None, fsdp: bool = False,
                  tokens_col: str = "tokens", seed: int = 0,
-                 shuffle: bool = False,
+                 shuffle: bool = False, eval_every: int = 0,
                  checkpoint_dir: str | None = None, checkpoint_every: int = 0,
                  max_checkpoints: int = 3, resume: bool = False):
         self.cfg = cfg
@@ -88,6 +88,10 @@ class LMTrainer(CheckpointingBase):
         self.seed = seed
         self.shuffle = shuffle
         self.history: list[float] = []
+        self.eval_every = eval_every
+        # [(round, {"loss", "perplexity"})]; loss here is pure NLL (no
+        # MoE aux), so exp(loss) is honest perplexity.
+        self.eval_history: list[tuple[int, dict]] = []
         self.training_time: float = 0.0
         self._setup_checkpointing(
             checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
@@ -124,12 +128,17 @@ class LMTrainer(CheckpointingBase):
                 seq_axis="seq" if n_seq > 1 else None)
             self._step_builder = lambda opt: tfm.make_train_step(
                 cfg, opt, apply_fn=apply_fn)
+            self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg,
+                                                   apply_fn=apply_fn)
         elif n_seq > 1:
             ring = make_ring_attention(self.mesh, causal=True)
             self._step_builder = lambda opt: tfm.make_train_step(
                 cfg, opt, attention_fn=ring)
+            self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg,
+                                                   attention_fn=ring)
         else:
             self._step_builder = lambda opt: tfm.make_train_step(cfg, opt)
+            self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg)
 
     # ------------------------------------------------------------------
 
@@ -154,8 +163,16 @@ class LMTrainer(CheckpointingBase):
                            opt_state, is_leaf=params_like)
         return psh, osh
 
-    def train(self, dataset: Dataset | np.ndarray, params=None):
-        """Train over the token rows; returns the trained params pytree."""
+    def train(self, dataset: Dataset | np.ndarray, params=None,
+              eval_tokens: np.ndarray | None = None):
+        """Train over the token rows; returns the trained params pytree.
+
+        ``eval_tokens [M, seq+1]`` (with ``eval_every``) runs a held-out
+        NLL/perplexity evaluation every ``eval_every`` optimizer steps
+        and once at the end (round -1) into ``eval_history``; fed in
+        ``batch_size`` chunks, dropping a remainder of up to
+        ``batch_size - 1`` rows (static shapes, one compiled program).
+        """
         tokens = (dataset if isinstance(dataset, np.ndarray)
                   else dataset[self.tokens_col])
         if tokens.ndim != 2:
@@ -185,6 +202,21 @@ class LMTrainer(CheckpointingBase):
 
             perm = np.random.default_rng(self.seed).permutation(len(tokens))
             tokens = gather_rows(np.ascontiguousarray(tokens), perm)
+
+        self.eval_history = []
+        if self.eval_every and eval_tokens is None:
+            raise ValueError("eval_every is set but train() got no "
+                             "eval_tokens")
+        if eval_tokens is not None:
+            if (eval_tokens.ndim != 2
+                    or eval_tokens.shape[1] != tokens.shape[1]):
+                raise ValueError(
+                    f"eval_tokens must be [M, {tokens.shape[1]}] like the "
+                    f"training rows, got {eval_tokens.shape}")
+            if len(eval_tokens) < global_bs:
+                raise ValueError(
+                    f"eval_tokens has {len(eval_tokens)} rows; one eval "
+                    f"batch needs {global_bs}")
 
         t0 = time.perf_counter()
         # Fail fast on a bad checkpoint_dir before paying parameter
@@ -217,6 +249,28 @@ class LMTrainer(CheckpointingBase):
             step = jax.jit(self._step_builder(self.optimizer),
                            donate_argnums=0, **jit_kw)
 
+            eval_fn = None
+            if eval_tokens is not None:
+                import math
+
+                nll = jax.jit(self._nll_fn)
+                n_eval = len(eval_tokens) - (len(eval_tokens) % global_bs)
+                # Stage the eval chunks once; every eval round reuses
+                # the device arrays instead of re-paying the transfer.
+                eval_chunks = [
+                    jax.device_put(
+                        np.asarray(eval_tokens[j:j + global_bs], np.int32),
+                        tok_sh)
+                    for j in range(0, n_eval, global_bs)]
+
+                def eval_fn(carry, rnd):
+                    ps = carry[0]
+                    mean = sum(float(nll(ps, c))
+                               for c in eval_chunks) / len(eval_chunks)
+                    ppl = math.exp(mean) if mean < 700 else float("inf")
+                    self.eval_history.append(
+                        (rnd, {"loss": mean, "perplexity": ppl}))
+
             carry, losses = (params, opt_state), []
             n_rows = len(tokens) - (len(tokens) % global_bs)
             if not n_rows:
@@ -235,8 +289,14 @@ class LMTrainer(CheckpointingBase):
                     carry, loss = step(carry, batch)
                     losses.append(loss)
                     self._checkpoint(carry, rnd)
+                    if (eval_fn is not None and self.eval_every
+                            and rnd % self.eval_every == 0):
+                        eval_fn(carry, rnd)
             if losses:
                 self._checkpoint(carry, rnd, final=True)
+            if eval_fn is not None and not (
+                    self.eval_history and self.eval_history[-1][0] == rnd):
+                eval_fn(carry, -1)  # final state not already evaluated
         finally:
             self._close_checkpoints()
         params, _ = carry

@@ -14,9 +14,12 @@ CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
 MOE_CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
                                 n_layers=1, d_ff=64, max_len=16,
                                 num_experts=4, capacity_factor=8.0)
+ROPE_CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, max_len=16, rope=True)
 
 
-@pytest.mark.parametrize("cfg", [CFG, MOE_CFG], ids=["dense", "moe"])
+@pytest.mark.parametrize("cfg", [CFG, MOE_CFG, ROPE_CFG],
+                         ids=["dense", "moe", "rope"])
 def test_cached_decode_matches_full_forward(rng, cfg):
     """Teacher-forcing through the cache == apply() at every position."""
     params = tfm.init_params(jax.random.key(0), cfg)
@@ -144,3 +147,17 @@ def test_generate_sampling_validation(rng):
     with pytest.raises(ValueError, match="top_p"):
         generate(params, prompt, CFG, 4, temperature=1.0, top_p=1.5,
                  key=jax.random.key(0))
+
+
+def test_generate_rope_greedy_matches_rollout(rng):
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=16, rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)).astype(np.int32))
+    out = generate(params, prompt, cfg, max_new_tokens=6)
+    seq = np.asarray(prompt)
+    for _ in range(6):
+        logits, _ = tfm.apply(params, jnp.asarray(seq), cfg)
+        nxt = np.asarray(logits[:, -1].argmax(-1), np.int32)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, seq)

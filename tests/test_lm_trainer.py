@@ -174,3 +174,60 @@ def test_lm_trainer_resume_matches_straight_run(tmp_path, devices, rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
     assert len(resumed.history) == len(straight.history) - len(first.history)
+
+
+def test_lm_eval_perplexity(devices, rng):
+    """Held-out NLL/perplexity every eval_every steps + at the end; the
+    eval loss is pure NLL so exp(loss) is an honest perplexity."""
+    import math
+
+    mesh = make_mesh(MeshSpec(data=8), devices=devices)
+    # Learnable structure shared by train and eval: cyclic sequences.
+    offs = rng.integers(0, 64, 96)
+    data = ((offs[:, None] + np.arange(17)) % 64).astype(np.int32)
+    t = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16, num_epoch=6,
+                     mesh=mesh, eval_every=4)
+    t.train(data[:64], eval_tokens=data[64:])
+    rounds = [r for r, _ in t.eval_history]
+    # Final state always evaluated: as -1 unless the last step already
+    # hit the eval_every cadence (24 steps / eval_every=4 does).
+    assert rounds[0] == 4 and rounds[-1] in (-1, len(t.history))
+    assert rounds.count(rounds[-1]) == 1  # no duplicate final eval
+    first, last = t.eval_history[0][1], t.eval_history[-1][1]
+    assert last["loss"] < first["loss"]
+    assert abs(last["perplexity"] - math.exp(last["loss"])) < 1e-9
+    # Vocab 64, random tokens: NLL can't beat ln(64) by much but must
+    # be finite and positive.
+    assert 0 < last["loss"] < 10
+
+
+def test_lm_eval_moe_excludes_aux(devices, rng):
+    """For MoE the eval loss must be below the training loss signal
+    that includes the router aux term (same params, same data)."""
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                num_experts=2, capacity_factor=2.0)
+    mesh = make_mesh(MeshSpec(data=2, expert=2), devices=devices[:4])
+    data = tokens(rng, n=48)
+    t = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=16, num_epoch=1,
+                     mesh=mesh)
+    params = t.train(data[:32], eval_tokens=data[:32])
+    # eval on the same rows the last step trained on: nll < nll + aux
+    import jax as _jax
+
+    full = float(_jax.jit(lambda p, tk: tfm.lm_loss(p, tk, cfg))(
+        params, data[:16].astype(np.int32)))
+    nll = float(_jax.jit(lambda p, tk: tfm.lm_nll(p, tk, cfg))(
+        params, data[:16].astype(np.int32)))
+    assert nll < full  # aux > 0 strictly separates them
+    assert t.eval_history and t.eval_history[-1][0] == -1
+
+
+def test_lm_eval_validation(devices, rng):
+    mesh = make_mesh(MeshSpec(data=8), devices=devices)
+    with pytest.raises(ValueError, match="eval_tokens"):
+        dk.LMTrainer(CFG, batch_size=16, mesh=mesh,
+                     eval_every=2).train(tokens(rng))
+    with pytest.raises(ValueError, match="eval batch"):
+        dk.LMTrainer(CFG, batch_size=16, mesh=mesh, eval_every=2).train(
+            tokens(rng), eval_tokens=tokens(rng, n=8))

@@ -150,3 +150,91 @@ def test_moe_train_step_learns(rng):
         carry, loss = step(carry, t)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+ROPE_CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, max_len=32, rope=True)
+
+
+def test_rope_params_have_no_pos_table():
+    params = tfm.init_params(jax.random.key(0), ROPE_CFG)
+    assert "pos_emb" not in params
+    with pytest.raises(ValueError, match="even head_dim"):
+        tfm.init_params(jax.random.key(0), tfm.TransformerConfig(
+            vocab_size=64, d_model=30, n_heads=2, n_layers=1, d_ff=64,
+            max_len=32, rope=True))
+
+
+def test_rope_forward_and_learning(rng):
+    params = tfm.init_params(jax.random.key(0), ROPE_CFG)
+    t = toks(rng)
+    out, _ = tfm.apply(params, jnp.asarray(t), ROPE_CFG)
+    assert out.shape == (4, 16, 64) and np.isfinite(np.asarray(out)).all()
+
+    opt = optax.adam(1e-2)
+    step = jax.jit(tfm.make_train_step(ROPE_CFG, opt))
+    carry = (params, opt.init(params))
+    data = jnp.asarray(toks(rng, b=16, s=16))
+    first = None
+    for _ in range(30):
+        carry, loss = step(carry, data)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first * 0.5
+
+
+def test_rope_relative_position_invariance(rng):
+    """With RoPE (no absolute table), causal attention over a prefix
+    placed at different absolute offsets gives identical logits for the
+    same relative context — the property a learned pos_emb cannot have.
+
+    Construct: logits at the last position of sequence [a, b, c]
+    must equal logits at the last position of [x, a, b, c] restricted
+    to attending only {a, b, c}... which plain causal attention does
+    not do; instead verify the cheap exact form: rotating *all*
+    positions by a constant offset leaves attention scores unchanged.
+    """
+    params = tfm.init_params(jax.random.key(0), ROPE_CFG)
+    q = jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)
+    base = tfm.rope_angles(jnp.arange(8), 16, 10000.0)[None, :, None, :]
+    off = tfm.rope_angles(jnp.arange(8) + 13, 16, 10000.0)[None, :, None, :]
+
+    def scores(ang):
+        qr, kr = tfm.rope_rotate(q, ang), tfm.rope_rotate(k, ang)
+        return jnp.einsum("bshk,bthk->bsht", qr, kr)
+
+    np.testing.assert_allclose(scores(base), scores(off),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_rope_ring_matches_single(devices, rng):
+    """SP: ring attention with global-position rotary == single-device."""
+    mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
+    params = tfm.init_params(jax.random.key(0), ROPE_CFG)
+    t = toks(rng)
+    ref, _ = tfm.apply(params, jnp.asarray(t), ROPE_CFG)
+    ring = make_ring_attention(mesh, causal=True)
+    out = _sharded_apply(params, t, ROPE_CFG, mesh, [], attention_fn=ring)
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_rope_pipelined_matches_single(devices, rng):
+    """PP and PP x SP: stage-local rotary offsets must reproduce the
+    un-pipelined forward exactly."""
+    mesh = make_mesh(MeshSpec(data=2, pipeline=2, seq=2), devices=devices)
+    params = tfm.init_params(jax.random.key(0), ROPE_CFG)
+    t = jnp.asarray(toks(rng, b=4, s=16))
+    ref, _ = tfm.apply(params, t, ROPE_CFG)
+    out, _ = jax.jit(lambda p, tk: tfm.apply_pipelined(
+        p, tk, ROPE_CFG, mesh, microbatches=2, seq_axis="seq"))(params, t)
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_rope_trains_past_max_len(rng):
+    """No position table -> training length is unbounded by max_len
+    (which only sizes the decode KV cache)."""
+    params = tfm.init_params(jax.random.key(0), ROPE_CFG)
+    long = jnp.asarray(toks(rng, b=2, s=ROPE_CFG.max_len * 2))
+    out, _ = tfm.apply(params, long, ROPE_CFG)
+    assert out.shape == (2, ROPE_CFG.max_len * 2, 64)
+    assert np.isfinite(np.asarray(out)).all()
