@@ -26,9 +26,14 @@ from distkeras_tpu.models.transformer import (
 
 
 def init_cache(cfg: TransformerConfig, batch: int, dtype=None):
-    """Per-layer KV buffers [L, B, max_len, H, head_dim]."""
+    """Per-layer KV buffers [L, B, max_len, kv_heads, head_dim].
+
+    Under GQA (cfg.n_kv_heads < n_heads) the cache carries only the
+    shared K/V heads — the n_heads/kv_heads memory and HBM-bandwidth
+    saving that is the point of GQA at decode time.
+    """
     dtype = dtype or jnp.dtype(cfg.dtype)
-    shape = (cfg.n_layers, batch, cfg.max_len, cfg.n_heads, cfg.head_dim)
+    shape = (cfg.n_layers, batch, cfg.max_len, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -70,13 +75,21 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
         new_cache_k.append(ck)
         new_cache_v.append(cv)
 
-        logits = jnp.einsum("bhk,bshk->bhs", q.astype(jnp.float32),
+        # GQA: grouped einsums read only the kv-head cache — never
+        # materialize an expanded per-query-head copy (that repeat
+        # would forfeit the cache-bandwidth saving that is GQA's point).
+        groups = cfg.n_heads // cfg.kv_heads
+        qg = q.astype(jnp.float32).reshape(
+            b, cfg.kv_heads, groups, cfg.head_dim)
+        logits = jnp.einsum("bcgk,bsck->bcgs", qg,
                             ck.astype(jnp.float32))
         logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
-        mask = jnp.arange(cfg.max_len)[None, None, :] <= pos
+        mask = jnp.arange(cfg.max_len)[None, None, None, :] <= pos
         logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("bhs,bshk->bhk", probs, cv.astype(jnp.float32))
+        attn = jnp.einsum("bcgs,bsck->bcgk", probs,
+                          cv.astype(jnp.float32)).reshape(
+            b, cfg.n_heads, cfg.head_dim)
         x = x + jnp.einsum("bhk,hkd->bd", attn.astype(dtype),
                            lp["attn"]["wo"])
 

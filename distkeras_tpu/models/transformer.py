@@ -57,6 +57,12 @@ class TransformerConfig:
     # decode KV cache).  Requires an even head_dim.
     rope: bool = False
     rope_theta: float = 10000.0
+    # Grouped-query attention: fewer K/V heads than Q heads (None =
+    # n_heads = vanilla MHA; 1 = multi-query).  Shrinks the decode KV
+    # cache and its HBM traffic by n_heads/n_kv_heads; K/V are repeated
+    # to full heads for the attention kernels (training compute
+    # unchanged, the cache is the win).
+    n_kv_heads: int | None = None
     # Rematerialize each block in the backward pass (jax.checkpoint):
     # activation memory drops from O(layers) to O(1) blocks at ~1/3 more
     # FLOPs — the standard long-context/deep-model trade on TPU, where
@@ -67,6 +73,14 @@ class TransformerConfig:
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+        if not 1 <= kv <= self.n_heads or self.n_heads % kv:
+            raise ValueError(
+                f"n_kv_heads={kv} must divide n_heads={self.n_heads}")
+        return kv
 
 
 def _dense_init(rng, shape, fan_in):
@@ -79,6 +93,7 @@ def init_params(rng, cfg: TransformerConfig):
     """
     keys = jax.random.split(rng, 12)
     d, f, h, hd = cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.head_dim
+    kv = cfg.kv_heads
     L = cfg.n_layers
 
     def stack(key, shape, fan_in):
@@ -89,8 +104,8 @@ def init_params(rng, cfg: TransformerConfig):
         "ln2_scale": jnp.ones((L, d)),
         "attn": {
             "wq": stack(keys[0], (d, h, hd), d),
-            "wk": stack(keys[1], (d, h, hd), d),
-            "wv": stack(keys[2], (d, h, hd), d),
+            "wk": stack(keys[1], (d, kv, hd), d),
+            "wv": stack(keys[2], (d, kv, hd), d),
             "wo": stack(keys[3], (h, hd, d), d),
         },
     }
@@ -176,12 +191,15 @@ def rope_rotate(x, ang):
                            axis=-1).astype(x.dtype)
 
 
-def _attention_block(lp, x, attention_fn, rope_ang=None):
+def _attention_block(lp, x, attention_fn, rope_ang=None, kv_groups=1):
     q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"])
     k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
     v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
     if rope_ang is not None:
         q, k = rope_rotate(q, rope_ang), rope_rotate(k, rope_ang)
+    if kv_groups > 1:  # GQA: expand shared K/V heads for the kernel
+        k = jnp.repeat(k, kv_groups, axis=2)
+        v = jnp.repeat(v, kv_groups, axis=2)
     out = attention_fn(q, k, v)
     return jnp.einsum("bshk,hkd->bsd", out, lp["wo"])
 
@@ -233,7 +251,8 @@ def block_apply(layer_params, x, cfg: TransformerConfig,
     over traced angles would leak tracers through jax.checkpoint.
     """
     h = _rms_norm(x, layer_params["ln1_scale"])
-    x = x + _attention_block(layer_params["attn"], h, attention_fn, rope_ang)
+    x = x + _attention_block(layer_params["attn"], h, attention_fn, rope_ang,
+                             kv_groups=cfg.n_heads // cfg.kv_heads)
     h = _rms_norm(x, layer_params["ln2_scale"])
     if cfg.num_experts:
         y, aux = _moe_block(layer_params["moe"], h, cfg)
@@ -412,16 +431,36 @@ def lm_nll(params, tokens, cfg: TransformerConfig,
 
 def make_train_step(cfg: TransformerConfig, optimizer,
                     attention_fn: Callable | None = None,
-                    apply_fn: Callable | None = None):
+                    apply_fn: Callable | None = None,
+                    grad_accum: int = 1):
     """``step((params, opt_state), tokens) -> ((params', opt_state'), loss)``.
 
     Pure; callers jit it with NamedShardings (see __graft_entry__ and
-    the trainers).
+    the trainers).  With ``grad_accum > 1``, ``tokens`` is
+    ``[grad_accum, B, S+1]``: gradients accumulate over the microbatches
+    and one optimizer update applies their mean — the memory lever for
+    batch sizes whose activations do not fit HBM (the LM analogue of
+    the Keras family's ``communication_window``, SURVEY.md §7.4).  The
+    microbatch loop is unrolled, not scanned: attention_fn may close
+    over shard_map/pallas calls whose tracing under scan complicates
+    sharding (same reason apply() unrolls its layer loop).
     """
     def step(carry, tokens):
         params, opt_state = carry
-        loss, grads = jax.value_and_grad(lm_loss)(
-            params, tokens, cfg, attention_fn, apply_fn)
+        grad_fn = jax.value_and_grad(lm_loss)
+        if grad_accum == 1:
+            loss, grads = grad_fn(params, tokens, cfg, attention_fn,
+                                  apply_fn)
+        else:
+            grads = jax.tree.map(jnp.zeros_like, params)
+            loss = jnp.zeros((), jnp.float32)
+            for i in range(grad_accum):
+                li, gi = grad_fn(params, tokens[i], cfg, attention_fn,
+                                 apply_fn)
+                grads = jax.tree.map(jnp.add, grads, gi)
+                loss = loss + li
+            grads = jax.tree.map(lambda g: g / grad_accum, grads)
+            loss = loss / grad_accum
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return (params, opt_state), loss

@@ -58,6 +58,7 @@ class LMTrainer(CheckpointingBase):
                  learning_rate: float = 3e-4, batch_size: int = 8,
                  num_epoch: int = 1, mesh=None, rules=None,
                  microbatches: int | None = None, fsdp: bool = False,
+                 grad_accum: int = 1, grad_clip_norm: float | None = None,
                  tokens_col: str = "tokens", seed: int = 0,
                  shuffle: bool = False, eval_every: int = 0,
                  checkpoint_dir: str | None = None, checkpoint_every: int = 0,
@@ -77,6 +78,17 @@ class LMTrainer(CheckpointingBase):
                 raise ValueError(
                     f"unknown optimizer {optimizer!r}; known: {sorted(_OPTS)} "
                     "(or pass an optax factory / GradientTransformation)")
+        if grad_clip_norm is not None:
+            if grad_clip_norm <= 0:
+                raise ValueError(
+                    f"grad_clip_norm must be positive, got {grad_clip_norm}")
+            self.optimizer = optax.chain(
+                optax.clip_by_global_norm(grad_clip_norm), self.optimizer)
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        self.grad_accum = grad_accum
+        if eval_every < 0:
+            raise ValueError(f"eval_every must be >= 0, got {eval_every}")
         self.batch_size = batch_size
         self.num_epoch = num_epoch
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -107,6 +119,15 @@ class LMTrainer(CheckpointingBase):
                 "all five, sized 1 when unused)")
         n_pipe = int(self.mesh.shape["pipeline"])
         n_seq = int(self.mesh.shape["seq"])
+        n_model = int(self.mesh.shape["model"])
+        if (n_model > 1 and rules is None and cfg.n_kv_heads is not None
+                and cfg.kv_heads % n_model):
+            raise ValueError(
+                f"GQA with Megatron TP: n_kv_heads={cfg.kv_heads} must "
+                f"divide by the mesh model axis ({n_model}) — the default "
+                "tp_rules shard K/V projections over their head "
+                "dimension. Use more KV heads, a smaller model axis, or "
+                "custom rules.")
         if fsdp and n_pipe > 1:
             raise ValueError(
                 "fsdp=True cannot compose with a pipeline axis > 1: the "
@@ -127,17 +148,18 @@ class LMTrainer(CheckpointingBase):
                 p, t, cfg, self.mesh, microbatches=self.microbatches,
                 seq_axis="seq" if n_seq > 1 else None)
             self._step_builder = lambda opt: tfm.make_train_step(
-                cfg, opt, apply_fn=apply_fn)
+                cfg, opt, apply_fn=apply_fn, grad_accum=grad_accum)
             self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg,
                                                    apply_fn=apply_fn)
         elif n_seq > 1:
             ring = make_ring_attention(self.mesh, causal=True)
             self._step_builder = lambda opt: tfm.make_train_step(
-                cfg, opt, attention_fn=ring)
+                cfg, opt, attention_fn=ring, grad_accum=grad_accum)
             self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg,
                                                    attention_fn=ring)
         else:
-            self._step_builder = lambda opt: tfm.make_train_step(cfg, opt)
+            self._step_builder = lambda opt: tfm.make_train_step(
+                cfg, opt, grad_accum=grad_accum)
             self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg)
 
     # ------------------------------------------------------------------
@@ -235,6 +257,10 @@ class LMTrainer(CheckpointingBase):
             psh, osh = self._state_shardings(params, opt_state)
             opt_state = jax.device_put(opt_state, osh)
             tok_sh = NamedSharding(self.mesh, P("data", None))
+            # With accumulation the fed block is [accum, B, S+1]: the
+            # microbatch axis leads, batch still shards over data.
+            step_sh = (tok_sh if self.grad_accum == 1
+                       else NamedSharding(self.mesh, P(None, "data", None)))
             jit_kw = {}
             if int(self.mesh.shape["pipeline"]) == 1:
                 # Pin the carry layout so XLA keeps the plan's placement
@@ -243,7 +269,7 @@ class LMTrainer(CheckpointingBase):
                 # The pipelined trunk is exempt: its manual shard_map
                 # governs placement internally.
                 jit_kw = dict(
-                    in_shardings=((psh, osh), tok_sh),
+                    in_shardings=((psh, osh), step_sh),
                     out_shardings=((psh, osh),
                                    NamedSharding(self.mesh, P())))
             step = jax.jit(self._step_builder(self.optimizer),
@@ -272,20 +298,24 @@ class LMTrainer(CheckpointingBase):
                         (rnd, {"loss": mean, "perplexity": ppl}))
 
             carry, losses = (params, opt_state), []
-            n_rows = len(tokens) - (len(tokens) % global_bs)
+            rows_per_step = global_bs * self.grad_accum
+            n_rows = len(tokens) - (len(tokens) % rows_per_step)
             if not n_rows:
                 raise ValueError(
                     f"dataset has {len(tokens)} rows; one step needs "
-                    f"{global_bs}")
+                    f"{rows_per_step} (batch_size x grad_accum)")
             carry, start = self._restore_or(carry)
             rnd = 0
             for _ in range(self.num_epoch):
-                for i in range(0, n_rows, global_bs):
+                for i in range(0, n_rows, rows_per_step):
                     rnd += 1
                     if rnd <= start:
                         continue
-                    batch = jax.device_put(
-                        np.asarray(tokens[i:i + global_bs], np.int32), tok_sh)
+                    block = np.asarray(tokens[i:i + rows_per_step], np.int32)
+                    if self.grad_accum > 1:
+                        block = block.reshape(self.grad_accum, global_bs,
+                                              block.shape[1])
+                    batch = jax.device_put(block, step_sh)
                     carry, loss = step(carry, batch)
                     losses.append(loss)
                     self._checkpoint(carry, rnd)

@@ -150,8 +150,7 @@ def test_generate_sampling_validation(rng):
 
 
 def test_generate_rope_greedy_matches_rollout(rng):
-    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
-                                n_layers=2, d_ff=64, max_len=16, rope=True)
+    cfg = ROPE_CFG
     params = tfm.init_params(jax.random.key(0), cfg)
     prompt = jnp.asarray(rng.integers(0, 64, (2, 4)).astype(np.int32))
     out = generate(params, prompt, cfg, max_new_tokens=6)
@@ -161,3 +160,18 @@ def test_generate_rope_greedy_matches_rollout(rng):
         nxt = np.asarray(logits[:, -1].argmax(-1), np.int32)
         seq = np.concatenate([seq, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(out, seq)
+
+
+def test_gqa_cache_is_smaller_and_decode_matches(rng):
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_len=16,
+                                n_kv_heads=1, rope=True)
+    cache = init_cache(cfg, batch=2)
+    assert cache["k"].shape == (2, 2, 16, 1, 8)  # 1 kv head, not 4
+    params = tfm.init_params(jax.random.key(0), cfg)
+    toks_ = jnp.asarray(rng.integers(0, 64, (2, 10)).astype(np.int32))
+    full_logits, _ = tfm.apply(params, toks_, cfg)
+    for pos in range(10):
+        logits, cache = _decode_step(params, cache, toks_[:, pos], pos, cfg)
+        np.testing.assert_allclose(logits, full_logits[:, pos],
+                                   atol=2e-4, rtol=2e-4)

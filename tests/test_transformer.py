@@ -238,3 +238,55 @@ def test_rope_trains_past_max_len(rng):
     out, _ = tfm.apply(params, long, ROPE_CFG)
     assert out.shape == (2, ROPE_CFG.max_len * 2, 64)
     assert np.isfinite(np.asarray(out)).all()
+
+
+GQA_CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_len=32,
+                                n_kv_heads=2)
+
+
+def test_gqa_shapes_and_learning(rng):
+    params = tfm.init_params(jax.random.key(0), GQA_CFG)
+    assert params["layers"]["attn"]["wk"].shape == (2, 32, 2, 8)
+    assert params["layers"]["attn"]["wq"].shape == (2, 32, 4, 8)
+    out, _ = tfm.apply(params, jnp.asarray(toks(rng)), GQA_CFG)
+    assert out.shape == (4, 16, 64) and np.isfinite(np.asarray(out)).all()
+
+    opt = optax.adam(1e-2)
+    step = jax.jit(tfm.make_train_step(GQA_CFG, opt))
+    carry = (params, opt.init(params))
+    data = jnp.asarray(toks(rng, b=16, s=16))
+    first = None
+    for _ in range(30):
+        carry, loss = step(carry, data)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first * 0.5
+
+
+def test_gqa_equals_mha_when_kv_heads_full(rng):
+    """n_kv_heads == n_heads must be bit-identical to the default."""
+    full = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, max_len=32,
+                                 n_kv_heads=2)
+    p1 = tfm.init_params(jax.random.key(0), CFG)
+    p2 = tfm.init_params(jax.random.key(0), full)
+    t = jnp.asarray(toks(rng))
+    np.testing.assert_array_equal(tfm.apply(p1, t, CFG)[0],
+                                  tfm.apply(p2, t, full)[0])
+
+
+def test_gqa_validation():
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        tfm.init_params(jax.random.key(0), tfm.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+            max_len=32, n_kv_heads=3))
+
+
+def test_gqa_ring_matches_single(devices, rng):
+    mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
+    params = tfm.init_params(jax.random.key(0), GQA_CFG)
+    t = toks(rng)
+    ref, _ = tfm.apply(params, jnp.asarray(t), GQA_CFG)
+    ring = make_ring_attention(mesh, causal=True)
+    out = _sharded_apply(params, t, GQA_CFG, mesh, [], attention_fn=ring)
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
