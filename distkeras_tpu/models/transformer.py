@@ -57,6 +57,12 @@ class TransformerConfig:
     # decode KV cache).  Requires an even head_dim.
     rope: bool = False
     rope_theta: float = 10000.0
+    # Residual dropout rate (embedding, attention output, FFN/MoE
+    # output).  Active only when a dropout_rng is supplied (training);
+    # inference and eval are always deterministic.  Not supported under
+    # pipeline parallelism (the compiled tick schedule has no
+    # per-microbatch rng stream) — LMTrainer rejects the combination.
+    dropout: float = 0.0
     # Grouped-query attention: fewer K/V heads than Q heads (None =
     # n_heads = vanilla MHA; 1 = multi-query).  Shrinks the decode KV
     # cache and its HBM traffic by n_heads/n_kv_heads; K/V are repeated
@@ -168,6 +174,12 @@ def _check_len(s: int, cfg: TransformerConfig) -> None:
             "max_len + 1 positions)")
 
 
+def _dropout(x, rate: float, key):
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0).astype(x.dtype)
+
+
 def _rms_norm(x, scale, eps=1e-6):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
@@ -243,16 +255,20 @@ def _moe_block(lp, x, cfg: TransformerConfig):
 
 
 def block_apply(layer_params, x, cfg: TransformerConfig,
-                attention_fn: Callable, rope_ang=None):
+                attention_fn: Callable, rope_ang=None, drop_key=None):
     """One transformer block (pre-norm).  Returns (x, aux_loss).
 
-    ``rope_ang`` is a *traced array* argument (not a closure) so the
-    remat wrapper's static_argnums stay (2, 3) — a callable closing
-    over traced angles would leak tracers through jax.checkpoint.
+    ``rope_ang`` and ``drop_key`` are *traced array* arguments (not
+    closures) so the remat wrapper's static_argnums stay (2, 3) — a
+    callable closing over traced values would leak tracers through
+    jax.checkpoint.  ``drop_key`` non-None enables residual dropout.
     """
     h = _rms_norm(x, layer_params["ln1_scale"])
-    x = x + _attention_block(layer_params["attn"], h, attention_fn, rope_ang,
-                             kv_groups=cfg.n_heads // cfg.kv_heads)
+    a = _attention_block(layer_params["attn"], h, attention_fn, rope_ang,
+                         kv_groups=cfg.n_heads // cfg.kv_heads)
+    if drop_key is not None:
+        a = _dropout(a, cfg.dropout, jax.random.fold_in(drop_key, 0))
+    x = x + a
     h = _rms_norm(x, layer_params["ln2_scale"])
     if cfg.num_experts:
         y, aux = _moe_block(layer_params["moe"], h, cfg)
@@ -262,16 +278,20 @@ def block_apply(layer_params, x, cfg: TransformerConfig,
             jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, layer_params["ffn"]["w1"])),
             layer_params["ffn"]["w2"])
         aux = jnp.zeros((), jnp.float32)
+    if drop_key is not None:
+        y = _dropout(y, cfg.dropout, jax.random.fold_in(drop_key, 1))
     return x + y, aux
 
 
 def apply(params, tokens, cfg: TransformerConfig,
-          attention_fn: Callable | None = None):
+          attention_fn: Callable | None = None, dropout_rng=None):
     """Forward pass: tokens [B, S] int32 -> logits [B, S, V].
 
     ``attention_fn(q, k, v) -> out`` defaults to causal flash attention
     (Pallas on TPU); pass a ``make_ring_attention(...)`` wrapper for
-    sequence parallelism.  Returns (logits, aux_loss).
+    sequence parallelism.  ``dropout_rng`` non-None (with cfg.dropout
+    > 0) enables training dropout; omit it for deterministic
+    inference/eval.  Returns (logits, aux_loss).
     """
     if attention_fn is None:
         attention_fn = lambda q, k, v: flash_attention(q, k, v, True)
@@ -285,6 +305,11 @@ def apply(params, tokens, cfg: TransformerConfig,
                                cfg.rope_theta)[None, :, None, :]
     else:
         x = x + params["pos_emb"][:s][None].astype(dtype)
+    dropping = cfg.dropout > 0 and dropout_rng is not None
+    if dropping:
+        # fold_in index n_layers: disjoint from the per-layer keys 0..L-1.
+        x = _dropout(x, cfg.dropout,
+                     jax.random.fold_in(dropout_rng, cfg.n_layers))
 
     aux_total = jnp.zeros((), jnp.float32)
 
@@ -297,7 +322,9 @@ def apply(params, tokens, cfg: TransformerConfig,
     # counts at this framework's scale compile fine unrolled.
     for i in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
-        x, aux = block(lp, x, cfg, attention_fn, rope_ang)
+        drop_key = (jax.random.fold_in(dropout_rng, i) if dropping
+                    else None)
+        x, aux = block(lp, x, cfg, attention_fn, rope_ang, drop_key)
         aux_total = aux_total + aux
 
     x = _rms_norm(x, params["ln_f_scale"])
@@ -409,13 +436,22 @@ def _forward_nll(params, tokens, cfg: TransformerConfig,
 
 def lm_loss(params, tokens, cfg: TransformerConfig,
             attention_fn: Callable | None = None,
-            apply_fn: Callable | None = None):
+            apply_fn: Callable | None = None, dropout_rng=None):
     """Next-token cross-entropy (+ MoE aux), mean over B*(S-1) targets.
 
     ``apply_fn(params, inputs) -> (logits, aux)`` defaults to
     :func:`apply`; pass a closure over :func:`apply_pipelined` to train
     the pipelined trunk with the same loss.
     """
+    if dropout_rng is not None:
+        if apply_fn is not None:
+            raise ValueError(
+                "dropout_rng only threads through the default apply(); "
+                "a custom apply_fn (e.g. the pipelined trunk) must take "
+                "its own rng — pipeline parallelism does not support "
+                "dropout (see TransformerConfig.dropout)")
+        apply_fn = lambda p, t: apply(p, t, cfg, attention_fn,
+                                      dropout_rng=dropout_rng)
     nll, aux = _forward_nll(params, tokens, cfg, attention_fn, apply_fn)
     return nll + aux
 
@@ -445,18 +481,22 @@ def make_train_step(cfg: TransformerConfig, optimizer,
     over shard_map/pallas calls whose tracing under scan complicates
     sharding (same reason apply() unrolls its layer loop).
     """
-    def step(carry, tokens):
+    dropping = cfg.dropout > 0
+
+    def step(carry, tokens, dropout_rng=None):
         params, opt_state = carry
         grad_fn = jax.value_and_grad(lm_loss)
+        rng = dropout_rng if dropping else None
         if grad_accum == 1:
             loss, grads = grad_fn(params, tokens, cfg, attention_fn,
-                                  apply_fn)
+                                  apply_fn, rng)
         else:
             grads = jax.tree.map(jnp.zeros_like, params)
             loss = jnp.zeros((), jnp.float32)
             for i in range(grad_accum):
+                ri = jax.random.fold_in(rng, i) if rng is not None else None
                 li, gi = grad_fn(params, tokens[i], cfg, attention_fn,
-                                 apply_fn)
+                                 apply_fn, ri)
                 grads = jax.tree.map(jnp.add, grads, gi)
                 loss = loss + li
             grads = jax.tree.map(lambda g: g / grad_accum, grads)

@@ -128,6 +128,13 @@ class LMTrainer(CheckpointingBase):
                 "tp_rules shard K/V projections over their head "
                 "dimension. Use more KV heads, a smaller model axis, or "
                 "custom rules.")
+        if cfg.dropout > 0 and n_pipe > 1:
+            raise ValueError(
+                "cfg.dropout > 0 cannot compose with a pipeline axis > 1: "
+                "the pipeline's tick schedule is compiled without a "
+                "per-microbatch rng stream (TransformerConfig.dropout). "
+                "Train with dropout on a dp/tp/sp/fsdp mesh, or drop the "
+                "regularizer under PP.")
         if fsdp and n_pipe > 1:
             raise ValueError(
                 "fsdp=True cannot compose with a pipeline axis > 1: the "
@@ -261,6 +268,8 @@ class LMTrainer(CheckpointingBase):
             # microbatch axis leads, batch still shards over data.
             step_sh = (tok_sh if self.grad_accum == 1
                        else NamedSharding(self.mesh, P(None, "data", None)))
+            rep = NamedSharding(self.mesh, P())
+            dropping = self.cfg.dropout > 0
             jit_kw = {}
             if int(self.mesh.shape["pipeline"]) == 1:
                 # Pin the carry layout so XLA keeps the plan's placement
@@ -268,12 +277,15 @@ class LMTrainer(CheckpointingBase):
                 # across steps instead of resharding at its own whim.
                 # The pipelined trunk is exempt: its manual shard_map
                 # governs placement internally.
-                jit_kw = dict(
-                    in_shardings=((psh, osh), step_sh),
-                    out_shardings=((psh, osh),
-                                   NamedSharding(self.mesh, P())))
+                in_sh = ((psh, osh), step_sh) + ((rep,) if dropping else ())
+                jit_kw = dict(in_shardings=in_sh,
+                              out_shardings=((psh, osh), rep))
             step = jax.jit(self._step_builder(self.optimizer),
                            donate_argnums=0, **jit_kw)
+            # Dropout stream keyed on the optimizer round: resume from a
+            # checkpoint replays the identical mask sequence.
+            drop_base = (jax.random.key(self.seed + 0x5eed)
+                         if dropping else None)
 
             eval_fn = None
             if eval_tokens is not None:
@@ -316,7 +328,11 @@ class LMTrainer(CheckpointingBase):
                         block = block.reshape(self.grad_accum, global_bs,
                                               block.shape[1])
                     batch = jax.device_put(block, step_sh)
-                    carry, loss = step(carry, batch)
+                    if dropping:
+                        carry, loss = step(
+                            carry, batch, jax.random.fold_in(drop_base, rnd))
+                    else:
+                        carry, loss = step(carry, batch)
                     losses.append(loss)
                     self._checkpoint(carry, rnd)
                     if (eval_fn is not None and self.eval_every

@@ -162,12 +162,15 @@ def test_generate_rope_greedy_matches_rollout(rng):
     np.testing.assert_array_equal(out, seq)
 
 
-def test_gqa_cache_is_smaller_and_decode_matches(rng):
+@pytest.mark.parametrize("kv", [1, 2])
+def test_gqa_cache_is_smaller_and_decode_matches(rng, kv):
+    """kv=2 exercises the group->kv-head mapping proper (kv=1/MQA is
+    grouping-invariant and would mask a reshape-order regression)."""
     cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
                                 n_layers=2, d_ff=64, max_len=16,
-                                n_kv_heads=1, rope=True)
+                                n_kv_heads=kv, rope=True)
     cache = init_cache(cfg, batch=2)
-    assert cache["k"].shape == (2, 2, 16, 1, 8)  # 1 kv head, not 4
+    assert cache["k"].shape == (2, 2, 16, kv, 8)
     params = tfm.init_params(jax.random.key(0), cfg)
     toks_ = jnp.asarray(rng.integers(0, 64, (2, 10)).astype(np.int32))
     full_logits, _ = tfm.apply(params, toks_, cfg)

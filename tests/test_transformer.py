@@ -290,3 +290,38 @@ def test_gqa_ring_matches_single(devices, rng):
     ring = make_ring_attention(mesh, causal=True)
     out = _sharded_apply(params, t, GQA_CFG, mesh, [], attention_fn=ring)
     np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+DROP_CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, max_len=32,
+                                 dropout=0.2)
+
+
+def test_dropout_deterministic_per_key_and_off_without_rng(rng):
+    params = tfm.init_params(jax.random.key(0), DROP_CFG)
+    t = jnp.asarray(toks(rng))
+    # No rng -> deterministic inference even with cfg.dropout > 0.
+    a, _ = tfm.apply(params, t, DROP_CFG)
+    b, _ = tfm.apply(params, t, DROP_CFG)
+    np.testing.assert_array_equal(a, b)
+    # Same key -> same masks; different key -> different activations.
+    k1, k2 = jax.random.key(1), jax.random.key(2)
+    d1, _ = tfm.apply(params, t, DROP_CFG, dropout_rng=k1)
+    d1b, _ = tfm.apply(params, t, DROP_CFG, dropout_rng=k1)
+    d2, _ = tfm.apply(params, t, DROP_CFG, dropout_rng=k2)
+    np.testing.assert_array_equal(d1, d1b)
+    assert not np.array_equal(np.asarray(d1), np.asarray(d2))
+    assert not np.array_equal(np.asarray(a), np.asarray(d1))
+
+
+def test_dropout_training_learns(rng):
+    params = tfm.init_params(jax.random.key(0), DROP_CFG)
+    opt = optax.adam(1e-2)
+    step = jax.jit(tfm.make_train_step(DROP_CFG, opt))
+    carry = (params, opt.init(params))
+    data = jnp.asarray(toks(rng, b=16, s=16))
+    first = None
+    for i in range(30):
+        carry, loss = step(carry, data, jax.random.key(i))
+        first = first if first is not None else float(loss)
+    assert float(loss) < first * 0.6
