@@ -97,6 +97,8 @@ def init_params(rng, cfg: TransformerConfig):
     """Build the parameter pytree.  Per-layer params are stacked on a
     leading [n_layers] axis (scan/pipeline-friendly: one tree, L-major).
     """
+    if not 0.0 <= cfg.dropout < 1.0:
+        raise ValueError(f"dropout must be in [0, 1), got {cfg.dropout}")
     keys = jax.random.split(rng, 12)
     d, f, h, hd = cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.head_dim
     kv = cfg.kv_heads
@@ -486,6 +488,12 @@ def make_train_step(cfg: TransformerConfig, optimizer,
     def step(carry, tokens, dropout_rng=None):
         params, opt_state = carry
         grad_fn = jax.value_and_grad(lm_loss)
+        if dropping and dropout_rng is None:
+            raise ValueError(
+                f"cfg.dropout={cfg.dropout} but the train step got no "
+                "dropout_rng: pass step(carry, tokens, rng) or training "
+                "silently runs unregularized (LMTrainer threads the rng "
+                "automatically)")
         rng = dropout_rng if dropping else None
         if grad_accum == 1:
             loss, grads = grad_fn(params, tokens, cfg, attention_fn,

@@ -210,6 +210,17 @@ def bench_transformer_long():
     return _measure_lm(_long_cfg(), batch=8, seq=4096, iters=20)
 
 
+def bench_transformer_long_rope():
+    """Long config with rotary positions + grouped-query attention (the
+    modern long-context layout; rope/GQA cost vs the learned-table MHA
+    baseline is the interesting delta)."""
+    import dataclasses
+
+    return _measure_lm(
+        dataclasses.replace(_long_cfg(), rope=True, n_kv_heads=2),
+        batch=8, seq=4096, iters=20)
+
+
 def bench_transformer_long_noremat():
     """Same config without per-block rematerialization (fits at this
     size; remat trades ~13% step time for O(1)-block activations)."""
@@ -375,6 +386,7 @@ BENCHES = {
     "resnet50": (bench_resnet50, "samples/sec/chip"),
     "transformer": (bench_transformer, "tokens/sec/chip"),
     "transformer_long": (bench_transformer_long, "tokens/sec/chip"),
+    "transformer_long_rope": (bench_transformer_long_rope, "tokens/sec/chip"),
     "transformer_long_noremat": (bench_transformer_long_noremat,
                                  "tokens/sec/chip"),
     "transformer_long_xla": (bench_transformer_long_xla, "tokens/sec/chip"),

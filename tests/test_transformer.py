@@ -325,3 +325,17 @@ def test_dropout_training_learns(rng):
         carry, loss = step(carry, data, jax.random.key(i))
         first = first if first is not None else float(loss)
     assert float(loss) < first * 0.6
+
+
+def test_dropout_validation(rng):
+    with pytest.raises(ValueError, match="dropout"):
+        tfm.init_params(jax.random.key(0), tfm.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+            max_len=32, dropout=1.0))
+    # A dropout config whose step is driven without an rng must refuse
+    # rather than silently train unregularized.
+    params = tfm.init_params(jax.random.key(0), DROP_CFG)
+    opt = optax.adam(1e-2)
+    step = tfm.make_train_step(DROP_CFG, opt)
+    with pytest.raises(ValueError, match="dropout_rng"):
+        step((params, opt.init(params)), jnp.asarray(toks(rng)))
