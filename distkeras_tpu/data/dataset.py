@@ -128,6 +128,31 @@ class Dataset:
         return Dataset({k: gather_rows(v, perm)
                         for k, v in self._cols.items()})
 
+    def split(self, frac: float, seed: int | None = None
+              ) -> tuple["Dataset", "Dataset"]:
+        """Random (train, held-out) split; ``frac`` is the first part.
+
+        The reference delegates splitting to Spark's
+        ``randomSplit`` (workflow.ipynb); here it is a permutation
+        slice, deterministic under ``seed``.
+        """
+        if not 0.0 < frac < 1.0:
+            raise ValueError(f"frac must be in (0, 1), got {frac}")
+        n = len(self)
+        cut = round(n * frac)  # int() truncation would undershoot e.g.
+        if cut == 0 or cut == n:  # 100 * 0.29 == 28.999…
+            raise ValueError(
+                f"split frac={frac} of {n} rows leaves an empty part")
+        from distkeras_tpu.native import gather_rows
+
+        perm = np.random.default_rng(seed).permutation(n)
+        first, second = perm[:cut], perm[cut:]
+        return (
+            Dataset({k: gather_rows(np.ascontiguousarray(v), first)
+                     for k, v in self._cols.items()}),
+            Dataset({k: gather_rows(np.ascontiguousarray(v), second)
+                     for k, v in self._cols.items()}))
+
     def shard(self, index: int, num_shards: int) -> "Dataset":
         """Strided host shard — each host keeps rows i, i+num_shards, ...
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distkeras_tpu.models.transformer import (
     TransformerConfig,
@@ -37,23 +38,33 @@ def init_cache(cfg: TransformerConfig, batch: int, dtype=None):
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
+def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
+                 pad_lens=None):
     """One position: tokens [B] at position ``pos`` -> (logits [B, V], cache).
 
     Attention reads the cache up to ``pos`` with a position mask (static
     shapes; masked slots contribute exp(NEG_INF-ish) = 0).
+
+    ``pad_lens [B]`` supports left-padded batches (ragged prompts
+    aligned at their ends): positions < pad_lens[i] are excluded from
+    row i's attention forever, and position *ids* (rotary angles /
+    pos_emb rows) count from the row's true start, so each row decodes
+    exactly as it would alone.
     """
     dtype = jnp.dtype(cfg.dtype)
     b = tokens.shape[0]
     x = params["tok_emb"][tokens].astype(dtype)  # [B, D]
+    if pad_lens is None:
+        pos_ids = jnp.full((b,), pos)
+    else:
+        pos_ids = jnp.maximum(pos - pad_lens, 0)
     rope_ang = None
     if cfg.rope:
-        # [half] angles for this single position; broadcasts over [B,H].
-        rope_ang = rope_angles(jnp.asarray(pos), cfg.head_dim,
-                               cfg.rope_theta)[None, None, :]
+        # [B, half] per-row angles; broadcast over heads.
+        rope_ang = rope_angles(pos_ids, cfg.head_dim,
+                               cfg.rope_theta)[:, None, :]
     else:
-        x = x + jax.lax.dynamic_index_in_dim(
-            params["pos_emb"], pos, axis=0, keepdims=False).astype(dtype)
+        x = x + params["pos_emb"][pos_ids].astype(dtype)
 
     new_cache_k, new_cache_v = [], []
     for i in range(cfg.n_layers):
@@ -84,7 +95,11 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
         logits = jnp.einsum("bcgk,bsck->bcgs", qg,
                             ck.astype(jnp.float32))
         logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
-        mask = jnp.arange(cfg.max_len)[None, None, None, :] <= pos
+        span = jnp.arange(cfg.max_len)
+        mask = (span <= pos)[None, None, None, :]
+        if pad_lens is not None:  # left-pad slots never enter attention
+            mask = mask & (span[None, :] >= pad_lens[:, None]
+                           )[:, None, None, :]
         logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
         attn = jnp.einsum("bcgs,bsck->bcgk", probs,
@@ -151,7 +166,8 @@ def top_p_mask(logits, p: float):
 
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
              temperature: float = 0.0, key=None,
-             top_k: int | None = None, top_p: float | None = None):
+             top_k: int | None = None, top_p: float | None = None,
+             prompt_lengths=None):
     """Decode ``max_new_tokens`` past ``prompt [B, P]``; returns [B, P+N].
 
     One compiled scan: prompt positions run through the same cached
@@ -160,6 +176,14 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     > 0, ``top_k`` and/or ``top_p`` (nucleus) restrict the sampling
     support — both applied to the temperature-scaled logits, top-k
     first, the standard composition.
+
+    Ragged batches: pass right-padded prompts plus ``prompt_lengths
+    [B]`` (1 <= L_i <= P).  Rows are internally left-aligned at their
+    ends (per-row roll), pad slots are masked out of attention and
+    position ids count from each row's true start, so every row decodes
+    exactly as it would alone; the result returns in the input layout —
+    row i carries its L_i prompt tokens, then its N generated tokens,
+    then the original padding.
 
     MoE caveat: decode-time routing is dense top-1 *without* expert
     capacity (see ``step_fn``), so logits diverge from the training
@@ -193,6 +217,21 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     key = key if key is not None else jax.random.key(0)
 
+    pad_lens = None
+    if prompt_lengths is not None:
+        host_lens = np.asarray(prompt_lengths)
+        if host_lens.shape != (b,):
+            raise ValueError(
+                f"prompt_lengths must be [batch={b}], got {host_lens.shape}")
+        if host_lens.min() < 1 or host_lens.max() > p:
+            raise ValueError(
+                f"prompt_lengths must lie in [1, {p}] (the padded prompt "
+                f"width), got range [{host_lens.min()}, {host_lens.max()}]")
+        lens = jnp.asarray(host_lens, jnp.int32)
+        pad_lens = p - lens  # left-pad sizes after end-alignment
+        # Right-align each row: [tok..., pad...] -> [pad..., tok...].
+        prompt = jax.vmap(jnp.roll)(prompt, pad_lens)
+
     # Buffer of emitted tokens; prompt occupies [0, p).
     buf = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
     cache = init_cache(cfg, b)
@@ -200,7 +239,7 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     def body(carry, pos):
         buf, cache, key = carry
         tok = jax.lax.dynamic_index_in_dim(buf, pos, axis=1, keepdims=False)
-        logits, cache = _decode_step(params, cache, tok, pos, cfg)
+        logits, cache = _decode_step(params, cache, tok, pos, cfg, pad_lens)
         key, sub = jax.random.split(key)
         if temperature > 0:
             scaled = logits / temperature
@@ -221,4 +260,7 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
 
     (buf, _, _), _ = jax.lax.scan(body, (buf, cache, key),
                                   jnp.arange(total - 1))
+    if pad_lens is not None:
+        # Back to the input layout: prompt, generation, then padding.
+        buf = jax.vmap(jnp.roll)(buf, -pad_lens)
     return buf

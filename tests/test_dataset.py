@@ -142,3 +142,23 @@ def test_csv_headerless_single_column(tmp_path):
     p.write_text("1.0\n2.0\n3.0\n")
     ds = Dataset.from_csv(str(p), skip_header=0)
     assert ds["features"].shape == (3, 1)
+
+
+def test_split_deterministic_and_disjoint():
+    import distkeras_tpu as dk
+
+    rng = np.random.default_rng(0)
+    ds = dk.Dataset({"features": rng.normal(size=(100, 4)).astype(np.float32),
+                     "label": np.arange(100)})
+    a, b = ds.split(0.8, seed=3)
+    a2, b2 = ds.split(0.8, seed=3)
+    assert len(a) == 80 and len(b) == 20
+    np.testing.assert_array_equal(a["label"], a2["label"])
+    assert set(a["label"]) | set(b["label"]) == set(range(100))
+    assert not set(a["label"]) & set(b["label"])
+    import pytest
+
+    with pytest.raises(ValueError, match="frac"):
+        ds.split(1.5)
+    with pytest.raises(ValueError, match="empty"):
+        dk.Dataset({"x": np.arange(3)}).split(0.1)
