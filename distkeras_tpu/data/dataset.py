@@ -148,9 +148,9 @@ class Dataset:
         perm = np.random.default_rng(seed).permutation(n)
         first, second = perm[:cut], perm[cut:]
         return (
-            Dataset({k: gather_rows(np.ascontiguousarray(v), first)
+            Dataset({k: gather_rows(v, first)
                      for k, v in self._cols.items()}),
-            Dataset({k: gather_rows(np.ascontiguousarray(v), second)
+            Dataset({k: gather_rows(v, second)
                      for k, v in self._cols.items()}))
 
     def shard(self, index: int, num_shards: int) -> "Dataset":

@@ -167,7 +167,7 @@ def top_p_mask(logits, p: float):
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
              temperature: float = 0.0, key=None,
              top_k: int | None = None, top_p: float | None = None,
-             prompt_lengths=None):
+             prompt_lengths=None, eos_token: int | None = None):
     """Decode ``max_new_tokens`` past ``prompt [B, P]``; returns [B, P+N].
 
     One compiled scan: prompt positions run through the same cached
@@ -177,8 +177,11 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     support — both applied to the temperature-scaled logits, top-k
     first, the standard composition.
 
-    Ragged batches: pass right-padded prompts plus ``prompt_lengths
-    [B]`` (1 <= L_i <= P).  Rows are internally left-aligned at their
+    ``eos_token`` makes completion sticky: once a row emits it, every
+    later generated slot in that row is ``eos_token`` (static shapes —
+    the scan always runs ``max_new_tokens`` positions; trim on the
+    host).  Ragged batches: pass right-padded prompts plus
+    ``prompt_lengths [B]`` (1 <= L_i <= P).  Rows are internally left-aligned at their
     ends (per-row roll), pad slots are masked out of attention and
     position ids count from each row's true start, so every row decodes
     exactly as it would alone; the result returns in the input layout —
@@ -232,12 +235,18 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
         # Right-align each row: [tok..., pad...] -> [pad..., tok...].
         prompt = jax.vmap(jnp.roll)(prompt, pad_lens)
 
+    if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
+        raise ValueError(
+            f"eos_token must be in [0, vocab_size={cfg.vocab_size}), "
+            f"got {eos_token}")
+
     # Buffer of emitted tokens; prompt occupies [0, p).
     buf = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
     cache = init_cache(cfg, b)
+    done = jnp.zeros((b,), bool)
 
     def body(carry, pos):
-        buf, cache, key = carry
+        buf, cache, key, done = carry
         tok = jax.lax.dynamic_index_in_dim(buf, pos, axis=1, keepdims=False)
         logits, cache = _decode_step(params, cache, tok, pos, cfg, pad_lens)
         key, sub = jax.random.split(key)
@@ -250,16 +259,21 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
             nxt = jax.random.categorical(sub, scaled, axis=-1)
         else:
             nxt = logits.argmax(axis=-1)
+        nxt = nxt.astype(jnp.int32)
         # Only write past the prompt (prompt positions are forced).
         write_pos = jnp.minimum(pos + 1, total - 1)
+        gen = write_pos >= p
+        if eos_token is not None:
+            nxt = jnp.where(done & gen, eos_token, nxt)  # sticky fill
+            done = done | (gen & (nxt == eos_token))
         keep = jax.lax.dynamic_index_in_dim(buf, write_pos, axis=1,
                                             keepdims=False)
-        nxt = jnp.where(write_pos >= p, nxt.astype(jnp.int32), keep)
+        nxt = jnp.where(gen, nxt, keep)
         buf = jax.lax.dynamic_update_index_in_dim(buf, nxt, write_pos, axis=1)
-        return (buf, cache, key), None
+        return (buf, cache, key, done), None
 
-    (buf, _, _), _ = jax.lax.scan(body, (buf, cache, key),
-                                  jnp.arange(total - 1))
+    (buf, _, _, _), _ = jax.lax.scan(body, (buf, cache, key, done),
+                                     jnp.arange(total - 1))
     if pad_lens is not None:
         # Back to the input layout: prompt, generation, then padding.
         buf = jax.vmap(jnp.roll)(buf, -pad_lens)

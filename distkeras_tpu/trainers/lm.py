@@ -55,22 +55,42 @@ class LMTrainer(CheckpointingBase):
     """
 
     def __init__(self, cfg: tfm.TransformerConfig, optimizer="adamw",
-                 learning_rate: float = 3e-4, batch_size: int = 8,
+                 learning_rate: float = 3e-4, weight_decay: float | None = None,
+                 batch_size: int = 8,
                  num_epoch: int = 1, mesh=None, rules=None,
                  microbatches: int | None = None, fsdp: bool = False,
                  grad_accum: int = 1, grad_clip_norm: float | None = None,
                  tokens_col: str = "tokens", seed: int = 0,
                  shuffle: bool = False, eval_every: int = 0,
+                 profile_dir: str | None = None, profile_steps: int = 3,
                  checkpoint_dir: str | None = None, checkpoint_every: int = 0,
                  max_checkpoints: int = 3, resume: bool = False):
         self.cfg = cfg
         if not callable(learning_rate) and learning_rate <= 0:
             raise ValueError(
                 f"learning_rate must be positive, got {learning_rate}")
+        if weight_decay is not None and optimizer != "adamw":
+            raise ValueError(
+                "weight_decay only applies to optimizer='adamw' (pass a "
+                "prebuilt optax transform for anything more exotic); "
+                f"got optimizer={optimizer!r}")
         if hasattr(optimizer, "init"):  # prebuilt optax GradientTransformation
             self.optimizer = optimizer
         elif callable(optimizer):  # optax factory: optax.lion etc.
             self.optimizer = optimizer(learning_rate)
+        elif optimizer == "adamw" and weight_decay is not None:
+            # Standard masking: RMSNorm scales are excluded from decay
+            # (decaying a normalization gain toward 0 fights the
+            # parameterization, not overfitting).
+            def decay_mask(params):
+                def leaf(path, _):
+                    name = jax.tree_util.keystr(path, simple=True,
+                                                separator="/")
+                    return not name.endswith("_scale")
+                return jax.tree_util.tree_map_with_path(leaf, params)
+
+            self.optimizer = optax.adamw(
+                learning_rate, weight_decay=weight_decay, mask=decay_mask)
         else:
             try:
                 self.optimizer = _OPTS[optimizer](learning_rate)
@@ -89,6 +109,14 @@ class LMTrainer(CheckpointingBase):
         self.grad_accum = grad_accum
         if eval_every < 0:
             raise ValueError(f"eval_every must be >= 0, got {eval_every}")
+        # Optional XLA profile of a few steady-state steps (skips round
+        # 1, which is compile): utils/profiling.trace around rounds
+        # [2, 2 + profile_steps); view in TensorBoard/Perfetto.
+        if profile_steps < 1:
+            raise ValueError(
+                f"profile_steps must be >= 1, got {profile_steps}")
+        self.profile_dir = profile_dir
+        self.profile_steps = profile_steps
         self.batch_size = batch_size
         self.num_epoch = num_epoch
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -251,6 +279,7 @@ class LMTrainer(CheckpointingBase):
         # Fail fast on a bad checkpoint_dir before paying parameter
         # init and mesh placement.
         self._open_checkpoints()
+        profiling = False
         try:
             if params is None:
                 params = self.init_params()
@@ -318,6 +347,10 @@ class LMTrainer(CheckpointingBase):
                     f"{rows_per_step} (batch_size x grad_accum)")
             carry, start = self._restore_or(carry)
             rnd = 0
+            # Profile rounds relative to the first *executed* round
+            # (resume skips rnd <= start): one warm round for compile,
+            # then profile_steps captured rounds.
+            prof_start = start + 2
             for _ in range(self.num_epoch):
                 for i in range(0, n_rows, rows_per_step):
                     rnd += 1
@@ -328,22 +361,39 @@ class LMTrainer(CheckpointingBase):
                         block = block.reshape(self.grad_accum, global_bs,
                                               block.shape[1])
                     batch = jax.device_put(block, step_sh)
+                    if self.profile_dir and rnd == prof_start:
+                        jax.profiler.start_trace(self.profile_dir)
+                        profiling = True
                     if dropping:
                         carry, loss = step(
                             carry, batch, jax.random.fold_in(drop_base, rnd))
                     else:
                         carry, loss = step(carry, batch)
+                    if (profiling
+                            and rnd >= prof_start - 1 + self.profile_steps):
+                        jax.block_until_ready(loss)  # flush async device work
+                        jax.profiler.stop_trace()
+                        profiling = False
                     losses.append(loss)
                     self._checkpoint(carry, rnd)
                     if (eval_fn is not None and self.eval_every
                             and rnd % self.eval_every == 0):
                         eval_fn(carry, rnd)
+            if profiling:  # run shorter than the requested capture
+                jax.block_until_ready(losses[-1])
+                jax.profiler.stop_trace()
+                profiling = False
             if losses:
                 self._checkpoint(carry, rnd, final=True)
             if eval_fn is not None and not (
                     self.eval_history and self.eval_history[-1][0] == rnd):
                 eval_fn(carry, -1)  # final state not already evaluated
         finally:
+            if profiling:  # exception mid-capture: close the profiler
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
             self._close_checkpoints()
         params, _ = carry
         jax.block_until_ready(jax.tree.leaves(params)[0])

@@ -217,3 +217,19 @@ def test_generate_ragged_length_range_checked(rng):
         generate(params, prompt, CFG, 4, prompt_lengths=np.array([4, 7]))
     with pytest.raises(ValueError, match=r"\[1, 4\]"):
         generate(params, prompt, CFG, 4, prompt_lengths=np.array([0, 4]))
+
+
+def test_generate_eos_sticky(rng):
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)).astype(np.int32))
+    free = np.asarray(generate(params, prompt, CFG, max_new_tokens=8))
+    eos = int(free[0, 4])  # row 0's first generated token
+    out = np.asarray(generate(params, prompt, CFG, max_new_tokens=8,
+                              eos_token=eos))
+    # Row 0 finished at its first generated slot: the rest is eos fill.
+    assert (out[0, 4:] == eos).all()
+    # A row that never emits eos matches the unconstrained run.
+    if eos not in free[1, 4:]:
+        np.testing.assert_array_equal(out[1], free[1])
+    with pytest.raises(ValueError, match="eos_token"):
+        generate(params, prompt, CFG, 4, eos_token=64)

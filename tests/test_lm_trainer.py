@@ -330,3 +330,34 @@ def test_lm_dropout_rejects_pipeline(devices):
     mesh = make_mesh(MeshSpec(data=4, pipeline=2), devices=devices)
     with pytest.raises(ValueError, match="dropout.*pipeline"):
         dk.LMTrainer(cfg, mesh=mesh)
+
+
+def test_lm_weight_decay_masks_norm_scales(devices):
+    t = dk.LMTrainer(CFG, optimizer="adamw", learning_rate=1e-2,
+                     weight_decay=0.5)
+    params = t.init_params()
+    zero_g = jax.tree.map(lambda a: np.zeros_like(np.asarray(a)), params)
+    upd, _ = t.optimizer.update(zero_g, t.optimizer.init(params), params)
+    # Norm scales: no decay -> zero update under zero gradients.
+    assert float(np.abs(np.asarray(upd["ln_f_scale"])).max()) == 0.0
+    assert float(np.abs(np.asarray(upd["layers"]["ln1_scale"])).max()) == 0.0
+    # Weights do decay.
+    assert float(np.abs(np.asarray(upd["tok_emb"])).max()) > 0.0
+    assert float(np.abs(
+        np.asarray(upd["layers"]["attn"]["wq"])).max()) > 0.0
+    with pytest.raises(ValueError, match="weight_decay"):
+        dk.LMTrainer(CFG, optimizer="sgd", weight_decay=0.1)
+
+
+def test_lm_profile_dir_writes_trace(tmp_path, devices, rng):
+    import glob as _glob
+
+    d = str(tmp_path / "prof")
+    mesh = make_mesh(MeshSpec(data=2), devices=devices[:2])
+    t = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=8, num_epoch=2,
+                     mesh=mesh, profile_dir=d, profile_steps=2)
+    t.train(tokens(rng, n=32))
+    traces = _glob.glob(d + "/**/*.trace.json.gz", recursive=True)
+    assert traces, f"no trace written under {d}"
+    with pytest.raises(ValueError, match="profile_steps"):
+        dk.LMTrainer(CFG, profile_steps=0)
