@@ -74,6 +74,16 @@ class TransformerConfig:
     # FLOPs — the standard long-context/deep-model trade on TPU, where
     # HBM, not MXU, is the usual ceiling.
     remat: bool = False
+    # Vocab-head cross-entropy chunking (training/eval loss only).
+    # With ce_chunks > 1 the loss computes the [tokens, vocab] logits in
+    # ce_chunks sequential slices, each rematerialized in the backward,
+    # so the full [B, S, V] f32 logits never materialize in HBM — at
+    # vocab 32k, seq 1k, batch 8 that is ~1 GB of f32 written + re-read
+    # several times per step on the unchunked path.  Pure optimization:
+    # loss and gradients are exact (per-slice logsumexp), sampling and
+    # predict paths are untouched (they need one position's logits
+    # only).  0/1 = off.
+    ce_chunks: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -99,6 +109,8 @@ def init_params(rng, cfg: TransformerConfig):
     """
     if not 0.0 <= cfg.dropout < 1.0:
         raise ValueError(f"dropout must be in [0, 1), got {cfg.dropout}")
+    if cfg.ce_chunks < 0:
+        raise ValueError(f"ce_chunks must be >= 0, got {cfg.ce_chunks}")
     keys = jax.random.split(rng, 12)
     d, f, h, hd = cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.head_dim
     kv = cfg.kv_heads
@@ -285,15 +297,13 @@ def block_apply(layer_params, x, cfg: TransformerConfig,
     return x + y, aux
 
 
-def apply(params, tokens, cfg: TransformerConfig,
-          attention_fn: Callable | None = None, dropout_rng=None):
-    """Forward pass: tokens [B, S] int32 -> logits [B, S, V].
+def apply_hidden(params, tokens, cfg: TransformerConfig,
+                 attention_fn: Callable | None = None, dropout_rng=None):
+    """Trunk forward: tokens [B, S] int32 -> final-norm hidden [B, S, D].
 
-    ``attention_fn(q, k, v) -> out`` defaults to causal flash attention
-    (Pallas on TPU); pass a ``make_ring_attention(...)`` wrapper for
-    sequence parallelism.  ``dropout_rng`` non-None (with cfg.dropout
-    > 0) enables training dropout; omit it for deterministic
-    inference/eval.  Returns (logits, aux_loss).
+    Everything in :func:`apply` except the unembedding matmul; the
+    chunked cross-entropy path consumes the hidden states directly so
+    the full-vocab logits never materialize.  Returns (hidden, aux).
     """
     if attention_fn is None:
         attention_fn = lambda q, k, v: flash_attention(q, k, v, True)
@@ -329,9 +339,64 @@ def apply(params, tokens, cfg: TransformerConfig,
         x, aux = block(lp, x, cfg, attention_fn, rope_ang, drop_key)
         aux_total = aux_total + aux
 
-    x = _rms_norm(x, params["ln_f_scale"])
+    return _rms_norm(x, params["ln_f_scale"]), aux_total
+
+
+def apply(params, tokens, cfg: TransformerConfig,
+          attention_fn: Callable | None = None, dropout_rng=None):
+    """Forward pass: tokens [B, S] int32 -> logits [B, S, V].
+
+    ``attention_fn(q, k, v) -> out`` defaults to causal flash attention
+    (Pallas on TPU); pass a ``make_ring_attention(...)`` wrapper for
+    sequence parallelism.  ``dropout_rng`` non-None (with cfg.dropout
+    > 0) enables training dropout; omit it for deterministic
+    inference/eval.  Returns (logits, aux_loss).
+    """
+    x, aux_total = apply_hidden(params, tokens, cfg, attention_fn,
+                                dropout_rng)
+    dtype = jnp.dtype(cfg.dtype)
     logits = jnp.einsum("bsd,vd->bsv", x, params["tok_emb"].astype(dtype))
     return logits.astype(jnp.float32), aux_total
+
+
+def chunked_softmax_xent(hidden, emb, targets, n_chunks: int):
+    """Mean softmax cross-entropy without materializing full logits.
+
+    ``hidden`` [B, S, D] (compute dtype), ``emb`` [V, D], ``targets``
+    [B, S] int.  Tokens flatten to N = B*S rows, padded up to a multiple
+    of ``n_chunks`` (padding carries target -1 and contributes 0); a
+    ``lax.scan`` over the chunks computes each [N/n_chunks, V] logits
+    slice, reduces it to its per-row ``logsumexp - target_logit``, and
+    discards it.  ``jax.checkpoint`` on the body re-derives the slice in
+    the backward, so peak HBM for the head is one slice fwd + bwd
+    instead of the full [N, V] f32 logits (plus XLA's saved
+    intermediates).  Exact — not an approximation: same per-row math as
+    ``log_softmax`` + gather, chunking only reorders the reduction.
+    """
+    n_tok = targets.size
+    d = hidden.shape[-1]
+    h = hidden.reshape(n_tok, d)
+    t = targets.reshape(n_tok).astype(jnp.int32)
+    pad = (-n_tok) % n_chunks
+    if pad:
+        h = jnp.concatenate([h, jnp.zeros((pad, d), h.dtype)])
+        t = jnp.concatenate([t, jnp.full((pad,), -1, jnp.int32)])
+    h = h.reshape(n_chunks, -1, d)
+    t = t.reshape(n_chunks, -1)
+    emb_c = emb.astype(hidden.dtype)
+
+    def body(total, sl):
+        hc, tc = sl
+        logits = jnp.einsum("cd,vd->cv", hc, emb_c).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(
+            logits, jnp.maximum(tc, 0)[:, None], axis=-1)[:, 0]
+        nll = jnp.where(tc >= 0, lse - tgt, 0.0)
+        return total + nll.sum(), None
+
+    total, _ = jax.lax.scan(jax.checkpoint(body),
+                            jnp.zeros((), jnp.float32), (h, t))
+    return total / n_tok
 
 
 def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
@@ -425,12 +490,25 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
 
 def _forward_nll(params, tokens, cfg: TransformerConfig,
                  attention_fn: Callable | None,
-                 apply_fn: Callable | None):
-    """(mean next-token NLL, aux) — shared by train loss and eval."""
-    if apply_fn is None:
-        apply_fn = lambda p, t: apply(p, t, cfg, attention_fn)
-    logits, aux = apply_fn(params, tokens[:, :-1])
+                 apply_fn: Callable | None, dropout_rng=None):
+    """(mean next-token NLL, aux) — shared by train loss and eval.
+
+    On the default path (no custom ``apply_fn``) with ``cfg.ce_chunks``
+    > 1 the vocab head runs through :func:`chunked_softmax_xent`; a
+    custom ``apply_fn`` (e.g. the pipelined trunk) returns full logits
+    and keeps the materialized head.
+    """
     targets = tokens[:, 1:]
+    if apply_fn is None and cfg.ce_chunks > 1:
+        hidden, aux = apply_hidden(params, tokens[:, :-1], cfg,
+                                   attention_fn, dropout_rng)
+        nll = chunked_softmax_xent(hidden, params["tok_emb"], targets,
+                                   cfg.ce_chunks)
+        return nll, aux
+    if apply_fn is None:
+        apply_fn = lambda p, t: apply(p, t, cfg, attention_fn,
+                                      dropout_rng=dropout_rng)
+    logits, aux = apply_fn(params, tokens[:, :-1])
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
     return nll, aux
@@ -445,16 +523,14 @@ def lm_loss(params, tokens, cfg: TransformerConfig,
     :func:`apply`; pass a closure over :func:`apply_pipelined` to train
     the pipelined trunk with the same loss.
     """
-    if dropout_rng is not None:
-        if apply_fn is not None:
-            raise ValueError(
-                "dropout_rng only threads through the default apply(); "
-                "a custom apply_fn (e.g. the pipelined trunk) must take "
-                "its own rng — pipeline parallelism does not support "
-                "dropout (see TransformerConfig.dropout)")
-        apply_fn = lambda p, t: apply(p, t, cfg, attention_fn,
-                                      dropout_rng=dropout_rng)
-    nll, aux = _forward_nll(params, tokens, cfg, attention_fn, apply_fn)
+    if dropout_rng is not None and apply_fn is not None:
+        raise ValueError(
+            "dropout_rng only threads through the default apply(); "
+            "a custom apply_fn (e.g. the pipelined trunk) must take "
+            "its own rng — pipeline parallelism does not support "
+            "dropout (see TransformerConfig.dropout)")
+    nll, aux = _forward_nll(params, tokens, cfg, attention_fn, apply_fn,
+                            dropout_rng)
     return nll + aux
 
 

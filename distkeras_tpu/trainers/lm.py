@@ -258,7 +258,7 @@ class LMTrainer(CheckpointingBase):
             from distkeras_tpu.native import gather_rows
 
             perm = np.random.default_rng(self.seed).permutation(len(tokens))
-            tokens = gather_rows(np.ascontiguousarray(tokens), perm)
+            tokens = gather_rows(tokens, perm)  # gather_rows coerces to C-order
 
         self.eval_history = []
         if self.eval_every and eval_tokens is None:
@@ -338,6 +338,15 @@ class LMTrainer(CheckpointingBase):
                     self.eval_history.append(
                         (rnd, {"loss": mean, "perplexity": ppl}))
 
+                if self.profile_dir and self.eval_every:
+                    # Pre-compile the eval nll so an eval round landing
+                    # inside the profiler capture window records eval
+                    # *execution*, not its first-call XLA compile (the
+                    # trace contract is steady-state work only).  With
+                    # eval_every=0 no eval can land in the window.
+                    jax.block_until_ready(
+                        nll(params, eval_chunks[0]))
+
             carry, losses = (params, opt_state), []
             rows_per_step = global_bs * self.grad_accum
             n_rows = len(tokens) - (len(tokens) % rows_per_step)
@@ -383,6 +392,15 @@ class LMTrainer(CheckpointingBase):
                 jax.block_until_ready(losses[-1])
                 jax.profiler.stop_trace()
                 profiling = False
+            elif self.profile_dir and rnd < prof_start:
+                import warnings
+
+                warnings.warn(
+                    f"profile_dir is set but the run executed only "
+                    f"{max(0, rnd - start)} round(s); the trace skips the "
+                    f"compile round and starts at round {prof_start - start}"
+                    " — no profile was written. Train on more data or more "
+                    "epochs to capture one.", stacklevel=2)
             if losses:
                 self._checkpoint(carry, rnd, final=True)
             if eval_fn is not None and not (

@@ -157,7 +157,12 @@ def bench_resnet50():
                          batch=128, iters=50, warmup=5)
 
 
-def _measure_lm(cfg, batch, seq, iters, warmup=5, attention_fn=None):
+def _measure_lm(cfg, batch, seq, iters, warmup=5, attention_fn=None,
+                flops_cfg=None):
+    """``flops_cfg``: config whose compiled program supplies the FLOPs
+    count — a ce_chunks config hides the head matmuls inside a lax.scan
+    whose body cost_analysis counts once, so its MFU must come from the
+    numerically-identical unchunked program."""
     import jax
     import numpy as np
     import optax
@@ -172,7 +177,12 @@ def _measure_lm(cfg, batch, seq, iters, warmup=5, attention_fn=None):
     rng = np.random.default_rng(0)
     tokens = jax.device_put(
         rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32))
-    step_flops = compiled_flops(step, carry, tokens)
+    if flops_cfg is not None:
+        fstep = jax.jit(tfm.make_train_step(flops_cfg, opt,
+                                            attention_fn=attention_fn))
+        step_flops = compiled_flops(fstep, carry, tokens)
+    else:
+        step_flops = compiled_flops(step, carry, tokens)
     for _ in range(warmup):
         carry, loss = step(carry, tokens)
     float(loss)
@@ -192,6 +202,22 @@ def bench_transformer():
         vocab_size=32768, d_model=512, n_heads=4, n_layers=4, d_ff=2048,
         max_len=1025, dtype="bfloat16")
     return _measure_lm(cfg, batch=8, seq=1024, iters=50)
+
+
+def bench_transformer_fusedce():
+    """Same head-dominated config with the chunked vocab-head CE
+    (ce_chunks=8): the [8, 1024, 32k] f32 logits (~1 GB) never
+    materialize — the delta vs ``transformer`` is pure head HBM
+    traffic."""
+    from distkeras_tpu.models import transformer as tfm
+
+    import dataclasses
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=32768, d_model=512, n_heads=4, n_layers=4, d_ff=2048,
+        max_len=1025, dtype="bfloat16", ce_chunks=8)
+    return _measure_lm(cfg, batch=8, seq=1024, iters=50,
+                       flops_cfg=dataclasses.replace(cfg, ce_chunks=0))
 
 
 def _long_cfg():
@@ -385,6 +411,7 @@ BENCHES = {
     "imdb_lstm": (bench_imdb_lstm, "samples/sec/chip"),
     "resnet50": (bench_resnet50, "samples/sec/chip"),
     "transformer": (bench_transformer, "tokens/sec/chip"),
+    "transformer_fusedce": (bench_transformer_fusedce, "tokens/sec/chip"),
     "transformer_long": (bench_transformer_long, "tokens/sec/chip"),
     "transformer_long_rope": (bench_transformer_long_rope, "tokens/sec/chip"),
     "transformer_long_noremat": (bench_transformer_long_noremat,

@@ -339,3 +339,80 @@ def test_dropout_validation(rng):
     step = tfm.make_train_step(DROP_CFG, opt)
     with pytest.raises(ValueError, match="dropout_rng"):
         step((params, opt.init(params)), jnp.asarray(toks(rng)))
+
+
+# ---------------------------------------------------------------- chunked CE
+
+def test_chunked_ce_loss_and_grads_match_full(rng):
+    """ce_chunks is a pure optimization: loss AND gradients must equal
+    the materialized-logits path (same math, reordered reduction)."""
+    import dataclasses
+
+    cfg_c = dataclasses.replace(CFG, ce_chunks=4)
+    params = tfm.init_params(jax.random.key(0), CFG)
+    t = jnp.asarray(toks(rng))
+    l_full, g_full = jax.value_and_grad(tfm.lm_loss)(params, t, CFG)
+    l_chunk, g_chunk = jax.value_and_grad(tfm.lm_loss)(params, t, cfg_c)
+    np.testing.assert_allclose(float(l_chunk), float(l_full), rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        a, b, atol=1e-6, rtol=1e-5), g_full, g_chunk)
+
+
+def test_chunked_ce_handles_nondivisible_token_count(rng):
+    """B*(S-1) not divisible by ce_chunks: padding rows carry target -1
+    and must contribute exactly zero."""
+    import dataclasses
+
+    cfg_c = dataclasses.replace(CFG, ce_chunks=7)  # 4*15=60 tokens, 7∤60
+    params = tfm.init_params(jax.random.key(0), CFG)
+    t = jnp.asarray(toks(rng))
+    l_full = tfm.lm_loss(params, t, CFG)
+    l_chunk = tfm.lm_loss(params, t, cfg_c)
+    np.testing.assert_allclose(float(l_chunk), float(l_full), rtol=1e-6)
+
+
+def test_chunked_ce_eval_nll_matches(rng):
+    import dataclasses
+
+    cfg_c = dataclasses.replace(CFG, ce_chunks=4)
+    params = tfm.init_params(jax.random.key(0), CFG)
+    t = jnp.asarray(toks(rng))
+    np.testing.assert_allclose(
+        float(tfm.lm_nll(params, t, cfg_c)),
+        float(tfm.lm_nll(params, t, CFG)), rtol=1e-6)
+
+
+def test_chunked_ce_under_tensor_parallel(devices, rng):
+    """Chunked head under the Megatron plan: tok_emb is model-sharded,
+    the per-chunk contraction psums over the mesh — loss must match the
+    single-device full-logits value."""
+    import dataclasses
+
+    cfg_c = dataclasses.replace(CFG, ce_chunks=4)
+    mesh = make_mesh(MeshSpec(data=4, model=2), devices=devices)
+    params = tfm.init_params(jax.random.key(0), CFG)
+    t = jnp.asarray(toks(rng))
+    ref = float(tfm.lm_loss(params, t, CFG))
+    plan = ShardingPlan(rules=tfm.tp_rules())
+    psh = plan.tree_shardings(mesh, params)
+    params_sh = jax.device_put(params, psh)
+    tsh = NamedSharding(mesh, P("data", None))
+    loss = jax.jit(lambda p, x: tfm.lm_loss(p, x, cfg_c),
+                   in_shardings=(psh, tsh))(params_sh, t)
+    np.testing.assert_allclose(float(loss), ref, atol=2e-5, rtol=2e-5)
+
+
+def test_chunked_ce_trains(rng):
+    import dataclasses
+
+    cfg_c = dataclasses.replace(CFG, ce_chunks=4)
+    params = tfm.init_params(jax.random.key(0), cfg_c)
+    opt = optax.adam(1e-2)
+    step = jax.jit(tfm.make_train_step(cfg_c, opt))
+    carry = (params, opt.init(params))
+    t = jnp.asarray(toks(rng, b=16, s=16))
+    first = None
+    for _ in range(30):
+        carry, loss = step(carry, t)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first * 0.5, (first, float(loss))
