@@ -159,8 +159,35 @@ class DenseTransformer(Transformer):
             if self.size is None:
                 raise ValueError("DenseTransformer needs size= for sparse input")
             out = np.zeros((len(dataset), self.size), dtype=np.float32)
-            for i, (ii, vv) in enumerate(zip(idx, val)):
-                out[i, np.asarray(ii, dtype=np.int64)] = vv
+            if len(dataset):
+                # One flattened scatter instead of a per-row Python loop:
+                # ragged per-row index/value arrays concatenate to flat
+                # (row, col, val) triples and assign in a single fancy
+                # index (duplicate (row, col) keeps last-wins semantics,
+                # same as the row-at-a-time assignment).
+                lengths = np.fromiter((len(ii) for ii in idx),
+                                      dtype=np.int64, count=len(dataset))
+                vlengths = np.fromiter((len(vv) for vv in val),
+                                       dtype=np.int64, count=len(dataset))
+                # Per-row, not aggregate: equal totals with unequal rows
+                # would silently shift values across rows.
+                if not np.array_equal(lengths, vlengths):
+                    bad = int(np.nonzero(lengths != vlengths)[0][0])
+                    raise ValueError(
+                        f"indices/values length mismatch at row {bad}: "
+                        f"{lengths[bad]} indices vs {vlengths[bad]} values")
+                if lengths.sum():
+                    rows = np.repeat(np.arange(len(dataset)), lengths)
+                    cols = np.concatenate(
+                        [np.asarray(ii, np.int64) for ii in idx])
+                    vals = np.concatenate(
+                        [np.asarray(vv, np.float32) for vv in val])
+                    if cols.size and (cols.min() < 0
+                                      or cols.max() >= self.size):
+                        raise ValueError(
+                            f"sparse index out of range for size="
+                            f"{self.size}: [{cols.min()}, {cols.max()}]")
+                    out[rows, cols] = vals
             return dataset.with_column(self.output_col, out)
         # Already dense: ensure float32 ndarray.
         x = np.asarray(dataset[self.input_col], dtype=np.float32)

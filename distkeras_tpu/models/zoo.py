@@ -68,19 +68,27 @@ def higgs_mlp(input_dim: int = 28, num_classes: int = 2,
 
 def imdb_lstm(vocab_size: int = 20000, embed_dim: int = 128,
               lstm_units: int = 128, maxlen: int = 128,
-              seed: int | None = None):
+              seed: int | None = None, fused: bool = True):
     """LSTM sentiment classifier (BASELINE.json config #4).
 
-    Binary logits output; use ``binary_crossentropy``.
+    Binary logits output; use ``binary_crossentropy``.  ``fused=True``
+    (default) uses :class:`~distkeras_tpu.models.rnn.FusedLSTM` — the
+    weight-compatible TPU restructuring of ``keras.layers.LSTM`` that
+    hoists the input projection out of the recurrence; ``fused=False``
+    keeps the stock Keras layer (the ablation baseline).
     """
     import keras
 
+    from distkeras_tpu.models.rnn import FusedLSTM
+
     if seed is not None:
         keras.utils.set_random_seed(seed)
+    lstm = (FusedLSTM(lstm_units) if fused
+            else keras.layers.LSTM(lstm_units))
     return keras.Sequential([
         keras.Input((maxlen,), dtype="int32"),
         keras.layers.Embedding(vocab_size, embed_dim),
-        keras.layers.LSTM(lstm_units),
+        lstm,
         keras.layers.Dense(1),
     ], name="imdb_lstm")
 

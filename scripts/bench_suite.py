@@ -139,12 +139,26 @@ def bench_higgs_mlp():
 
 
 def bench_imdb_lstm():
+    """FusedLSTM path (models/rnn.py): input projection hoisted out of
+    the recurrence into one MXU matmul."""
     import keras
     from distkeras_tpu.models.zoo import imdb_lstm
 
     keras.mixed_precision.set_global_policy("mixed_bfloat16")
     return measure_keras(
         lambda: imdb_lstm(vocab_size=20000, maxlen=128, seed=0), (128,), 1,
+        batch=512, iters=100, int_input=True, vocab=20000)
+
+
+def bench_imdb_lstm_keras():
+    """Ablation baseline: the stock keras.layers.LSTM recurrence."""
+    import keras
+    from distkeras_tpu.models.zoo import imdb_lstm
+
+    keras.mixed_precision.set_global_policy("mixed_bfloat16")
+    return measure_keras(
+        lambda: imdb_lstm(vocab_size=20000, maxlen=128, seed=0,
+                          fused=False), (128,), 1,
         batch=512, iters=100, int_input=True, vocab=20000)
 
 
@@ -409,6 +423,7 @@ BENCHES = {
     "cifar_cnn_resident": (bench_cifar_cnn_resident, "samples/sec/chip"),
     "higgs_mlp": (bench_higgs_mlp, "samples/sec/chip"),
     "imdb_lstm": (bench_imdb_lstm, "samples/sec/chip"),
+    "imdb_lstm_keras": (bench_imdb_lstm_keras, "samples/sec/chip"),
     "resnet50": (bench_resnet50, "samples/sec/chip"),
     "transformer": (bench_transformer, "tokens/sec/chip"),
     "transformer_fusedce": (bench_transformer_fusedce, "tokens/sec/chip"),

@@ -162,3 +162,55 @@ def test_split_deterministic_and_disjoint():
         ds.split(1.5)
     with pytest.raises(ValueError, match="empty"):
         dk.Dataset({"x": np.arange(3)}).split(0.1)
+
+
+def test_dense_transformer_sparse_edge_cases():
+    # Empty rows mixed with populated ones, out-of-range rejection, and
+    # scale (the scatter is one flattened fancy-index, not a row loop).
+    idx = np.empty(3, dtype=object)
+    val = np.empty(3, dtype=object)
+    idx[0], val[0] = np.array([3]), np.array([7.0])
+    idx[1], val[1] = np.array([], dtype=np.int64), np.array([])
+    idx[2], val[2] = np.array([0, 1]), np.array([1.0, 2.0])
+    ds = Dataset({"i": idx, "v": val})
+    out = DenseTransformer(indices_col="i", values_col="v", size=4,
+                           output_col="features")(ds)
+    np.testing.assert_array_equal(out["features"],
+                                  [[0, 0, 0, 7], [0, 0, 0, 0], [1, 2, 0, 0]])
+
+    bad = np.empty(1, dtype=object)
+    badv = np.empty(1, dtype=object)
+    bad[0], badv[0] = np.array([5]), np.array([1.0])
+    import pytest
+
+    with pytest.raises(ValueError, match="out of range"):
+        DenseTransformer(indices_col="i", values_col="v", size=4)(
+            Dataset({"i": bad, "v": badv}))
+
+    rng = np.random.default_rng(0)
+    n, size, nnz = 20000, 256, 8
+    big_i = np.empty(n, dtype=object)
+    big_v = np.empty(n, dtype=object)
+    for r in range(n):
+        big_i[r] = rng.choice(size, nnz, replace=False)
+        big_v[r] = rng.normal(size=nnz)
+    dense = DenseTransformer(indices_col="i", values_col="v", size=size,
+                             output_col="features")(
+        Dataset({"i": big_i, "v": big_v}))["features"]
+    r = 1234
+    ref = np.zeros(size, np.float32)
+    ref[big_i[r]] = big_v[r]
+    np.testing.assert_allclose(dense[r], ref, rtol=1e-6)
+
+
+def test_dense_transformer_rejects_row_length_mismatch():
+    # Equal totals, unequal rows: must raise, never shift values.
+    idx = np.empty(2, dtype=object)
+    val = np.empty(2, dtype=object)
+    idx[0], val[0] = np.array([0, 1]), np.array([1.0])
+    idx[1], val[1] = np.array([2]), np.array([2.0, 3.0])
+    import pytest
+
+    with pytest.raises(ValueError, match="mismatch at row 0"):
+        DenseTransformer(indices_col="i", values_col="v", size=4)(
+            Dataset({"i": idx, "v": val}))

@@ -1,0 +1,83 @@
+"""FusedLSTM: weight-compatible TPU restructuring of keras.layers.LSTM.
+
+Contract: identical parameterization and numerics to the stock layer
+(set_weights interchange, f32 tolerance match), so the zoo's IMDB
+config can swap it in without changing the model.
+"""
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.models.rnn import FusedLSTM
+
+
+def _pair(units=12, seq=16, feat=8, return_sequences=False, rng=None):
+    import keras
+
+    x = (rng or np.random.default_rng(0)).normal(
+        size=(4, seq, feat)).astype(np.float32)
+    ref = keras.layers.LSTM(units, return_sequences=return_sequences)
+    fused = FusedLSTM(units, return_sequences=return_sequences)
+    r = ref(x)
+    f = fused(x)  # builds
+    fused.set_weights(ref.get_weights())
+    return ref, fused, x, np.asarray(r)
+
+
+def test_matches_keras_last_state(rng):
+    _, fused, x, ref_out = _pair(rng=rng)
+    np.testing.assert_allclose(np.asarray(fused(x)), ref_out,
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_matches_keras_sequences(rng):
+    _, fused, x, ref_out = _pair(return_sequences=True, rng=rng)
+    out = np.asarray(fused(x))
+    assert out.shape == ref_out.shape == (4, 16, 12)
+    np.testing.assert_allclose(out, ref_out, atol=1e-5, rtol=1e-5)
+
+
+def test_weights_interchange_both_ways(rng):
+    import keras
+
+    ref, fused, x, _ = _pair(rng=rng)
+    # fused -> stock: the layout really is identical, not just same-shaped.
+    ref.set_weights(fused.get_weights())
+    np.testing.assert_allclose(np.asarray(ref(x)), np.asarray(fused(x)),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_serialization_round_trip(rng):
+    from distkeras_tpu.models.zoo import imdb_lstm
+
+    model = imdb_lstm(vocab_size=64, embed_dim=8, lstm_units=8, maxlen=12,
+                      seed=0)
+    blob = dk.serialize_keras_model(model)
+    clone = dk.deserialize_keras_model(blob)
+    x = rng.integers(0, 64, (4, 12)).astype(np.int32)
+    np.testing.assert_allclose(np.asarray(model(x)), np.asarray(clone(x)),
+                               atol=1e-6)
+
+
+def test_trains_under_single_trainer(rng):
+    from distkeras_tpu.models.zoo import imdb_lstm
+
+    # Learnable toy rule: label = (first token < vocab/2).
+    vocab = 64
+    x = rng.integers(0, vocab, (256, 12)).astype(np.int32)
+    y = (x[:, 0] < vocab // 2).astype(np.int64)
+    model = imdb_lstm(vocab_size=vocab, embed_dim=16, lstm_units=16,
+                      maxlen=12, seed=0)
+    tr = dk.SingleTrainer(model, loss="binary_crossentropy",
+                          worker_optimizer="adam", learning_rate=1e-2,
+                          batch_size=32, num_epoch=8)
+    tr.train(dk.Dataset.from_arrays(x, y))
+    assert tr.history[-1] < tr.history[0] * 0.5, tr.history[::16]
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="units"):
+        FusedLSTM(0)
+    with pytest.raises(ValueError, match="batch, time, features"):
+        FusedLSTM(4)(np.zeros((2, 8), np.float32))
