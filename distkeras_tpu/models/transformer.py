@@ -342,6 +342,19 @@ def apply_hidden(params, tokens, cfg: TransformerConfig,
     return _rms_norm(x, params["ln_f_scale"]), aux_total
 
 
+def _unembed(hidden, params, cfg: TransformerConfig):
+    """Tied unembedding head: hidden [B, S, D] -> f32 logits [B, S, V].
+
+    The single definition of the head — apply, apply_pipelined and the
+    materialized loss branch all call it, so the 'chunked CE matches
+    materialized logits' invariant has one site to stay in sync with.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    logits = jnp.einsum("bsd,vd->bsv", hidden,
+                        params["tok_emb"].astype(dtype))
+    return logits.astype(jnp.float32)
+
+
 def apply(params, tokens, cfg: TransformerConfig,
           attention_fn: Callable | None = None, dropout_rng=None):
     """Forward pass: tokens [B, S] int32 -> logits [B, S, V].
@@ -354,9 +367,7 @@ def apply(params, tokens, cfg: TransformerConfig,
     """
     x, aux_total = apply_hidden(params, tokens, cfg, attention_fn,
                                 dropout_rng)
-    dtype = jnp.dtype(cfg.dtype)
-    logits = jnp.einsum("bsd,vd->bsv", x, params["tok_emb"].astype(dtype))
-    return logits.astype(jnp.float32), aux_total
+    return _unembed(x, params, cfg), aux_total
 
 
 def chunked_softmax_xent(hidden, emb, targets, n_chunks: int):
@@ -401,7 +412,8 @@ def chunked_softmax_xent(hidden, emb, targets, n_chunks: int):
 
 def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
                     microbatches: int, attention_fn: Callable | None = None,
-                    axis_name: str = "pipeline", seq_axis: str | None = None):
+                    axis_name: str = "pipeline", seq_axis: str | None = None,
+                    return_hidden: bool = False):
     """Forward pass with the layer trunk pipelined over ``axis_name``.
 
     Embedding and the head run outside the pipeline (they change shape);
@@ -484,69 +496,91 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
                          x_spec=x_spec)
     x, aux_total = pipe(stage_params, x)
     x = _rms_norm(x, params["ln_f_scale"])
-    logits = jnp.einsum("bsd,vd->bsv", x, params["tok_emb"].astype(dtype))
-    return logits.astype(jnp.float32), aux_total
+    if return_hidden:
+        # The head runs outside the pipeline, so the chunked-CE loss can
+        # consume the hidden states directly (lm_loss hidden_fn).
+        return x, aux_total
+    return _unembed(x, params, cfg), aux_total
 
 
 def _forward_nll(params, tokens, cfg: TransformerConfig,
                  attention_fn: Callable | None,
-                 apply_fn: Callable | None, dropout_rng=None):
+                 apply_fn: Callable | None, dropout_rng=None,
+                 hidden_fn: Callable | None = None):
     """(mean next-token NLL, aux) — shared by train loss and eval.
 
-    On the default path (no custom ``apply_fn``) with ``cfg.ce_chunks``
-    > 1 the vocab head runs through :func:`chunked_softmax_xent`; a
-    custom ``apply_fn`` (e.g. the pipelined trunk) returns full logits
-    and keeps the materialized head.
+    Three forward routes:
+
+    - ``apply_fn(params, inputs) -> (logits, aux)``: caller-materialized
+      logits (legacy custom-forward hook); full log_softmax head.
+    - ``hidden_fn(params, inputs) -> (hidden, aux)``: caller supplies
+      final-norm hidden states (e.g. ``apply_pipelined`` with
+      ``return_hidden=True``); the head honors ``cfg.ce_chunks``.
+    - neither: the default :func:`apply_hidden` trunk; the head honors
+      ``cfg.ce_chunks``.
     """
+    if apply_fn is not None and hidden_fn is not None:
+        raise ValueError("pass apply_fn or hidden_fn, not both")
     targets = tokens[:, 1:]
-    if apply_fn is None and cfg.ce_chunks > 1:
-        hidden, aux = apply_hidden(params, tokens[:, :-1], cfg,
-                                   attention_fn, dropout_rng)
+    if apply_fn is not None:
+        logits, aux = apply_fn(params, tokens[:, :-1])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None],
+                                   axis=-1).mean()
+        return nll, aux
+    if hidden_fn is None:
+        hidden_fn = lambda p, t: apply_hidden(p, t, cfg, attention_fn,
+                                              dropout_rng)
+    hidden, aux = hidden_fn(params, tokens[:, :-1])
+    if cfg.ce_chunks > 1:
         nll = chunked_softmax_xent(hidden, params["tok_emb"], targets,
                                    cfg.ce_chunks)
-        return nll, aux
-    if apply_fn is None:
-        apply_fn = lambda p, t: apply(p, t, cfg, attention_fn,
-                                      dropout_rng=dropout_rng)
-    logits, aux = apply_fn(params, tokens[:, :-1])
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+    else:
+        logp = jax.nn.log_softmax(_unembed(hidden, params, cfg), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None],
+                                   axis=-1).mean()
     return nll, aux
 
 
 def lm_loss(params, tokens, cfg: TransformerConfig,
             attention_fn: Callable | None = None,
-            apply_fn: Callable | None = None, dropout_rng=None):
+            apply_fn: Callable | None = None, dropout_rng=None,
+            hidden_fn: Callable | None = None):
     """Next-token cross-entropy (+ MoE aux), mean over B*(S-1) targets.
 
     ``apply_fn(params, inputs) -> (logits, aux)`` defaults to
-    :func:`apply`; pass a closure over :func:`apply_pipelined` to train
-    the pipelined trunk with the same loss.
+    :func:`apply`; pass ``hidden_fn`` (e.g. a closure over
+    :func:`apply_pipelined` with ``return_hidden=True``) to train a
+    custom trunk under the ``cfg.ce_chunks`` head.
     """
-    if dropout_rng is not None and apply_fn is not None:
+    if dropout_rng is not None and (apply_fn is not None
+                                    or hidden_fn is not None):
         raise ValueError(
             "dropout_rng only threads through the default apply(); "
-            "a custom apply_fn (e.g. the pipelined trunk) must take "
-            "its own rng — pipeline parallelism does not support "
-            "dropout (see TransformerConfig.dropout)")
+            "a custom apply_fn/hidden_fn (e.g. the pipelined trunk) "
+            "must take its own rng — pipeline parallelism does not "
+            "support dropout (see TransformerConfig.dropout)")
     nll, aux = _forward_nll(params, tokens, cfg, attention_fn, apply_fn,
-                            dropout_rng)
+                            dropout_rng, hidden_fn)
     return nll + aux
 
 
 def lm_nll(params, tokens, cfg: TransformerConfig,
            attention_fn: Callable | None = None,
-           apply_fn: Callable | None = None):
+           apply_fn: Callable | None = None,
+           hidden_fn: Callable | None = None):
     """Mean next-token NLL *without* the MoE aux regularizer — the
     evaluation quantity (``exp`` of it is perplexity; the router load
     penalty is a training device, not model quality)."""
-    return _forward_nll(params, tokens, cfg, attention_fn, apply_fn)[0]
+    return _forward_nll(params, tokens, cfg, attention_fn, apply_fn,
+                        hidden_fn=hidden_fn)[0]
 
 
 def make_train_step(cfg: TransformerConfig, optimizer,
                     attention_fn: Callable | None = None,
                     apply_fn: Callable | None = None,
-                    grad_accum: int = 1):
+                    grad_accum: int = 1,
+                    hidden_fn: Callable | None = None):
     """``step((params, opt_state), tokens) -> ((params', opt_state'), loss)``.
 
     Pure; callers jit it with NamedShardings (see __graft_entry__ and
@@ -573,14 +607,14 @@ def make_train_step(cfg: TransformerConfig, optimizer,
         rng = dropout_rng if dropping else None
         if grad_accum == 1:
             loss, grads = grad_fn(params, tokens, cfg, attention_fn,
-                                  apply_fn, rng)
+                                  apply_fn, rng, hidden_fn)
         else:
             grads = jax.tree.map(jnp.zeros_like, params)
             loss = jnp.zeros((), jnp.float32)
             for i in range(grad_accum):
                 ri = jax.random.fold_in(rng, i) if rng is not None else None
                 li, gi = grad_fn(params, tokens[i], cfg, attention_fn,
-                                 apply_fn, ri)
+                                 apply_fn, ri, hidden_fn)
                 grads = jax.tree.map(jnp.add, grads, gi)
                 loss = loss + li
             grads = jax.tree.map(lambda g: g / grad_accum, grads)

@@ -179,13 +179,18 @@ class LMTrainer(CheckpointingBase):
         if n_pipe > 1:
             # PP x SP: the pipeline shard_map goes manual over
             # {pipeline, seq} and runs the ring attention body per stage.
-            apply_fn = lambda p, t: tfm.apply_pipelined(
+            # The head runs outside the pipeline, so with cfg.ce_chunks
+            # the loss takes the trunk's hidden states (hidden_fn) and
+            # chunks the vocab head exactly like the un-pipelined path.
+            chunked = cfg.ce_chunks > 1
+            fwd = lambda p, t: tfm.apply_pipelined(
                 p, t, cfg, self.mesh, microbatches=self.microbatches,
-                seq_axis="seq" if n_seq > 1 else None)
+                seq_axis="seq" if n_seq > 1 else None,
+                return_hidden=chunked)
+            fwd_kw = {"hidden_fn" if chunked else "apply_fn": fwd}
             self._step_builder = lambda opt: tfm.make_train_step(
-                cfg, opt, apply_fn=apply_fn, grad_accum=grad_accum)
-            self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg,
-                                                   apply_fn=apply_fn)
+                cfg, opt, grad_accum=grad_accum, **fwd_kw)
+            self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg, **fwd_kw)
         elif n_seq > 1:
             ring = make_ring_attention(self.mesh, causal=True)
             self._step_builder = lambda opt: tfm.make_train_step(
