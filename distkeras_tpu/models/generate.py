@@ -21,9 +21,12 @@ import numpy as np
 from distkeras_tpu.models.transformer import (
     TransformerConfig,
     _rms_norm,
+    _unembed,
+    block_apply,
     rope_angles,
     rope_rotate,
 )
+from distkeras_tpu.ops.attention import flash_attention
 
 
 def init_cache(cfg: TransformerConfig, batch: int, dtype=None):
@@ -36,6 +39,60 @@ def init_cache(cfg: TransformerConfig, batch: int, dtype=None):
     dtype = dtype or jnp.dtype(cfg.dtype)
     shape = (cfg.n_layers, batch, cfg.max_len, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def prefill(params, prompt, cfg: TransformerConfig,
+            last_logits: bool = True):
+    """Fill the KV cache for all prompt positions in ONE parallel pass.
+
+    The sequential decode loop costs one ``_decode_step`` per prompt
+    position; this runs the training-style batched forward (flash
+    attention over [B, P], through the SAME ``block_apply`` body as
+    training — ``return_kv=True``) and writes every position's K/V into
+    the cache at once.  Prompt processing drops from P sequential steps
+    to a single MXU-friendly program, the standard prefill/decode split.
+
+    Returns ``(cache, last [B, V] or None)`` — ``last_logits=False``
+    skips the final norm + unembed (``generate`` re-derives the last
+    position's logits inside its scan; under jit XLA DCE would prune
+    the unused head anyway, the flag keeps eager callers cheap too).
+    Dense-FFN configs only: decode-time MoE routes dense top-1
+    *without* capacity, which the batched training forward does not
+    reproduce — ``generate`` keeps the sequential prompt path for MoE.
+    """
+    if cfg.num_experts:
+        raise ValueError(
+            "prefill supports dense-FFN configs only: decode-time MoE "
+            "uses capacity-free top-1 routing that the batched training "
+            "forward does not reproduce (see generate's MoE caveat)")
+    dtype = jnp.dtype(cfg.dtype)
+    b, p_len = prompt.shape
+    x = params["tok_emb"][prompt].astype(dtype)
+    rope_ang = None
+    if cfg.rope:
+        rope_ang = rope_angles(jnp.arange(p_len), cfg.head_dim,
+                               cfg.rope_theta)[None, :, None, :]
+    else:
+        x = x + params["pos_emb"][:p_len][None].astype(dtype)
+
+    attention_fn = lambda q, k, v: flash_attention(q, k, v, True)
+    cache = init_cache(cfg, b)
+    ks, vs = [], []
+    for i in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+        x, _, (k, v) = block_apply(lp, x, cfg, attention_fn, rope_ang,
+                                   return_kv=True)
+        ks.append(k.astype(cache["k"].dtype))
+        vs.append(v.astype(cache["v"].dtype))
+
+    cache = {
+        "k": cache["k"].at[:, :, :p_len].set(jnp.stack(ks)),
+        "v": cache["v"].at[:, :, :p_len].set(jnp.stack(vs)),
+    }
+    if not last_logits:
+        return cache, None
+    x = _rms_norm(x, params["ln_f_scale"])
+    return cache, _unembed(x[:, -1:], params, cfg)[:, 0]
 
 
 def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
@@ -167,12 +224,17 @@ def top_p_mask(logits, p: float):
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
              temperature: float = 0.0, key=None,
              top_k: int | None = None, top_p: float | None = None,
-             prompt_lengths=None, eos_token: int | None = None):
+             prompt_lengths=None, eos_token: int | None = None,
+             use_prefill: bool | None = None):
     """Decode ``max_new_tokens`` past ``prompt [B, P]``; returns [B, P+N].
 
-    One compiled scan: prompt positions run through the same cached
-    step (teacher-forced), then sampling continues from the last
-    prompt token.  temperature == 0 is greedy argmax; with temperature
+    Prefill/decode split: uniform-length dense-FFN prompts run through
+    :func:`prefill` (one batched flash-attention forward fills the
+    whole cache) and the scan covers only generation positions; ragged
+    or MoE prompts fall back to teacher-forcing every prompt position
+    through the cached step.  ``use_prefill`` overrides the automatic
+    choice (True raises if the config cannot prefill).
+    temperature == 0 is greedy argmax; with temperature
     > 0, ``top_k`` and/or ``top_p`` (nucleus) restrict the sampling
     support — both applied to the temperature-scaled logits, top-k
     first, the standard composition.
@@ -240,16 +302,37 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
             f"eos_token must be in [0, vocab_size={cfg.vocab_size}), "
             f"got {eos_token}")
 
+    can_prefill = (pad_lens is None and not cfg.num_experts and p > 1)
+    if use_prefill is None:
+        use_prefill = can_prefill
+    elif use_prefill and not can_prefill:
+        raise ValueError(
+            "use_prefill=True needs a uniform-length (no prompt_lengths) "
+            "prompt of >= 2 tokens and a dense-FFN config (prefill "
+            "does not reproduce decode-time MoE routing)")
+
     # Buffer of emitted tokens; prompt occupies [0, p).
     buf = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
-    cache = init_cache(cfg, b)
+    if use_prefill:
+        # Cache holds K/V for [0, p); the scan starts at the last
+        # prompt position (its step recomputes identical K/V in place
+        # and yields the logits that sample token p).
+        cache, _ = prefill(params, prompt, cfg, last_logits=False)
+        start = p - 1
+    else:
+        cache = init_cache(cfg, b)
+        start = 0
     done = jnp.zeros((b,), bool)
 
     def body(carry, pos):
-        buf, cache, key, done = carry
+        buf, cache, done = carry
         tok = jax.lax.dynamic_index_in_dim(buf, pos, axis=1, keepdims=False)
         logits, cache = _decode_step(params, cache, tok, pos, cfg, pad_lens)
-        key, sub = jax.random.split(key)
+        # Position-keyed stream (not a split chain): the sampled tokens
+        # are a function of (key, position) alone, so the prefill path
+        # — whose scan skips the prompt positions — samples identically
+        # to the all-sequential path.
+        sub = jax.random.fold_in(key, pos)
         if temperature > 0:
             scaled = logits / temperature
             if top_k is not None:
@@ -270,10 +353,10 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
                                             keepdims=False)
         nxt = jnp.where(gen, nxt, keep)
         buf = jax.lax.dynamic_update_index_in_dim(buf, nxt, write_pos, axis=1)
-        return (buf, cache, key, done), None
+        return (buf, cache, done), None
 
-    (buf, _, _, _), _ = jax.lax.scan(body, (buf, cache, key, done),
-                                     jnp.arange(total - 1))
+    (buf, _, _), _ = jax.lax.scan(body, (buf, cache, done),
+                                  jnp.arange(start, total - 1))
     if pad_lens is not None:
         # Back to the input layout: prompt, generation, then padding.
         buf = jax.vmap(jnp.roll)(buf, -pad_lens)

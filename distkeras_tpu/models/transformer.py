@@ -217,17 +217,20 @@ def rope_rotate(x, ang):
                            axis=-1).astype(x.dtype)
 
 
-def _attention_block(lp, x, attention_fn, rope_ang=None, kv_groups=1):
+def _attention_block(lp, x, attention_fn, rope_ang=None, kv_groups=1,
+                     return_kv=False):
     q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"])
     k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
     v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
     if rope_ang is not None:
         q, k = rope_rotate(q, rope_ang), rope_rotate(k, rope_ang)
+    kv = (k, v)  # post-rope, pre-GQA-expansion: the decode cache layout
     if kv_groups > 1:  # GQA: expand shared K/V heads for the kernel
         k = jnp.repeat(k, kv_groups, axis=2)
         v = jnp.repeat(v, kv_groups, axis=2)
     out = attention_fn(q, k, v)
-    return jnp.einsum("bshk,hkd->bsd", out, lp["wo"])
+    out = jnp.einsum("bshk,hkd->bsd", out, lp["wo"])
+    return (out, kv) if return_kv else out
 
 
 def _moe_block(lp, x, cfg: TransformerConfig):
@@ -269,8 +272,12 @@ def _moe_block(lp, x, cfg: TransformerConfig):
 
 
 def block_apply(layer_params, x, cfg: TransformerConfig,
-                attention_fn: Callable, rope_ang=None, drop_key=None):
-    """One transformer block (pre-norm).  Returns (x, aux_loss).
+                attention_fn: Callable, rope_ang=None, drop_key=None,
+                return_kv=False):
+    """One transformer block (pre-norm).  Returns (x, aux_loss), or
+    (x, aux_loss, (k, v)) with ``return_kv`` (post-rope, kv-heads-only —
+    the decode-cache layout; generate.prefill consumes it so there is
+    exactly ONE definition of the block body to keep in sync).
 
     ``rope_ang`` and ``drop_key`` are *traced array* arguments (not
     closures) so the remat wrapper's static_argnums stay (2, 3) — a
@@ -279,7 +286,11 @@ def block_apply(layer_params, x, cfg: TransformerConfig,
     """
     h = _rms_norm(x, layer_params["ln1_scale"])
     a = _attention_block(layer_params["attn"], h, attention_fn, rope_ang,
-                         kv_groups=cfg.n_heads // cfg.kv_heads)
+                         kv_groups=cfg.n_heads // cfg.kv_heads,
+                         return_kv=return_kv)
+    kv = None
+    if return_kv:
+        a, kv = a
     if drop_key is not None:
         a = _dropout(a, cfg.dropout, jax.random.fold_in(drop_key, 0))
     x = x + a
@@ -294,7 +305,8 @@ def block_apply(layer_params, x, cfg: TransformerConfig,
         aux = jnp.zeros((), jnp.float32)
     if drop_key is not None:
         y = _dropout(y, cfg.dropout, jax.random.fold_in(drop_key, 1))
-    return x + y, aux
+    out = x + y
+    return (out, aux, kv) if return_kv else (out, aux)
 
 
 def apply_hidden(params, tokens, cfg: TransformerConfig,

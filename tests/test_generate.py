@@ -233,3 +233,70 @@ def test_generate_eos_sticky(rng):
         np.testing.assert_array_equal(out[1], free[1])
     with pytest.raises(ValueError, match="eos_token"):
         generate(params, prompt, CFG, 4, eos_token=64)
+
+
+# ------------------------------------------------------------------ prefill
+
+@pytest.mark.parametrize("cfg", [CFG, ROPE_CFG])
+def test_prefill_matches_sequential_generate(rng, cfg):
+    """The prefill/decode split is a pure optimization: outputs must
+    equal teacher-forcing every prompt position through the cached
+    step (same einsums, same dtype path)."""
+    params = tfm.init_params(jax.random.key(0), cfg)
+    prompt = jnp.asarray(rng.integers(0, 64, (3, 7)), jnp.int32)
+    seq = generate(params, prompt, cfg, max_new_tokens=8,
+                   use_prefill=False)
+    pre = generate(params, prompt, cfg, max_new_tokens=8,
+                   use_prefill=True)
+    np.testing.assert_array_equal(np.asarray(pre), np.asarray(seq))
+
+
+def test_prefill_matches_sequential_gqa(rng):
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_len=32,
+                                n_kv_heads=2, rope=True)
+    params = tfm.init_params(jax.random.key(1), cfg)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 11)), jnp.int32)
+    seq = generate(params, prompt, cfg, max_new_tokens=6,
+                   use_prefill=False)
+    pre = generate(params, prompt, cfg, max_new_tokens=6,
+                   use_prefill=True)
+    np.testing.assert_array_equal(np.asarray(pre), np.asarray(seq))
+
+
+def test_prefill_sampling_matches_sequential(rng):
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 7)), jnp.int32)
+    kw = dict(temperature=0.8, key=jax.random.key(5), top_k=8)
+    seq = generate(params, prompt, CFG, 6, use_prefill=False, **kw)
+    pre = generate(params, prompt, CFG, 6, use_prefill=True, **kw)
+    np.testing.assert_array_equal(np.asarray(pre), np.asarray(seq))
+
+
+def test_prefill_eos_matches_sequential(rng):
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (4, 5)), jnp.int32)
+    seq = generate(params, prompt, CFG, 10, eos_token=3,
+                   use_prefill=False)
+    pre = generate(params, prompt, CFG, 10, eos_token=3,
+                   use_prefill=True)
+    np.testing.assert_array_equal(np.asarray(pre), np.asarray(seq))
+
+
+def test_prefill_rejections(rng):
+    from distkeras_tpu.models.generate import prefill
+
+    params = tfm.init_params(jax.random.key(0), MOE_CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 5)), jnp.int32)
+    with pytest.raises(ValueError, match="dense-FFN"):
+        prefill(params, prompt, MOE_CFG)
+    with pytest.raises(ValueError, match="use_prefill"):
+        generate(params, prompt, MOE_CFG, 4, use_prefill=True)
+    # Ragged prompts keep the sequential path.
+    params_d = tfm.init_params(jax.random.key(0), CFG)
+    with pytest.raises(ValueError, match="use_prefill"):
+        generate(params_d, prompt, CFG, 4, use_prefill=True,
+                 prompt_lengths=np.array([3, 5]))
+    # MoE + auto gate: silently sequential, still works.
+    out = generate(params, prompt, MOE_CFG, 4)
+    assert out.shape == (2, 9)
