@@ -67,6 +67,10 @@ def prefill(params, prompt, cfg: TransformerConfig,
             "forward does not reproduce (see generate's MoE caveat)")
     dtype = jnp.dtype(cfg.dtype)
     b, p_len = prompt.shape
+    if p_len > cfg.max_len:
+        raise ValueError(
+            f"prompt length {p_len} exceeds max_len={cfg.max_len} "
+            "(the KV cache size)")
     x = params["tok_emb"][prompt].astype(dtype)
     rope_ang = None
     if cfg.rope:

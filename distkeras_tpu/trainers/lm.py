@@ -204,9 +204,43 @@ class LMTrainer(CheckpointingBase):
 
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _put_global(tree, shardings):
+        """Host pytree -> mesh-placed pytree, multi-process safe.
+
+        Single process: plain ``device_put``.  Multi-process SPMD (the
+        mesh spans hosts): every process holds the identical full host
+        array (same-seeded parameter init), so each leaf is assembled
+        per-shard via ``make_array_from_callback`` — ``device_put``
+        cannot target non-addressable devices.  Per-host *data* (token
+        batches, eval chunks) goes through :meth:`_global_batch`
+        instead.
+        """
+        if jax.process_count() == 1:
+            return jax.device_put(tree, shardings)
+
+        def put(x, sh):
+            x = np.asarray(x)
+            return jax.make_array_from_callback(x.shape, sh,
+                                                lambda idx: x[idx])
+
+        return jax.tree.map(put, tree, shardings)
+
+    def _global_batch(self, block, sharding):
+        """Per-step token block -> device batch across the mesh.
+
+        Multi-process: each process passes only ITS rows (the caller
+        feeds per-host data, e.g. ``tokens[process_index::count]``) and
+        the global batch is assembled from the process-local slab —
+        same contract as the Keras trainer family
+        (trainers/distributed.py::_global_batch)."""
+        if jax.process_count() == 1:
+            return jax.device_put(block, sharding)
+        return jax.make_array_from_process_local_data(sharding, block)
+
     def init_params(self):
         params = tfm.init_params(jax.random.key(self.seed), self.cfg)
-        return jax.device_put(
+        return self._put_global(
             params, self.plan.tree_shardings(self.mesh, params))
 
     def _state_shardings(self, params, opt_state):
@@ -234,11 +268,23 @@ class LMTrainer(CheckpointingBase):
         and once at the end (round -1) into ``eval_history``; fed in
         ``batch_size`` chunks, dropping a remainder of up to
         ``batch_size - 1`` rows (static shapes, one compiled program).
+
+        Multi-process: BOTH ``dataset`` and ``eval_tokens`` are this
+        host's shard (e.g. ``rows[process_index::process_count]``), and
+        every host must pass the same row counts — each eval chunk is
+        ``batch_size / process_count`` local rows assembled into one
+        global batch, so feeding the full set on every host would
+        evaluate each row ``process_count`` times.
         """
         tokens = (dataset if isinstance(dataset, np.ndarray)
                   else dataset[self.tokens_col])
         if tokens.ndim != 2:
             raise ValueError(f"tokens must be [N, seq+1], got {tokens.shape}")
+        # Multi-process SPMD: every process runs this same loop over its
+        # OWN rows (feed tokens[process_index::process_count] or
+        # Dataset.shard) — all hosts must pass the same row count or
+        # their step counts diverge and the collectives deadlock.
+        n_proc = jax.process_count()
         n_data = int(self.mesh.shape["data"])
         n_seq = int(self.mesh.shape["seq"])
         seq_len = tokens.shape[1] - 1
@@ -257,6 +303,11 @@ class LMTrainer(CheckpointingBase):
                 f"batch_size={global_bs} must divide by data axis ({n_data})"
                 + (f" x microbatches ({self.microbatches})"
                    if divisor != n_data else ""))
+        if n_proc > 1 and n_data % n_proc:
+            raise ValueError(
+                f"multi-process training needs the data axis ({n_data}) to "
+                f"divide by the process count ({n_proc}) so every host "
+                "feeds its own devices' shards")
         if self.shuffle:
             # Same permutation contract as Dataset.shuffle; the row
             # gather runs through the native threaded loader when built.
@@ -275,10 +326,10 @@ class LMTrainer(CheckpointingBase):
                 raise ValueError(
                     f"eval_tokens must be [M, {tokens.shape[1]}] like the "
                     f"training rows, got {eval_tokens.shape}")
-            if len(eval_tokens) < global_bs:
+            if len(eval_tokens) < global_bs // n_proc:
                 raise ValueError(
                     f"eval_tokens has {len(eval_tokens)} rows; one eval "
-                    f"batch needs {global_bs}")
+                    f"batch needs {global_bs // n_proc} per process")
 
         t0 = time.perf_counter()
         # Fail fast on a bad checkpoint_dir before paying parameter
@@ -293,10 +344,13 @@ class LMTrainer(CheckpointingBase):
             # but the checkpoint-restore template takes each leaf's
             # sharding literally, so adam's scalar count would come back
             # pinned to one device while params span the mesh — an
-            # invalid mix.
-            opt_state = self.optimizer.init(params)
-            psh, osh = self._state_shardings(params, opt_state)
-            opt_state = jax.device_put(opt_state, osh)
+            # invalid mix.  Built under jit with explicit out_shardings
+            # (structure from eval_shape): eager optax init on params
+            # spanning non-addressable devices would fail multi-process.
+            opt_shapes = jax.eval_shape(self.optimizer.init, params)
+            psh, osh = self._state_shardings(params, opt_shapes)
+            opt_state = jax.jit(self.optimizer.init,
+                                out_shardings=osh)(params)
             tok_sh = NamedSharding(self.mesh, P("data", None))
             # With accumulation the fed block is [accum, B, S+1]: the
             # microbatch axis leads, batch still shards over data.
@@ -326,14 +380,15 @@ class LMTrainer(CheckpointingBase):
                 import math
 
                 nll = jax.jit(self._nll_fn)
-                n_eval = len(eval_tokens) - (len(eval_tokens) % global_bs)
+                eval_bs = global_bs // n_proc  # rows per process
+                n_eval = len(eval_tokens) - (len(eval_tokens) % eval_bs)
                 # Stage the eval chunks once; every eval round reuses
                 # the device arrays instead of re-paying the transfer.
                 eval_chunks = [
-                    jax.device_put(
-                        np.asarray(eval_tokens[j:j + global_bs], np.int32),
+                    self._global_batch(
+                        np.asarray(eval_tokens[j:j + eval_bs], np.int32),
                         tok_sh)
-                    for j in range(0, n_eval, global_bs)]
+                    for j in range(0, n_eval, eval_bs)]
 
                 def eval_fn(carry, rnd):
                     ps = carry[0]
@@ -353,12 +408,16 @@ class LMTrainer(CheckpointingBase):
                         nll(params, eval_chunks[0]))
 
             carry, losses = (params, opt_state), []
-            rows_per_step = global_bs * self.grad_accum
+            # Multi-process: ``tokens`` holds only this host's rows, so
+            # each step consumes 1/n_proc of the global row count and
+            # the global batch is assembled shard-wise (_global_batch).
+            rows_per_step = global_bs * self.grad_accum // n_proc
             n_rows = len(tokens) - (len(tokens) % rows_per_step)
             if not n_rows:
                 raise ValueError(
                     f"dataset has {len(tokens)} rows; one step needs "
-                    f"{rows_per_step} (batch_size x grad_accum)")
+                    f"{rows_per_step} (batch_size x grad_accum"
+                    + (f" / {n_proc} processes)" if n_proc > 1 else ")"))
             carry, start = self._restore_or(carry)
             rnd = 0
             # Profile rounds relative to the first *executed* round
@@ -372,9 +431,10 @@ class LMTrainer(CheckpointingBase):
                         continue
                     block = np.asarray(tokens[i:i + rows_per_step], np.int32)
                     if self.grad_accum > 1:
-                        block = block.reshape(self.grad_accum, global_bs,
+                        block = block.reshape(self.grad_accum,
+                                              global_bs // n_proc,
                                               block.shape[1])
-                    batch = jax.device_put(block, step_sh)
+                    batch = self._global_batch(block, step_sh)
                     if self.profile_dir and rnd == prof_start:
                         jax.profiler.start_trace(self.profile_dir)
                         profiling = True

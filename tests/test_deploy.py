@@ -279,3 +279,106 @@ def test_two_process_downpour_matches_single_process(tmp_path, devices):
         np.testing.assert_allclose(a, b, rtol=5e-5, atol=5e-6)
     np.testing.assert_allclose(got["losses"], np.asarray(t.history),
                                rtol=1e-5)
+
+
+MULTIHOST_LM_CHILD = """
+import os, sys
+os.environ["KERAS_BACKEND"] = "jax"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tests!r})
+from distkeras_tpu.deploy import init_from_env
+init_from_env()
+
+import numpy as np
+import distkeras_tpu as dk
+from distkeras_tpu.models.transformer import TransformerConfig
+
+assert jax.process_count() == 2
+host = int(os.environ["DKT_HOST_ID"])
+
+rng = np.random.default_rng(0)
+tokens = np.repeat(rng.integers(0, 64, (64, 1)), 17, axis=1).astype(np.int32)
+cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                        d_ff=64, max_len=17)
+tr = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=16, num_epoch=1)
+params = tr.train(tokens[host::2])  # strided per-host shard
+assert len(tr.history) == 4, tr.history
+if host == 0:
+    flat = {{"/".join(map(str, p)): np.asarray(v)
+            for p, v in jax.tree_util.tree_flatten_with_path(params)[0]}}
+    np.savez({out!r}, losses=np.asarray(tr.history), **flat)
+print("HOST", host, "OK", flush=True)
+"""
+
+
+def test_two_process_lm_trainer_matches_single_process(tmp_path, devices):
+    """The flagship LMTrainer on the real multi-process runtime: each
+    host feeds its strided row shard, the global batch is assembled
+    from process-local slabs (make_array_from_process_local_data), and
+    the optimizer state is built under jit with global shardings.  A
+    step's global batch is the same row *set* as the single-process
+    run's (strided shard + contiguous blocks), and mean-loss gradients
+    are permutation invariant, so losses and trained params must match.
+    """
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests = os.path.join(repo, "tests")
+    out = str(tmp_path / "host0.npz")
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    job = Job(script="<inline>", num_hosts=2, coordinator=f"localhost:{port}")
+
+    procs = []
+    for h in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env.update(job.env_for(h))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             MULTIHOST_LM_CHILD.format(repo=repo, tests=tests, out=out)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    fail = []
+    for h, p in enumerate(procs):
+        try:
+            stdout, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        if p.returncode != 0:
+            fail.append(f"host {h} rc={p.returncode}\n"
+                        f"{stdout.decode(errors='replace')[-3000:]}")
+    assert not fail, "\n---\n".join(fail)
+
+    # Single-process reference on the full dataset.
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.transformer import TransformerConfig
+
+    rng = np.random.default_rng(0)
+    tokens = np.repeat(rng.integers(0, 64, (64, 1)), 17,
+                       axis=1).astype(np.int32)
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_len=17)
+    tr = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=16, num_epoch=1)
+    params = tr.train(tokens)
+
+    import jax as jx
+
+    got = np.load(out)
+    np.testing.assert_allclose(got["losses"], np.asarray(tr.history),
+                               rtol=1e-4, atol=1e-5)
+    ref = {"/".join(map(str, p)): np.asarray(v)
+           for p, v in jx.tree_util.tree_flatten_with_path(params)[0]}
+    for k, v in ref.items():
+        np.testing.assert_allclose(got[k], v, rtol=1e-4, atol=1e-5,
+                                   err_msg=k)

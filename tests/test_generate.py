@@ -300,3 +300,12 @@ def test_prefill_rejections(rng):
     # MoE + auto gate: silently sequential, still works.
     out = generate(params, prompt, MOE_CFG, 4)
     assert out.shape == (2, 9)
+
+
+def test_prefill_rejects_overlong_prompt(rng):
+    from distkeras_tpu.models.generate import prefill
+
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, CFG.max_len + 2)), jnp.int32)
+    with pytest.raises(ValueError, match="max_len"):
+        prefill(params, prompt, CFG)
