@@ -74,6 +74,13 @@ class TransformerConfig:
     # FLOPs — the standard long-context/deep-model trade on TPU, where
     # HBM, not MXU, is the usual ceiling.
     remat: bool = False
+    # Selective remat (only with remat=True): which intermediates the
+    # backward may keep instead of recomputing.  None = recompute
+    # everything (max memory saving); "dots" saves matmul outputs
+    # (recompute only the cheap elementwise work — most of the no-remat
+    # speed at a fraction of its memory); "dots_no_batch" saves only
+    # matmuls without batch dims (weight-stationary contractions).
+    remat_policy: str | None = None
     # Vocab-head cross-entropy chunking (training/eval loss only).
     # With ce_chunks > 1 the loss computes the [tokens, vocab] logits in
     # ce_chunks sequential slices, each rematerialized in the backward,
@@ -99,6 +106,27 @@ class TransformerConfig:
         return kv
 
 
+_REMAT_POLICIES = {
+    None: None,
+    "dots": "checkpoint_dots",
+    "dots_no_batch": "dots_with_no_batch_dims_saveable",
+}
+
+
+def _remat_block(cfg: "TransformerConfig"):
+    """``block_apply`` wrapped per cfg.remat / cfg.remat_policy."""
+    if not cfg.remat:
+        return block_apply
+    if cfg.remat_policy not in _REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {cfg.remat_policy!r}; "
+            f"known: {sorted(k for k in _REMAT_POLICIES if k)} or None")
+    name = _REMAT_POLICIES[cfg.remat_policy]
+    policy = getattr(jax.checkpoint_policies, name) if name else None
+    return jax.checkpoint(block_apply, static_argnums=(2, 3),
+                          policy=policy)
+
+
 def _dense_init(rng, shape, fan_in):
     return jax.random.normal(rng, shape, jnp.float32) / math.sqrt(fan_in)
 
@@ -111,6 +139,16 @@ def init_params(rng, cfg: TransformerConfig):
         raise ValueError(f"dropout must be in [0, 1), got {cfg.dropout}")
     if cfg.ce_chunks < 0:
         raise ValueError(f"ce_chunks must be >= 0, got {cfg.ce_chunks}")
+    if cfg.remat_policy is not None:
+        if cfg.remat_policy not in _REMAT_POLICIES:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r}; "
+                f"known: {sorted(k for k in _REMAT_POLICIES if k)} or None")
+        if not cfg.remat:
+            raise ValueError(
+                "remat_policy is set but remat=False — the policy only "
+                "selects what a rematerialized backward may save; enable "
+                "remat=True (or drop the policy)")
     keys = jax.random.split(rng, 12)
     d, f, h, hd = cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.head_dim
     kv = cfg.kv_heads
@@ -337,9 +375,7 @@ def apply_hidden(params, tokens, cfg: TransformerConfig,
 
     aux_total = jnp.zeros((), jnp.float32)
 
-    block = block_apply
-    if cfg.remat:
-        block = jax.checkpoint(block_apply, static_argnums=(2, 3))
+    block = _remat_block(cfg)
 
     # Python loop (not scan): attention_fn may close over shard_map /
     # pallas calls whose tracing under scan complicates sharding; layer
@@ -481,9 +517,7 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
         lambda a: a.reshape(n_stages, per_stage, *a.shape[1:]),
         params["layers"])
 
-    block = block_apply
-    if cfg.remat:
-        block = jax.checkpoint(block_apply, static_argnums=(2, 3))
+    block = _remat_block(cfg)
 
     seq_sharded = x_spec != P()
 

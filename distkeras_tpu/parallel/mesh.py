@@ -101,3 +101,19 @@ def initialize_multihost(coordinator_address: str | None = None,
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:
     return int(mesh.shape[axis])
+
+
+def global_batch(arr, sharding):
+    """Host batch -> device batch across the (possibly multi-host) mesh.
+
+    Single-process: plain ``device_put`` under the sharding.
+    Multi-process SPMD (the Spark-executor analogue, SURVEY.md §5):
+    every process holds only ITS rows (its ``Dataset.shard``), so the
+    global array is assembled from the process-local slab — each host's
+    rows land on its own devices and the collectives do the rest.  The
+    single shared definition: the trainer family and LMTrainer both
+    route batches through here.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_process_local_data(sharding, arr)

@@ -28,7 +28,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.data.dataset import Dataset
-from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+from distkeras_tpu.parallel.mesh import (MeshSpec, make_mesh,
+                                          global_batch as mesh_global_batch)
 from distkeras_tpu.parallel.sharding import ShardingPlan, dp_plan, fsdp_plan
 from distkeras_tpu.trainers.base import Trainer
 
@@ -81,19 +82,10 @@ class DistributedTrainer(Trainer):
         spec = (P(None, "data") if leading_window else P("data"))
         return NamedSharding(self.mesh, spec)
 
-    def _global_batch(self, arr, sharding):
-        """Host batch -> device batch across the (possibly multi-host) mesh.
-
-        Single-process: hand the numpy array straight to jit (it places
-        it under the in_sharding).  Multi-process SPMD (the Spark-
-        executor analogue, SURVEY.md §5): every process holds only its
-        Dataset.shard's rows, so the global array is assembled from the
-        process-local slab — each host's rows land on its own devices,
-        and the all-reduce over ``data`` does the rest.
-        """
-        if jax.process_count() == 1:
-            return arr
-        return jax.make_array_from_process_local_data(sharding, arr)
+    # Batch staging shares one definition with LMTrainer
+    # (parallel.mesh.global_batch): process-local slab assembly
+    # multi-process, device_put under the sharding single-process.
+    _global_batch = staticmethod(mesh_global_batch)
 
 
 class ADAG(DistributedTrainer):

@@ -29,7 +29,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models import transformer as tfm
-from distkeras_tpu.parallel.mesh import AXES, make_mesh
+from distkeras_tpu.parallel.mesh import (AXES, make_mesh,
+                                          global_batch as mesh_global_batch)
 from distkeras_tpu.parallel.ring import make_ring_attention
 from distkeras_tpu.parallel.sharding import ShardingPlan
 from distkeras_tpu.trainers.base import CheckpointingBase
@@ -226,17 +227,10 @@ class LMTrainer(CheckpointingBase):
 
         return jax.tree.map(put, tree, shardings)
 
-    def _global_batch(self, block, sharding):
-        """Per-step token block -> device batch across the mesh.
-
-        Multi-process: each process passes only ITS rows (the caller
-        feeds per-host data, e.g. ``tokens[process_index::count]``) and
-        the global batch is assembled from the process-local slab —
-        same contract as the Keras trainer family
-        (trainers/distributed.py::_global_batch)."""
-        if jax.process_count() == 1:
-            return jax.device_put(block, sharding)
-        return jax.make_array_from_process_local_data(sharding, block)
+    # Per-step token blocks and eval chunks route through the shared
+    # parallel.mesh.global_batch (one definition of the process-local
+    # slab assembly for the whole trainer family).
+    _global_batch = staticmethod(mesh_global_batch)
 
     def init_params(self):
         params = tfm.init_params(jax.random.key(self.seed), self.cfg)

@@ -261,6 +261,17 @@ def bench_transformer_long_rope():
         batch=8, seq=4096, iters=20)
 
 
+def bench_transformer_long_rematdots():
+    """Long config with selective remat (policy='dots': matmul outputs
+    saved, elementwise recomputed) — the middle point between full
+    remat and no remat."""
+    import dataclasses
+
+    return _measure_lm(
+        dataclasses.replace(_long_cfg(), remat_policy="dots"),
+        batch=8, seq=4096, iters=20)
+
+
 def bench_transformer_long_noremat():
     """Same config without per-block rematerialization (fits at this
     size; remat trades ~13% step time for O(1)-block activations)."""
@@ -472,6 +483,8 @@ BENCHES = {
     "generate_decode": (bench_generate_decode, "tokens/sec/chip"),
     "transformer_long": (bench_transformer_long, "tokens/sec/chip"),
     "transformer_long_rope": (bench_transformer_long_rope, "tokens/sec/chip"),
+    "transformer_long_rematdots": (bench_transformer_long_rematdots,
+                                   "tokens/sec/chip"),
     "transformer_long_noremat": (bench_transformer_long_noremat,
                                  "tokens/sec/chip"),
     "transformer_long_xla": (bench_transformer_long_xla, "tokens/sec/chip"),

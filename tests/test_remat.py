@@ -38,3 +38,45 @@ def test_transformer_pipelined_remat(devices, rng):
     out, _ = jax.jit(lambda p, t: tfm.apply_pipelined(p, t, cfg_r, mesh, 2))(
         params, toks)
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_remat_policy_matches_plain_remat(rng):
+    """Selective remat changes what the backward saves, never the math:
+    loss and grads must match full remat and no remat exactly."""
+    import dataclasses
+
+    from distkeras_tpu.models import transformer as tfm
+
+    base = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, max_len=32)
+    params = tfm.init_params(jax.random.key(0), base)
+    t = jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32)
+    ref_l, ref_g = jax.value_and_grad(tfm.lm_loss)(params, t, base)
+    for kw in ({"remat": True},
+               {"remat": True, "remat_policy": "dots"},
+               {"remat": True, "remat_policy": "dots_no_batch"}):
+        cfg = dataclasses.replace(base, **kw)
+        l, g = jax.value_and_grad(tfm.lm_loss)(params, t, cfg)
+        np.testing.assert_allclose(float(l), float(ref_l), rtol=1e-6,
+                                   err_msg=str(kw))
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            a, b, atol=1e-5, rtol=1e-5), ref_g, g)
+
+
+def test_remat_policy_validation(rng):
+    import dataclasses
+
+    import pytest
+
+    from distkeras_tpu.models import transformer as tfm
+
+    base = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=1, d_ff=64, max_len=16)
+    with pytest.raises(ValueError, match="remat_policy"):
+        tfm.init_params(jax.random.key(0),
+                        dataclasses.replace(base, remat=True,
+                                            remat_policy="bogus"))
+    # A policy without remat=True would be silently inert; refuse it.
+    with pytest.raises(ValueError, match="remat=False"):
+        tfm.init_params(jax.random.key(0),
+                        dataclasses.replace(base, remat_policy="dots"))
