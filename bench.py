@@ -31,6 +31,18 @@ SPARK8_CPU_PROXY_SPS = 2137.0  # samples/sec; provenance in module docstring
 
 
 def main():
+    # Fail loud, not hung: the relay's backend init can block forever
+    # when the tunnel is down — record an error line instead of
+    # stalling the driver's bench step.
+    from distkeras_tpu.utils.misc import probe_devices
+
+    try:
+        probe_devices(deadline_s=180.0)
+    except Exception as e:
+        print(json.dumps({"metric": "cifar_cnn_train_throughput",
+                          "error": repr(e)[:200]}))
+        sys.exit(1)
+
     from bench_suite import bench_cifar_cnn, peak_flops
 
     sps, step_s, step_flops = bench_cifar_cnn()[:3]

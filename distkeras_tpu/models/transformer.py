@@ -113,14 +113,26 @@ _REMAT_POLICIES = {
 }
 
 
-def _remat_block(cfg: "TransformerConfig"):
-    """``block_apply`` wrapped per cfg.remat / cfg.remat_policy."""
-    if not cfg.remat:
-        return block_apply
+def _validate_remat_policy(cfg: "TransformerConfig") -> None:
+    """Single enforcement point for the remat knobs (init + wrap time)."""
+    if cfg.remat_policy is None:
+        return
     if cfg.remat_policy not in _REMAT_POLICIES:
         raise ValueError(
             f"unknown remat_policy {cfg.remat_policy!r}; "
             f"known: {sorted(k for k in _REMAT_POLICIES if k)} or None")
+    if not cfg.remat:
+        raise ValueError(
+            "remat_policy is set but remat=False — the policy only "
+            "selects what a rematerialized backward may save; enable "
+            "remat=True (or drop the policy)")
+
+
+def _remat_block(cfg: "TransformerConfig"):
+    """``block_apply`` wrapped per cfg.remat / cfg.remat_policy."""
+    _validate_remat_policy(cfg)
+    if not cfg.remat:
+        return block_apply
     name = _REMAT_POLICIES[cfg.remat_policy]
     policy = getattr(jax.checkpoint_policies, name) if name else None
     return jax.checkpoint(block_apply, static_argnums=(2, 3),
@@ -139,16 +151,7 @@ def init_params(rng, cfg: TransformerConfig):
         raise ValueError(f"dropout must be in [0, 1), got {cfg.dropout}")
     if cfg.ce_chunks < 0:
         raise ValueError(f"ce_chunks must be >= 0, got {cfg.ce_chunks}")
-    if cfg.remat_policy is not None:
-        if cfg.remat_policy not in _REMAT_POLICIES:
-            raise ValueError(
-                f"unknown remat_policy {cfg.remat_policy!r}; "
-                f"known: {sorted(k for k in _REMAT_POLICIES if k)} or None")
-        if not cfg.remat:
-            raise ValueError(
-                "remat_policy is set but remat=False — the policy only "
-                "selects what a rematerialized backward may save; enable "
-                "remat=True (or drop the policy)")
+    _validate_remat_policy(cfg)
     keys = jax.random.split(rng, 12)
     d, f, h, hd = cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.head_dim
     kv = cfg.kv_heads

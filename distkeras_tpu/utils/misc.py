@@ -26,3 +26,37 @@ def uniform_weights(model, bounds=(-0.5, 0.5), seed: int | None = None):
         [rng.uniform(low, high, size=w.shape).astype(w.dtype)
          for w in model.get_weights()])
     return model
+
+
+def probe_devices(deadline_s: float = 120.0):
+    """``jax.devices()`` with a deadline, on a daemon thread.
+
+    The axon relay's backend init can HANG outright when the device
+    tunnel is down; callers that must not stall (the driver entry
+    gate, bench.py) probe through this instead.  Returns the device
+    list; raises ``TimeoutError`` on a hang or re-raises the probe's
+    own error.  The single shared definition — keep hang-mode fixes
+    here.
+    """
+    import threading
+
+    import jax
+
+    found, err = [], []
+
+    def probe():
+        try:
+            found.extend(jax.devices())
+        except Exception as e:  # noqa: BLE001 — surface to the caller
+            err.append(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=deadline_s)
+    if t.is_alive():
+        raise TimeoutError(
+            f"jax device discovery hung >{deadline_s:.0f}s — accelerator "
+            "tunnel down?")
+    if err:
+        raise err[0]
+    return found
