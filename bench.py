@@ -39,8 +39,11 @@ def main():
     try:
         probe_devices(deadline_s=180.0)
     except Exception as e:
+        # Keep the documented one-line key set; null value signals "no
+        # measurement" to contract-parsing consumers.
         print(json.dumps({"metric": "cifar_cnn_train_throughput",
-                          "error": repr(e)[:200]}))
+                          "value": None, "unit": "samples/sec/chip",
+                          "vs_baseline": None, "error": repr(e)[:200]}))
         sys.exit(1)
 
     from bench_suite import bench_cifar_cnn, peak_flops

@@ -26,6 +26,7 @@ from distkeras_tpu.models.transformer import (
     rope_angles,
     rope_rotate,
 )
+from distkeras_tpu.models.quant import deq, embed_rows, is_quantized
 from distkeras_tpu.ops.attention import flash_attention
 
 
@@ -114,7 +115,7 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
     """
     dtype = jnp.dtype(cfg.dtype)
     b = tokens.shape[0]
-    x = params["tok_emb"][tokens].astype(dtype)  # [B, D]
+    x = embed_rows(params["tok_emb"], tokens, dtype)  # [B, D]
     if pad_lens is None:
         pos_ids = jnp.full((b,), pos)
     else:
@@ -131,11 +132,13 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
     for i in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
         h = _rms_norm(x, lp["ln1_scale"])
-        q = jnp.einsum("bd,dhk->bhk", h, lp["attn"]["wq"])
+        # deq: int8 weights dequantize here (fused into the matmul
+        # read); plain trees pass through untouched.
+        q = jnp.einsum("bd,dhk->bhk", h, deq(lp["attn"]["wq"]))
         # Cache dtype: the einsum promotes bf16 activations x f32 weights
         # to f32; the cache stays in the compute dtype.
-        k = jnp.einsum("bd,dhk->bhk", h, lp["attn"]["wk"])
-        v = jnp.einsum("bd,dhk->bhk", h, lp["attn"]["wv"])
+        k = jnp.einsum("bd,dhk->bhk", h, deq(lp["attn"]["wk"]))
+        v = jnp.einsum("bd,dhk->bhk", h, deq(lp["attn"]["wv"]))
         if rope_ang is not None:
             # Keys cache post-rotation (each key's rotation depends only
             # on its own position), matching the training forward.
@@ -167,7 +170,7 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
                           cv.astype(jnp.float32)).reshape(
             b, cfg.n_heads, cfg.head_dim)
         x = x + jnp.einsum("bhk,hkd->bd", attn.astype(dtype),
-                           lp["attn"]["wo"])
+                           deq(lp["attn"]["wo"]))
 
         h = _rms_norm(x, lp["ln2_scale"])
         if cfg.num_experts:
@@ -186,12 +189,13 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
         else:
             y = jnp.einsum(
                 "bf,fd->bd",
-                jax.nn.gelu(jnp.einsum("bd,df->bf", h, lp["ffn"]["w1"])),
-                lp["ffn"]["w2"])
+                jax.nn.gelu(jnp.einsum("bd,df->bf", h,
+                                       deq(lp["ffn"]["w1"]))),
+                deq(lp["ffn"]["w2"]))
         x = x + y
 
     x = _rms_norm(x, params["ln_f_scale"])
-    out = jnp.einsum("bd,vd->bv", x, params["tok_emb"].astype(dtype))
+    out = jnp.einsum("bd,vd->bv", x, deq(params["tok_emb"], dtype))
     cache = {"k": jnp.stack(new_cache_k), "v": jnp.stack(new_cache_v)}
     return out.astype(jnp.float32), cache
 
@@ -306,14 +310,17 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
             f"eos_token must be in [0, vocab_size={cfg.vocab_size}), "
             f"got {eos_token}")
 
-    can_prefill = (pad_lens is None and not cfg.num_experts and p > 1)
+    can_prefill = (pad_lens is None and not cfg.num_experts and p > 1
+                   and not is_quantized(params))
     if use_prefill is None:
         use_prefill = can_prefill
     elif use_prefill and not can_prefill:
         raise ValueError(
             "use_prefill=True needs a uniform-length (no prompt_lengths) "
-            "prompt of >= 2 tokens and a dense-FFN config (prefill "
-            "does not reproduce decode-time MoE routing)")
+            "prompt of >= 2 tokens, a dense-FFN config (prefill does not "
+            "reproduce decode-time MoE routing), and full-precision "
+            "params (the batched prefill forward wants the training "
+            "weights — quantize for decode-heavy work)")
 
     # Buffer of emitted tokens; prompt occupies [0, p).
     buf = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)

@@ -113,15 +113,23 @@ _REMAT_POLICIES = {
 }
 
 
-def _validate_remat_policy(cfg: "TransformerConfig") -> None:
-    """Single enforcement point for the remat knobs (init + wrap time)."""
+def _validate_remat_policy(cfg: "TransformerConfig",
+                           require_remat: bool = True) -> None:
+    """Single enforcement point for the remat knobs.
+
+    ``require_remat=True`` (init_params) also rejects a policy with
+    remat=False — a config *built* that way is a mistake.  Wrap time
+    passes False: ``dataclasses.replace(cfg, remat=False)`` on a
+    training config is the natural way to run eval/inference, and the
+    leftover policy is simply inert there.
+    """
     if cfg.remat_policy is None:
         return
     if cfg.remat_policy not in _REMAT_POLICIES:
         raise ValueError(
             f"unknown remat_policy {cfg.remat_policy!r}; "
             f"known: {sorted(k for k in _REMAT_POLICIES if k)} or None")
-    if not cfg.remat:
+    if require_remat and not cfg.remat:
         raise ValueError(
             "remat_policy is set but remat=False — the policy only "
             "selects what a rematerialized backward may save; enable "
@@ -130,9 +138,9 @@ def _validate_remat_policy(cfg: "TransformerConfig") -> None:
 
 def _remat_block(cfg: "TransformerConfig"):
     """``block_apply`` wrapped per cfg.remat / cfg.remat_policy."""
-    _validate_remat_policy(cfg)
     if not cfg.remat:
         return block_apply
+    _validate_remat_policy(cfg, require_remat=False)
     name = _REMAT_POLICIES[cfg.remat_policy]
     policy = getattr(jax.checkpoint_policies, name) if name else None
     return jax.checkpoint(block_apply, static_argnums=(2, 3),

@@ -337,6 +337,37 @@ def bench_generate_decode():
     return rate, dt_pre / new, 0.0, extras
 
 
+def bench_generate_decode_int8():
+    """Same decode workload with int8-quantized weights (models/quant):
+    the sequential loop is weight-bandwidth-bound, so halving the
+    weight bytes vs bf16 is the lever.  Short prompt (the int8 path is
+    sequential-only; its regime is generation-heavy serving)."""
+    import jax
+    import numpy as np
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.models.generate import generate
+    from distkeras_tpu.models.quant import quantize_params
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=32768, d_model=512, n_heads=4, n_layers=4, d_ff=2048,
+        max_len=1025, dtype="bfloat16")
+    qparams = quantize_params(tfm.init_params(jax.random.key(0), cfg))
+    batch, p_len, new = 8, 16, 512
+    prompt = jax.device_put(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, p_len)).astype(np.int32))
+
+    gen = jax.jit(lambda pp, pr: generate(pp, pr, cfg, new))
+    int(np.asarray(gen(qparams, prompt))[0, -1])
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = gen(qparams, prompt)
+    int(np.asarray(out)[0, -1])
+    dt = (time.perf_counter() - t0) / iters
+    return batch * new / dt, dt / new, 0.0, {"prompt_len": p_len,
+                                             "new_tokens": new}
+
+
 def bench_cifar_cnn_hostdata():
     """End-to-end input pipeline: host uint8 rows -> native gather ->
     DeviceFeed (async h2d, uint8 on the wire) -> multi-step scan with
@@ -481,6 +512,7 @@ BENCHES = {
     "transformer": (bench_transformer, "tokens/sec/chip"),
     "transformer_fusedce": (bench_transformer_fusedce, "tokens/sec/chip"),
     "generate_decode": (bench_generate_decode, "tokens/sec/chip"),
+    "generate_decode_int8": (bench_generate_decode_int8, "tokens/sec/chip"),
     "transformer_long": (bench_transformer_long, "tokens/sec/chip"),
     "transformer_long_rope": (bench_transformer_long_rope, "tokens/sec/chip"),
     "transformer_long_rematdots": (bench_transformer_long_rematdots,

@@ -309,3 +309,75 @@ def test_prefill_rejects_overlong_prompt(rng):
     prompt = jnp.asarray(rng.integers(0, 64, (2, CFG.max_len + 2)), jnp.int32)
     with pytest.raises(ValueError, match="max_len"):
         prefill(params, prompt, CFG)
+
+
+# ---------------------------------------------------------------- int8 decode
+
+def test_quantize_roundtrip_error_bound(rng):
+    from distkeras_tpu.models.quant import quantize_params
+
+    params = tfm.init_params(jax.random.key(0), CFG)
+    qp = quantize_params(params)
+    w = np.asarray(params["layers"]["attn"]["wq"])
+    dq = np.asarray(qp["layers"]["attn"]["wq"].dequant())
+    # Symmetric absmax int8: per-channel error <= scale/2 = amax/254.
+    amax = np.abs(w).max(axis=1, keepdims=True)
+    assert np.all(np.abs(dq - w) <= amax / 254 + 1e-7)
+
+
+def test_quantized_decode_matches_f32_greedy(rng):
+    """On a trained model the int8 decode must reproduce the f32 greedy
+    tokens (easy task -> logit margins dwarf the ~0.4% rounding)."""
+    import optax
+
+    from distkeras_tpu.models.quant import quantize_params
+
+    params = tfm.init_params(jax.random.key(0), CFG)
+    opt = optax.adam(1e-2)
+    step = jax.jit(tfm.make_train_step(CFG, opt))
+    carry = (params, opt.init(params))
+    data = jnp.asarray(np.repeat(rng.integers(0, 64, (32, 1)), 16, axis=1),
+                       jnp.int32)
+    for _ in range(30):
+        carry, loss = step(carry, data)
+    trained = carry[0]
+
+    prompt = data[:4, :4]
+    ref = generate(trained, prompt, CFG, 8, use_prefill=False)
+    qp = quantize_params(trained)
+    out = generate(qp, prompt, CFG, 8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_quantized_params_memory_and_guards(rng):
+    from distkeras_tpu.models.quant import QTensor, quantize_params
+
+    params = tfm.init_params(jax.random.key(0), CFG)
+    qp = quantize_params(params)
+    emb = qp["tok_emb"]
+    assert isinstance(emb, QTensor) and emb.q.dtype == jnp.int8
+    # int8 + per-row scales ~ 1/3.9 of the f32 bytes on the big mats.
+    f32_bytes = np.asarray(params["tok_emb"]).nbytes
+    q_bytes = (np.asarray(emb.q).nbytes + np.asarray(emb.s).nbytes)
+    assert q_bytes < f32_bytes / 3.5
+    # prefill wants full-precision weights.
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 6)), jnp.int32)
+    with pytest.raises(ValueError, match="use_prefill"):
+        generate(qp, prompt, CFG, 4, use_prefill=True)
+    # MoE rejected.
+    moe_params = tfm.init_params(jax.random.key(1), MOE_CFG)
+    with pytest.raises(ValueError, match="dense-FFN"):
+        quantize_params(moe_params)
+
+
+def test_quantized_decode_rope_gqa(rng):
+    from distkeras_tpu.models.quant import quantize_params
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_len=32,
+                                n_kv_heads=2, rope=True)
+    params = tfm.init_params(jax.random.key(1), cfg)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 5)), jnp.int32)
+    out = generate(quantize_params(params), prompt, cfg, 6)
+    assert out.shape == (2, 11)
+    assert int(np.asarray(out).min()) >= 0

@@ -80,3 +80,20 @@ def test_remat_policy_validation(rng):
     with pytest.raises(ValueError, match="remat=False"):
         tfm.init_params(jax.random.key(0),
                         dataclasses.replace(base, remat_policy="dots"))
+
+
+def test_remat_policy_inert_when_remat_disabled_post_init(rng):
+    """dataclasses.replace(cfg, remat=False) on a trained config is the
+    natural eval move; the leftover policy must be inert, not an error."""
+    import dataclasses
+
+    from distkeras_tpu.models import transformer as tfm
+
+    train_cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                      n_layers=1, d_ff=64, max_len=16,
+                                      remat=True, remat_policy="dots")
+    params = tfm.init_params(jax.random.key(0), train_cfg)
+    eval_cfg = dataclasses.replace(train_cfg, remat=False)
+    t = jnp.asarray(rng.integers(0, 64, (2, 8)), jnp.int32)
+    logits, _ = tfm.apply(params, t, eval_cfg)  # must not raise
+    assert logits.shape == (2, 8, 64)
