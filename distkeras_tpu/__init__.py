@@ -56,6 +56,8 @@ from distkeras_tpu.version import __version__
 from distkeras_tpu.utils.serialization import (
     serialize_keras_model,
     deserialize_keras_model,
+    save_lm,
+    load_lm,
 )
 from distkeras_tpu.models.adapter import ModelAdapter, TrainState
 from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
@@ -92,6 +94,8 @@ __all__ = [
     "__version__",
     "serialize_keras_model",
     "deserialize_keras_model",
+    "save_lm",
+    "load_lm",
     "ModelAdapter",
     "TrainState",
     "MeshSpec",

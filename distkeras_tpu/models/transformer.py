@@ -138,9 +138,12 @@ def _validate_remat_policy(cfg: "TransformerConfig",
 
 def _remat_block(cfg: "TransformerConfig"):
     """``block_apply`` wrapped per cfg.remat / cfg.remat_policy."""
+    # Unknown names are rejected even with remat=False (typos must not
+    # pass silently); only the remat-required pairing check is relaxed
+    # (an inert leftover policy is fine at eval time).
+    _validate_remat_policy(cfg, require_remat=False)
     if not cfg.remat:
         return block_apply
-    _validate_remat_policy(cfg, require_remat=False)
     name = _REMAT_POLICIES[cfg.remat_policy]
     policy = getattr(jax.checkpoint_policies, name) if name else None
     return jax.checkpoint(block_apply, static_argnums=(2, 3),

@@ -36,3 +36,65 @@ def deserialize_keras_model(blob: dict):
     model = keras.models.model_from_json(blob["model"])
     model.set_weights(blob["weights"])
     return model
+
+
+def save_lm(path: str, params, cfg) -> None:
+    """Persist a transformer LM (params pytree + TransformerConfig) to
+    one ``.npz`` — the LM-flagship analogue of
+    :func:`serialize_keras_model` (architecture + weights in one
+    artifact; orbax checkpoints cover mid-training state, this covers
+    shipping a finished model).
+
+    Full-precision trees only — quantize after loading
+    (models/quant.quantize_params) since int8 conversion is cheap and
+    one-way.
+    """
+    import dataclasses
+    import json
+
+    from distkeras_tpu.models.quant import QTensor
+
+    import jax
+
+    # is_leaf: QTensor is itself a pytree node, so a plain flatten
+    # would silently decompose it into its q/s arrays.
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, QTensor))
+    if any(isinstance(v, QTensor) for _, v in flat):
+        raise ValueError(
+            "save_lm takes the full-precision tree; quantize after "
+            "load_lm instead (int8 conversion is cheap and lossy)")
+    arrays = {}
+    for keypath, leaf in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in keypath)
+        arrays[name] = np.asarray(leaf)
+    np.savez(path, __config__=json.dumps(dataclasses.asdict(cfg)),
+             **arrays)
+
+
+def load_lm(path: str):
+    """Load :func:`save_lm` output; returns ``(params, cfg)``.
+
+    Params come back as host numpy — place them on a mesh with
+    ``ShardingPlan.tree_shardings`` + ``device_put`` (or hand them to a
+    trainer / jitted ``generate``, which will place them).
+    """
+    import json
+
+    from distkeras_tpu.models.transformer import TransformerConfig
+
+    data = np.load(path, allow_pickle=False)
+    cfg = TransformerConfig(**json.loads(str(data["__config__"])))
+    params: dict = {}
+    for name in data.files:
+        if name == "__config__":
+            continue
+        node = params
+        parts = name.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        # Stays host numpy on purpose: committing to the default device
+        # here would OOM exactly the models whose mesh placement the
+        # caller needs to control.
+        node[parts[-1]] = data[name]
+    return params, cfg

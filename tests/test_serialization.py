@@ -1,3 +1,5 @@
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu import serialize_keras_model, deserialize_keras_model
@@ -33,3 +35,42 @@ def test_uniform_weights(mlp):
     uniform_weights(mlp, bounds=(-0.1, 0.1), seed=0)
     for w in mlp.get_weights():
         assert w.min() >= -0.1 and w.max() <= 0.1
+
+
+def test_save_load_lm_round_trip(tmp_path, rng):
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.models.generate import generate
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=24,
+                                rope=True, n_kv_heads=1, remat=True,
+                                remat_policy="dots", ce_chunks=2)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    path = str(tmp_path / "lm.npz")
+    dk.save_lm(path, params, cfg)
+    loaded, cfg2 = dk.load_lm(path)
+    assert cfg2 == cfg
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), params, loaded)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 5)), jnp.int32)
+    # loaded leaves are host numpy by contract: hand them to a jitted
+    # generate (jit places arguments), as the load_lm docstring says.
+    gen = jax.jit(lambda p, pr: generate(p, pr, cfg2, 6))
+    np.testing.assert_array_equal(
+        np.asarray(gen(loaded, prompt)),
+        np.asarray(generate(params, prompt, cfg, 6)))
+
+
+def test_save_lm_rejects_quantized(tmp_path):
+    import pytest
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.models.quant import quantize_params
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=1, d_ff=64, max_len=16)
+    qp = quantize_params(tfm.init_params(jax.random.key(0), cfg))
+    with pytest.raises(ValueError, match="full-precision"):
+        dk.save_lm(str(tmp_path / "q.npz"), qp, cfg)
