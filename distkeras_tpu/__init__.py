@@ -75,7 +75,8 @@ from distkeras_tpu.data.transformers import (
     DenseTransformer,
 )
 from distkeras_tpu.checkpoint import CheckpointManager
-from distkeras_tpu.evaluators import Evaluator, AccuracyEvaluator
+from distkeras_tpu.evaluators import (Evaluator, AccuracyEvaluator,
+                                       PerplexityEvaluator)
 from distkeras_tpu.predictors import Predictor, ModelPredictor
 from distkeras_tpu.trainers import (
     Trainer,
@@ -116,6 +117,7 @@ __all__ = [
     "CheckpointManager",
     "Evaluator",
     "AccuracyEvaluator",
+    "PerplexityEvaluator",
     "Predictor",
     "ModelPredictor",
     "Trainer",

@@ -38,3 +38,52 @@ class AccuracyEvaluator(Evaluator):
         if labels.ndim > 1:  # one-hot labels
             labels = np.argmax(labels, axis=-1)
         return float(np.mean(preds.astype(np.int64) == labels.astype(np.int64)))
+
+
+class PerplexityEvaluator(Evaluator):
+    """Held-out perplexity of a transformer LM over token rows.
+
+    The LM-family member of the evaluator API (the reference's
+    evaluators only cover classification, distkeras/evaluators.py) —
+    the same quantity LMTrainer's ``eval_every`` tracks mid-training,
+    packaged standalone: one jitted batched NLL, fed in ``batch_size``
+    chunks (a remainder of up to ``batch_size - 1`` rows is dropped for
+    static shapes), ``exp(mean NLL)`` out.  MoE aux loss is excluded —
+    the router penalty is a training device, not model quality.
+    """
+
+    def __init__(self, params, cfg, batch_size: int = 8,
+                 tokens_col: str = "tokens"):
+        import jax
+
+        from distkeras_tpu.models import transformer as tfm
+
+        self.params = params
+        self.cfg = cfg
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        self.batch_size = batch_size
+        self.tokens_col = tokens_col
+        # Jitted once here: a fresh lambda per evaluate() would retrace
+        # and recompile the full forward on every call.
+        self._nll = jax.jit(lambda p, t: tfm.lm_nll(p, t, cfg))
+
+    def evaluate(self, dataset) -> float:
+        from distkeras_tpu.utils.misc import nll_to_perplexity
+
+        tokens = (dataset if isinstance(dataset, np.ndarray)
+                  else dataset[self.tokens_col])
+        if tokens.ndim != 2 or tokens.shape[1] < 2:
+            raise ValueError(
+                f"tokens must be [N, seq+1] with seq >= 1, got "
+                f"{tokens.shape}")
+        bs = self.batch_size
+        n = len(tokens) - (len(tokens) % bs)
+        if not n:
+            raise ValueError(
+                f"dataset has {len(tokens)} rows; one batch needs {bs}")
+        total = 0.0
+        for i in range(0, n, bs):
+            chunk = np.asarray(tokens[i:i + bs], np.int32)
+            total += float(self._nll(self.params, chunk))
+        return nll_to_perplexity(total / (n // bs))

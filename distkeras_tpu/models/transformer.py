@@ -91,6 +91,13 @@ class TransformerConfig:
     # predict paths are untouched (they need one position's logits
     # only).  0/1 = off.
     ce_chunks: int = 0
+    # z-loss (ST-MoE eq. 6): z_loss_coef * mean(logsumexp(logits)^2)
+    # added to the TRAINING loss only.  Keeps the softmax normalizer
+    # near 0 so bf16 logits stay in range over long runs — the standard
+    # stability regularizer for large-vocab LMs.  Excluded from lm_nll
+    # (eval perplexity stays a pure model-quality number).  Typical:
+    # 1e-4.  Works on every head path, including chunked CE.
+    z_loss_coef: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -162,6 +169,10 @@ def init_params(rng, cfg: TransformerConfig):
         raise ValueError(f"dropout must be in [0, 1), got {cfg.dropout}")
     if cfg.ce_chunks < 0:
         raise ValueError(f"ce_chunks must be >= 0, got {cfg.ce_chunks}")
+    if cfg.z_loss_coef < 0:
+        raise ValueError(
+            f"z_loss_coef must be >= 0, got {cfg.z_loss_coef} (a negative "
+            "coefficient would silently disable the regularizer)")
     _validate_remat_policy(cfg)
     keys = jax.random.split(rng, 12)
     d, f, h, hd = cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.head_dim
@@ -434,6 +445,10 @@ def apply(params, tokens, cfg: TransformerConfig,
 
 def chunked_softmax_xent(hidden, emb, targets, n_chunks: int):
     """Mean softmax cross-entropy without materializing full logits.
+    Returns ``(mean_nll, mean_lse_sq)`` — the second term is the z-loss
+    statistic ``mean(logsumexp^2)`` (free here: the per-row logsumexp
+    is already computed), consumed by ``lm_loss`` when
+    ``cfg.z_loss_coef`` is set.
 
     ``hidden`` [B, S, D] (compute dtype), ``emb`` [V, D], ``targets``
     [B, S] int.  Tokens flatten to N = B*S rows, padded up to a multiple
@@ -458,18 +473,22 @@ def chunked_softmax_xent(hidden, emb, targets, n_chunks: int):
     t = t.reshape(n_chunks, -1)
     emb_c = emb.astype(hidden.dtype)
 
-    def body(total, sl):
+    def body(carry, sl):
+        nll_total, z_total = carry
         hc, tc = sl
         logits = jnp.einsum("cd,vd->cv", hc, emb_c).astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(
             logits, jnp.maximum(tc, 0)[:, None], axis=-1)[:, 0]
-        nll = jnp.where(tc >= 0, lse - tgt, 0.0)
-        return total + nll.sum(), None
+        valid = tc >= 0
+        nll = jnp.where(valid, lse - tgt, 0.0)
+        z = jnp.where(valid, jnp.square(lse), 0.0)
+        return (nll_total + nll.sum(), z_total + z.sum()), None
 
-    total, _ = jax.lax.scan(jax.checkpoint(body),
-                            jnp.zeros((), jnp.float32), (h, t))
-    return total / n_tok
+    (total, z_total), _ = jax.lax.scan(
+        jax.checkpoint(body),
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), (h, t))
+    return total / n_tok, z_total / n_tok
 
 
 def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
@@ -582,24 +601,33 @@ def _forward_nll(params, tokens, cfg: TransformerConfig,
     if apply_fn is not None and hidden_fn is not None:
         raise ValueError("pass apply_fn or hidden_fn, not both")
     targets = tokens[:, 1:]
-    if apply_fn is not None:
-        logits, aux = apply_fn(params, tokens[:, :-1])
+    zc = cfg.z_loss_coef
+
+    def full_head(logits, aux):
+        # z-loss rides in aux (training-only, like the MoE penalty —
+        # lm_nll drops aux, so eval perplexity stays pure).
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None],
                                    axis=-1).mean()
+        if zc > 0:
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            aux = aux + zc * jnp.square(lse).mean()
         return nll, aux
+
+    if apply_fn is not None:
+        logits, aux = apply_fn(params, tokens[:, :-1])
+        return full_head(logits, aux)
     if hidden_fn is None:
         hidden_fn = lambda p, t: apply_hidden(p, t, cfg, attention_fn,
                                               dropout_rng)
     hidden, aux = hidden_fn(params, tokens[:, :-1])
     if cfg.ce_chunks > 1:
-        nll = chunked_softmax_xent(hidden, params["tok_emb"], targets,
-                                   cfg.ce_chunks)
-    else:
-        logp = jax.nn.log_softmax(_unembed(hidden, params, cfg), axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None],
-                                   axis=-1).mean()
-    return nll, aux
+        nll, z_mean = chunked_softmax_xent(hidden, params["tok_emb"],
+                                           targets, cfg.ce_chunks)
+        if zc > 0:
+            aux = aux + zc * z_mean
+        return nll, aux
+    return full_head(_unembed(hidden, params, cfg), aux)
 
 
 def lm_loss(params, tokens, cfg: TransformerConfig,

@@ -371,7 +371,7 @@ class LMTrainer(CheckpointingBase):
 
             eval_fn = None
             if eval_tokens is not None:
-                import math
+                from distkeras_tpu.utils.misc import nll_to_perplexity
 
                 nll = jax.jit(self._nll_fn)
                 eval_bs = global_bs // n_proc  # rows per process
@@ -388,9 +388,9 @@ class LMTrainer(CheckpointingBase):
                     ps = carry[0]
                     mean = sum(float(nll(ps, c))
                                for c in eval_chunks) / len(eval_chunks)
-                    ppl = math.exp(mean) if mean < 700 else float("inf")
                     self.eval_history.append(
-                        (rnd, {"loss": mean, "perplexity": ppl}))
+                        (rnd, {"loss": mean,
+                               "perplexity": nll_to_perplexity(mean)}))
 
                 if self.profile_dir and self.eval_every:
                     # Pre-compile the eval nll so an eval round landing

@@ -60,3 +60,12 @@ def probe_devices(deadline_s: float = 120.0):
     if err:
         raise err[0]
     return found
+
+
+def nll_to_perplexity(mean_nll: float) -> float:
+    """exp(mean NLL) with the overflow guard — the ONE definition of
+    the perplexity formula (LMTrainer's eval hook and
+    PerplexityEvaluator must stay numerically identical)."""
+    import math
+
+    return math.exp(mean_nll) if mean_nll < 700 else float("inf")

@@ -132,3 +132,45 @@ def test_binary_accuracy(blobs):
                          batch_size=32, num_epoch=2, metrics=("accuracy",))
     t.train(train, eval_dataset=evals)
     assert 0.0 <= t.eval_history[-1][1]["accuracy"] <= 1.0
+
+
+def test_perplexity_evaluator_matches_trainer_eval(rng):
+    """Standalone PerplexityEvaluator == the eval_every machinery's
+    final number (same chunks, same NLL)."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=1, d_ff=64, max_len=17)
+    tokens = np.repeat(rng.integers(0, 64, (64, 1)), 17,
+                       axis=1).astype(np.int32)
+    tr = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=16, num_epoch=1,
+                      eval_every=2)
+    params = tr.train(tokens, eval_tokens=tokens[:32])
+    ev = dk.PerplexityEvaluator(params, cfg, batch_size=16)
+    ppl = ev.evaluate(tokens[:32])
+    np.testing.assert_allclose(
+        ppl, tr.eval_history[-1][1]["perplexity"], rtol=1e-6)
+    # Dataset-column form.
+    ppl2 = ev.evaluate(dk.Dataset({"tokens": tokens[:32]}))
+    np.testing.assert_allclose(ppl2, ppl, rtol=1e-12)
+
+
+def test_perplexity_evaluator_validation(rng):
+    import pytest
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import transformer as tfm
+
+    import jax
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=1, d_ff=64, max_len=17)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    ev = dk.PerplexityEvaluator(params, cfg, batch_size=16)
+    with pytest.raises(ValueError, match="one batch needs"):
+        ev.evaluate(np.zeros((4, 17), np.int32))
+    with pytest.raises(ValueError, match="seq"):
+        ev.evaluate(np.zeros((32,), np.int32))
+    with pytest.raises(ValueError, match="batch_size"):
+        dk.PerplexityEvaluator(params, cfg, batch_size=0)

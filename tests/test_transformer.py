@@ -463,3 +463,59 @@ def test_lm_loss_rejects_both_forward_hooks(rng):
     # would materialize the logits the caller asked ce_chunks to avoid.
     with pytest.raises(ValueError, match="not both"):
         tfm.lm_nll(params, t, CFG, apply_fn=dummy, hidden_fn=dummy)
+
+
+# -------------------------------------------------------------------- z-loss
+
+def test_z_loss_chunked_matches_full(rng):
+    """z-loss on the chunked head == the materialized head, and both
+    strictly exceed the unregularized loss."""
+    import dataclasses
+
+    z = dataclasses.replace(CFG, z_loss_coef=1e-3)
+    zc = dataclasses.replace(CFG, z_loss_coef=1e-3, ce_chunks=4)
+    params = tfm.init_params(jax.random.key(0), CFG)
+    t = jnp.asarray(toks(rng))
+    base = float(tfm.lm_loss(params, t, CFG))
+    l_full, g_full = jax.value_and_grad(tfm.lm_loss)(params, t, z)
+    l_chunk, g_chunk = jax.value_and_grad(tfm.lm_loss)(params, t, zc)
+    assert float(l_full) > base
+    np.testing.assert_allclose(float(l_chunk), float(l_full), rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        a, b, atol=1e-6, rtol=1e-5), g_full, g_chunk)
+
+
+def test_z_loss_excluded_from_eval_nll(rng):
+    import dataclasses
+
+    z = dataclasses.replace(CFG, z_loss_coef=1e-2)
+    params = tfm.init_params(jax.random.key(0), CFG)
+    t = jnp.asarray(toks(rng))
+    np.testing.assert_allclose(float(tfm.lm_nll(params, t, z)),
+                               float(tfm.lm_nll(params, t, CFG)),
+                               rtol=1e-7)
+
+
+def test_z_loss_trains_and_shrinks_normalizer(rng):
+    """With z-loss the trained model's mean logsumexp^2 must come out
+    smaller than without (the regularizer does its one job)."""
+    import dataclasses
+
+    def train(cfg):
+        params = tfm.init_params(jax.random.key(0), cfg)
+        opt = optax.adam(1e-2)
+        step = jax.jit(tfm.make_train_step(cfg, opt))
+        carry = (params, opt.init(params))
+        t = jnp.asarray(toks(rng_local, b=16, s=16))
+        for _ in range(40):
+            carry, loss = step(carry, t)
+        logits, _ = tfm.apply(carry[0], t[:, :-1], cfg)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        return float(loss), float(jnp.square(lse).mean())
+
+    rng_local = np.random.default_rng(0)
+    loss0, z0 = train(CFG)
+    rng_local = np.random.default_rng(0)
+    loss1, z1 = train(dataclasses.replace(CFG, z_loss_coef=1e-2))
+    assert z1 < z0, (z0, z1)
+    assert loss1 < 3.0  # still learns the copy task
