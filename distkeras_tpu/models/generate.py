@@ -372,3 +372,128 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
         # Back to the input layout: prompt, generation, then padding.
         buf = jax.vmap(jnp.roll)(buf, -pad_lens)
     return buf
+
+
+def beam_search(params, prompt, cfg: TransformerConfig,
+                max_new_tokens: int, beam_width: int = 4,
+                eos_token: int | None = None,
+                use_prefill: bool | None = None):
+    """Beam search decode: ``prompt [B, P]`` -> ``(sequences, scores)``
+    with ``sequences [B, W, P+N]`` and ``scores [B, W]`` (sum of token
+    log-probabilities of the generated part), best beam first.
+
+    XLA-shaped like :func:`generate`: static beam width, one compiled
+    ``lax.scan`` over positions, the KV cache tiled to ``B*W`` rows and
+    reordered each step by a parent gather.  The first expansion runs
+    on the un-tiled batch (top-W first tokens), so beams start distinct
+    instead of W copies of the greedy token.  ``eos_token`` freezes a
+    finished beam: its only continuation is another ``eos_token`` at
+    unchanged score, so finished and live beams compete in the same
+    top-W.  Uniform-length prompts only (use :func:`generate` for
+    ragged batches); quantized trees decode like everywhere else, but
+    force the sequential prompt path.
+    """
+    b, p = prompt.shape
+    w = beam_width
+    total = p + max_new_tokens
+    if p < 1:
+        raise ValueError("prompt must contain at least one token")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if not 1 <= w <= cfg.vocab_size:
+        raise ValueError(
+            f"beam_width must be in [1, vocab_size={cfg.vocab_size}], "
+            f"got {w}")
+    if total > cfg.max_len:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_len={cfg.max_len}")
+    if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
+        raise ValueError(
+            f"eos_token must be in [0, vocab_size={cfg.vocab_size}), "
+            f"got {eos_token}")
+
+    prompt = jnp.asarray(prompt, jnp.int32)
+    can_prefill = (not cfg.num_experts and p > 1
+                   and not is_quantized(params))
+    if use_prefill is None:
+        use_prefill = can_prefill
+    elif use_prefill and not can_prefill:
+        raise ValueError(
+            "use_prefill=True needs a >= 2 token prompt, a dense-FFN "
+            "config and full-precision params (see generate)")
+
+    # ---- prompt pass on the un-tiled [B] batch -----------------------
+    if use_prefill:
+        cache, _ = prefill(params, prompt, cfg, last_logits=False)
+    elif p > 1:
+        # One compiled scan, like generate()'s sequential path — an
+        # unrolled eager loop would pay per-op dispatch for every
+        # prompt position (quantized params always land here).
+        def warm(cache, q):
+            tok = jax.lax.dynamic_index_in_dim(prompt, q, axis=1,
+                                               keepdims=False)
+            _, cache = _decode_step(params, cache, tok, q, cfg)
+            return cache, None
+
+        cache, _ = jax.lax.scan(warm, init_cache(cfg, b),
+                                jnp.arange(p - 1))
+    else:
+        cache = init_cache(cfg, b)
+    # Logits for the first generated position (recomputes p-1 in place
+    # on the prefill path, same as generate()).
+    logits, cache = _decode_step(params, cache, prompt[:, p - 1], p - 1,
+                                 cfg)
+    logp0 = jax.nn.log_softmax(logits, axis=-1)  # [B, V]
+
+    # ---- first expansion: top-W distinct first tokens ----------------
+    scores, first = jax.lax.top_k(logp0, w)          # [B, W] each
+    first = first.astype(jnp.int32)
+    done = ((first == eos_token) if eos_token is not None
+            else jnp.zeros((b, w), bool))
+
+    # Tile prompt/cache per beam: row b's beams are b*W .. b*W+W-1.
+    buf = jnp.zeros((b, w, total), jnp.int32)
+    buf = buf.at[:, :, :p].set(prompt[:, None, :])
+    buf = buf.at[:, :, p].set(first)
+    cache = jax.tree.map(
+        lambda a: jnp.repeat(a, w, axis=1), cache)  # [L, B*W, S, ...]
+
+    neg_inf = jnp.float32(-1e30)
+
+    def body(carry, q):
+        buf, cache, scores, done = carry
+        tok = jax.lax.dynamic_index_in_dim(
+            buf.reshape(b * w, total), q, axis=1, keepdims=False)
+        logits, cache = _decode_step(params, cache, tok, q, cfg)
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(b, w, -1)
+        v = logp.shape[-1]
+        cand = scores[:, :, None] + logp           # [B, W, V]
+        if eos_token is not None:
+            # A finished beam's only continuation is eos at unchanged
+            # score; everything else is pruned.
+            frozen = jnp.full((v,), neg_inf).at[eos_token].set(0.0)
+            cand = jnp.where(done[:, :, None],
+                             scores[:, :, None] + frozen[None, None, :],
+                             cand)
+        scores, idx = jax.lax.top_k(cand.reshape(b, w * v), w)
+        parent = (idx // v).astype(jnp.int32)      # [B, W]
+        token = (idx % v).astype(jnp.int32)
+        # Reorder beams by parent: buf rows, cache rows, done flags.
+        buf = jnp.take_along_axis(buf, parent[:, :, None], axis=1)
+        buf = buf.at[:, :, q + 1].set(token)
+        done = jnp.take_along_axis(done, parent, axis=1)
+        if eos_token is not None:
+            done = done | (token == eos_token)
+        flat_parent = (parent
+                       + jnp.arange(b, dtype=jnp.int32)[:, None] * w
+                       ).reshape(b * w)
+        cache = jax.tree.map(lambda a: a[:, flat_parent], cache)
+        return (buf, cache, scores, done), None
+
+    if max_new_tokens > 1:
+        (buf, _, scores, _), _ = jax.lax.scan(
+            body, (buf, cache, scores, done),
+            jnp.arange(p, total - 1))
+    return buf, scores

@@ -381,3 +381,128 @@ def test_quantized_decode_rope_gqa(rng):
     out = generate(quantize_params(params), prompt, cfg, 6)
     assert out.shape == (2, 11)
     assert int(np.asarray(out).min()) >= 0
+
+
+# ------------------------------------------------------------- beam search
+
+def _seq_logprob(params, cfg, seq, start):
+    """Sum of per-token log-probs of seq[start:] under the model."""
+    from distkeras_tpu.models import transformer as tfm
+
+    logits, _ = tfm.apply(params, jnp.asarray(seq[None, :-1]), cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)[0]
+    tgt = np.asarray(seq[1:])
+    per = np.asarray(jnp.take_along_axis(
+        logp, jnp.asarray(tgt)[:, None], axis=-1))[:, 0]
+    return float(per[start - 1:].sum())
+
+
+def test_beam_width_1_equals_greedy(rng):
+    from distkeras_tpu.models.generate import beam_search
+
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (3, 5)), jnp.int32)
+    greedy = generate(params, prompt, CFG, 8)
+    seqs, scores = beam_search(params, prompt, CFG, 8, beam_width=1)
+    np.testing.assert_array_equal(np.asarray(seqs[:, 0]),
+                                  np.asarray(greedy))
+
+
+def test_beam_scores_match_rescoring_and_beat_greedy(rng):
+    from distkeras_tpu.models.generate import beam_search
+
+    params = tfm.init_params(jax.random.key(1), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)), jnp.int32)
+    n_new = 6
+    seqs, scores = beam_search(params, prompt, CFG, n_new, beam_width=4)
+    greedy = generate(params, prompt, CFG, n_new)
+    for row in range(2):
+        # Internal score bookkeeping == re-scoring with the training
+        # forward (same math up to f32 reduction order).
+        best = np.asarray(seqs[row, 0])
+        np.testing.assert_allclose(
+            float(scores[row, 0]), _seq_logprob(params, CFG, best, 4),
+            atol=1e-3, rtol=1e-4)
+        # The best beam is at least as probable as the greedy rollout.
+        g = _seq_logprob(params, CFG, np.asarray(greedy[row]), 4)
+        assert float(scores[row, 0]) >= g - 1e-4, (float(scores[row, 0]), g)
+        # Beams come back best-first.
+        assert np.all(np.diff(np.asarray(scores[row])) <= 1e-6)
+
+
+def test_beam_eos_freezes_score(rng):
+    from distkeras_tpu.models.generate import beam_search
+
+    params = tfm.init_params(jax.random.key(2), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 3)), jnp.int32)
+    seqs, scores = beam_search(params, prompt, CFG, 8, beam_width=3,
+                               eos_token=5)
+    s = np.asarray(seqs)
+    # After a generated eos, every later slot is eos (frozen beam).
+    gen = s[:, :, 3:]
+    for row in gen.reshape(-1, gen.shape[-1]):
+        hits = np.nonzero(row == 5)[0]
+        if hits.size:
+            assert np.all(row[hits[0]:] == 5), row
+
+
+def test_beam_validation_and_quantized(rng):
+    from distkeras_tpu.models.generate import beam_search
+    from distkeras_tpu.models.quant import quantize_params
+
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)), jnp.int32)
+    with pytest.raises(ValueError, match="beam_width"):
+        beam_search(params, prompt, CFG, 4, beam_width=0)
+    with pytest.raises(ValueError, match="max_len"):
+        beam_search(params, prompt, CFG, 64, beam_width=2)
+    with pytest.raises(ValueError, match="use_prefill"):
+        beam_search(quantize_params(params), prompt, CFG, 4,
+                    beam_width=2, use_prefill=True)
+    # Quantized tree works on the auto (sequential) path.
+    seqs, _ = beam_search(quantize_params(params), prompt, CFG, 4,
+                          beam_width=2)
+    assert seqs.shape == (2, 2, 8)
+
+
+def test_beam_prefill_matches_sequential(rng):
+    from distkeras_tpu.models.generate import beam_search
+
+    params = tfm.init_params(jax.random.key(3), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 6)), jnp.int32)
+    s1, sc1 = beam_search(params, prompt, CFG, 5, beam_width=3,
+                          use_prefill=True)
+    s2, sc2 = beam_search(params, prompt, CFG, 5, beam_width=3,
+                          use_prefill=False)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    np.testing.assert_allclose(np.asarray(sc1), np.asarray(sc2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_beam_frozen_score_is_length_invariant(rng):
+    """A beam that emits eos freezes: its score must not change as the
+    scan keeps running (regression guard: frozen continuation adds 0,
+    not logp(eos), each step)."""
+    import optax
+
+    from distkeras_tpu.models.generate import beam_search
+
+    # Constant-row training: the model emits token c forever; with
+    # eos_token=c the best beam finishes at the first generated slot.
+    c = 9
+    params = tfm.init_params(jax.random.key(0), CFG)
+    opt = optax.adam(1e-2)
+    step = jax.jit(tfm.make_train_step(CFG, opt))
+    carry = (params, opt.init(params))
+    data = jnp.full((16, 16), c, jnp.int32)
+    for _ in range(25):
+        carry, _ = step(carry, data)
+    trained = carry[0]
+    prompt = jnp.full((2, 3), c, jnp.int32)
+    _, s_short = beam_search(trained, prompt, CFG, 2, beam_width=2,
+                             eos_token=c)
+    _, s_long = beam_search(trained, prompt, CFG, 10, beam_width=2,
+                            eos_token=c)
+    np.testing.assert_allclose(np.asarray(s_long[:, 0]),
+                               np.asarray(s_short[:, 0]),
+                               rtol=1e-5, atol=1e-6)
