@@ -8,8 +8,12 @@ H, D] buffer per layer, the loop is ``lax.scan`` over positions (one
 compiled program regardless of prompt/output length), and sampling is
 functional over an explicit PRNG key.
 
-Greedy (temperature=0) and temperature sampling are supported; batch
-decoding shards over the mesh ``data`` axis like every other batch op.
+Decoding strategies: greedy, temperature sampling with top-k / top-p
+(nucleus) filtering (:func:`generate`), and beam search
+(:func:`beam_search`).  Uniform dense prompts run the prefill/decode
+split (:func:`prefill`); int8-quantized trees (models/quant) decode on
+the sequential path.  Batch decoding shards over the mesh ``data``
+axis like every other batch op.
 """
 
 from __future__ import annotations
@@ -229,6 +233,45 @@ def top_p_mask(logits, p: float):
     return jnp.where(logits < thr, -jnp.inf, logits)
 
 
+def _check_decode_budget(p: int, max_new_tokens: int,
+                         cfg: TransformerConfig,
+                         eos_token: int | None) -> int:
+    """Shared prompt/length/eos validation for generate and beam_search;
+    returns ``total``."""
+    if p < 1:
+        raise ValueError(
+            "prompt must contain at least one token (decoding starts from "
+            "its last position; pass a BOS token for unconditional samples)")
+    total = p + max_new_tokens
+    if total > cfg.max_len:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_len={cfg.max_len}")
+    if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
+        raise ValueError(
+            f"eos_token must be in [0, vocab_size={cfg.vocab_size}), "
+            f"got {eos_token}")
+    return total
+
+
+def _resolve_prefill(params, cfg: TransformerConfig, p: int,
+                     use_prefill: bool | None, ragged: bool) -> bool:
+    """Shared prefill-eligibility rule (ONE definition: generate and
+    beam_search must not drift)."""
+    can = (not ragged and not cfg.num_experts and p > 1
+           and not is_quantized(params))
+    if use_prefill is None:
+        return can
+    if use_prefill and not can:
+        raise ValueError(
+            "use_prefill=True needs a uniform-length (no prompt_lengths) "
+            "prompt of >= 2 tokens, a dense-FFN config (prefill does not "
+            "reproduce decode-time MoE routing), and full-precision "
+            "params (the batched prefill forward wants the training "
+            "weights — quantize for decode-heavy work)")
+    return use_prefill
+
+
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
              temperature: float = 0.0, key=None,
              top_k: int | None = None, top_p: float | None = None,
@@ -267,15 +310,7 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     the training ``apply`` instead of the cached step.
     """
     b, p = prompt.shape
-    if p < 1:
-        raise ValueError(
-            "prompt must contain at least one token (decoding starts from "
-            "its last position; pass a BOS token for unconditional samples)")
-    total = p + max_new_tokens
-    if total > cfg.max_len:
-        raise ValueError(
-            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"max_len={cfg.max_len}")
+    total = _check_decode_budget(p, max_new_tokens, cfg, eos_token)
     if temperature > 0 and key is None:
         raise ValueError("temperature sampling needs an explicit PRNG key")
     if (top_k is not None or top_p is not None) and temperature <= 0:
@@ -305,22 +340,8 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
         # Right-align each row: [tok..., pad...] -> [pad..., tok...].
         prompt = jax.vmap(jnp.roll)(prompt, pad_lens)
 
-    if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
-        raise ValueError(
-            f"eos_token must be in [0, vocab_size={cfg.vocab_size}), "
-            f"got {eos_token}")
-
-    can_prefill = (pad_lens is None and not cfg.num_experts and p > 1
-                   and not is_quantized(params))
-    if use_prefill is None:
-        use_prefill = can_prefill
-    elif use_prefill and not can_prefill:
-        raise ValueError(
-            "use_prefill=True needs a uniform-length (no prompt_lengths) "
-            "prompt of >= 2 tokens, a dense-FFN config (prefill does not "
-            "reproduce decode-time MoE routing), and full-precision "
-            "params (the batched prefill forward wants the training "
-            "weights — quantize for decode-heavy work)")
+    use_prefill = _resolve_prefill(params, cfg, p, use_prefill,
+                                   ragged=pad_lens is not None)
 
     # Buffer of emitted tokens; prompt occupies [0, p).
     buf = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
@@ -395,9 +416,6 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     """
     b, p = prompt.shape
     w = beam_width
-    total = p + max_new_tokens
-    if p < 1:
-        raise ValueError("prompt must contain at least one token")
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -405,24 +423,10 @@ def beam_search(params, prompt, cfg: TransformerConfig,
         raise ValueError(
             f"beam_width must be in [1, vocab_size={cfg.vocab_size}], "
             f"got {w}")
-    if total > cfg.max_len:
-        raise ValueError(
-            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"max_len={cfg.max_len}")
-    if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
-        raise ValueError(
-            f"eos_token must be in [0, vocab_size={cfg.vocab_size}), "
-            f"got {eos_token}")
-
+    total = _check_decode_budget(p, max_new_tokens, cfg, eos_token)
     prompt = jnp.asarray(prompt, jnp.int32)
-    can_prefill = (not cfg.num_experts and p > 1
-                   and not is_quantized(params))
-    if use_prefill is None:
-        use_prefill = can_prefill
-    elif use_prefill and not can_prefill:
-        raise ValueError(
-            "use_prefill=True needs a >= 2 token prompt, a dense-FFN "
-            "config and full-precision params (see generate)")
+    use_prefill = _resolve_prefill(params, cfg, p, use_prefill,
+                                   ragged=False)
 
     # ---- prompt pass on the un-tiled [B] batch -----------------------
     if use_prefill:

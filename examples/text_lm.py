@@ -69,11 +69,28 @@ def main():
 
     prompt_text = "def train("
     prompt = np.tile(tok.encode(prompt_text), (2, 1)).astype(np.int32)
-    out = generate(params, prompt, cfg,
-                   max_new_tokens=min(48, cfg.max_len - prompt.shape[1]),
+    n_new = min(48, cfg.max_len - prompt.shape[1])
+    out = generate(params, prompt, cfg, max_new_tokens=n_new,
                    temperature=0.8, top_p=0.95, key=jax.random.key(0))
     for row in np.asarray(out):
         print("sample:", repr(tok.decode(row)))
+
+    # Beam search: the most probable continuation instead of a sample.
+    from distkeras_tpu.models.generate import beam_search
+
+    seqs, scores = beam_search(params, prompt[:1], cfg, n_new,
+                               beam_width=4)
+    print(f"beam ({float(scores[0, 0]):.2f}):",
+          repr(tok.decode(np.asarray(seqs[0, 0]))))
+
+    # Ship the artifact; int8-quantize for decode-heavy serving.
+    from distkeras_tpu.models.quant import quantize_params
+
+    dk.save_lm("/tmp/text_lm.npz", params, cfg)
+    loaded, cfg2 = dk.load_lm("/tmp/text_lm.npz")
+    q = quantize_params(jax.tree.map(jax.numpy.asarray, loaded))
+    qout = generate(q, prompt[:1], cfg2, max_new_tokens=n_new)
+    print("int8 greedy:", repr(tok.decode(np.asarray(qout[0]))))
 
 
 if __name__ == "__main__":

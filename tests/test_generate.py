@@ -423,7 +423,10 @@ def test_beam_scores_match_rescoring_and_beat_greedy(rng):
         np.testing.assert_allclose(
             float(scores[row, 0]), _seq_logprob(params, CFG, best, 4),
             atol=1e-3, rtol=1e-4)
-        # The best beam is at least as probable as the greedy rollout.
+        # Seeded regression property, not a theorem: vanilla beam
+        # search can in principle prune the greedy path, but with this
+        # pinned seed/width/config the best beam matches or beats the
+        # greedy rollout's total log-prob (deterministic on CPU f32).
         g = _seq_logprob(params, CFG, np.asarray(greedy[row]), 4)
         assert float(scores[row, 0]) >= g - 1e-4, (float(scores[row, 0]), g)
         # Beams come back best-first.
