@@ -84,7 +84,8 @@ def prefill(params, prompt, cfg: TransformerConfig,
     else:
         x = x + params["pos_emb"][:p_len][None].astype(dtype)
 
-    attention_fn = lambda q, k, v: flash_attention(q, k, v, True)
+    attention_fn = lambda q, k, v: flash_attention(
+        q, k, v, True, window=cfg.attention_window)
     cache = init_cache(cfg, b)
     ks, vs = [], []
     for i in range(cfg.n_layers):
@@ -165,6 +166,12 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
         logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
         span = jnp.arange(cfg.max_len)
         mask = (span <= pos)[None, None, None, :]
+        if cfg.attention_window is not None:
+            # Sliding window: only the last `window` positions (self
+            # included); pos - span is pad-invariant, so this is exact
+            # for left-padded ragged rows too.
+            mask = mask & (span > pos - cfg.attention_window
+                           )[None, None, None, :]
         if pad_lens is not None:  # left-pad slots never enter attention
             mask = mask & (span[None, :] >= pad_lens[:, None]
                            )[:, None, None, :]

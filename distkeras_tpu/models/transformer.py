@@ -91,6 +91,14 @@ class TransformerConfig:
     # predict paths are untouched (they need one position's logits
     # only).  0/1 = off.
     ce_chunks: int = 0
+    # Sliding-window (local) attention: each position attends its last
+    # `attention_window` positions (self included) instead of the full
+    # causal past — compute per token drops from O(L) to O(window) in
+    # the flash kernels (dead blocks skipped), the standard local-
+    # attention long-context trade.  None = full causal attention.
+    # Composes with rope/GQA/remat/ce_chunks and the KV-cached decode;
+    # not with ring attention (the seq mesh axis) in this version.
+    attention_window: int | None = None
     # z-loss (ST-MoE eq. 6): z_loss_coef * mean(logsumexp(logits)^2)
     # added to the TRAINING loss only.  Keeps the softmax normalizer
     # near 0 so bf16 logits stay in range over long runs — the standard
@@ -173,6 +181,9 @@ def init_params(rng, cfg: TransformerConfig):
         raise ValueError(
             f"z_loss_coef must be >= 0, got {cfg.z_loss_coef} (a negative "
             "coefficient would silently disable the regularizer)")
+    if cfg.attention_window is not None and cfg.attention_window < 1:
+        raise ValueError(
+            f"attention_window must be >= 1, got {cfg.attention_window}")
     _validate_remat_policy(cfg)
     keys = jax.random.split(rng, 12)
     d, f, h, hd = cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.head_dim
@@ -381,7 +392,15 @@ def apply_hidden(params, tokens, cfg: TransformerConfig,
     the full-vocab logits never materialize.  Returns (hidden, aux).
     """
     if attention_fn is None:
-        attention_fn = lambda q, k, v: flash_attention(q, k, v, True)
+        attention_fn = lambda q, k, v: flash_attention(
+            q, k, v, True, window=cfg.attention_window)
+    elif cfg.attention_window is not None:
+        raise ValueError(
+            "cfg.attention_window only threads through the default "
+            "attention; a custom attention_fn must implement the window "
+            "itself (pass window= to flash_attention) or the config "
+            "must drop it — otherwise training would silently run full "
+            "attention while the KV-cached decode applies the band")
     dtype = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
     _check_len(s, cfg)
@@ -528,11 +547,22 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
                 "itself")
         from distkeras_tpu.parallel.ring import ring_attention
 
+        if cfg.attention_window is not None:
+            raise ValueError(
+                "attention_window does not compose with the seq mesh "
+                "axis (ring attention) in this version — drop the "
+                "window or the seq axis")
         attention_fn = functools.partial(ring_attention, axis_name=seq_axis,
                                          causal=True)
         x_spec = P(None, seq_axis)
     elif attention_fn is None:
-        attention_fn = lambda q, k, v: flash_attention(q, k, v, True)
+        attention_fn = lambda q, k, v: flash_attention(
+            q, k, v, True, window=cfg.attention_window)
+    elif cfg.attention_window is not None:
+        raise ValueError(
+            "cfg.attention_window only threads through the default "
+            "attention; a custom attention_fn must implement the window "
+            "itself or the config must drop it")
     n_stages = int(mesh.shape[axis_name])
     if cfg.n_layers % n_stages:
         raise ValueError(

@@ -43,20 +43,40 @@ def _scale_for(q, scale):
     return (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
 
 
-def _causal_mask(lq: int, lk: int, q_offset, kv_offset):
-    """[lq, lk] bool mask: True where q position >= k position (global)."""
+def _causal_mask(lq: int, lk: int, q_offset, kv_offset, window=None):
+    """[lq, lk] bool mask: True where q position >= k position (global);
+    with ``window`` also requires q - k < window (causal sliding
+    window: each query sees its last ``window`` positions, self
+    included)."""
     rows = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0) + q_offset
     cols = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1) + kv_offset
-    return rows >= cols
+    mask = rows >= cols
+    if window is not None:
+        mask = mask & (rows - cols < window)
+    return mask
+
+
+def _check_window(window, causal) -> None:
+    if window is None:
+        return
+    if not causal:
+        raise ValueError(
+            "window (sliding-window attention) requires causal=True — "
+            "the window is defined over the causal past")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
 
 
 def naive_attention(q, k, v, causal: bool = False, scale: float | None = None,
-                    q_offset: int = 0, kv_offset: int = 0):
+                    q_offset: int = 0, kv_offset: int = 0,
+                    window: int | None = None):
     """Materialized-logits attention; the test oracle."""
+    _check_window(window, causal)
     scale = _scale_for(q, scale)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
-        mask = _causal_mask(q.shape[1], k.shape[1], q_offset, kv_offset)
+        mask = _causal_mask(q.shape[1], k.shape[1], q_offset, kv_offset,
+                            window)
         logits = jnp.where(mask[None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -66,7 +86,7 @@ def naive_attention(q, k, v, causal: bool = False, scale: float | None = None,
 
 
 def attention_chunk(q, k, v, m, l, o, causal: bool, scale: float,
-                    q_offset, kv_offset):
+                    q_offset, kv_offset, window: int | None = None):
     """One online-softmax update with a KV chunk.
 
     Running state (per q row): ``m`` max logit ``[B,H,Lq]``, ``l``
@@ -76,7 +96,8 @@ def attention_chunk(q, k, v, m, l, o, causal: bool, scale: float,
     """
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
-        mask = _causal_mask(q.shape[1], k.shape[1], q_offset, kv_offset)
+        mask = _causal_mask(q.shape[1], k.shape[1], q_offset, kv_offset,
+                            window)
         logits = jnp.where(mask[None, None], logits, NEG_INF)
     m_new = jnp.maximum(m, logits.max(axis=-1))
     correction = jnp.exp(m - m_new)
@@ -108,13 +129,15 @@ def online_finish(m, l, o):
 
 def blockwise_attention(q, k, v, causal: bool = False,
                         scale: float | None = None, block_k: int = 512,
-                        q_offset: int = 0, kv_offset: int = 0):
+                        q_offset: int = 0, kv_offset: int = 0,
+                        window: int | None = None):
     """Online-softmax attention scanning KV in chunks; O(block_k) logits.
 
     Pure jnp: the differentiable any-backend reference for
     :func:`flash_attention`, and the single-device semantics that ring
     attention distributes.
     """
+    _check_window(window, causal)
     b, lq, h, d = q.shape
     lk = k.shape[1]
     # Clamp to the largest divisor of lk <= block_k so any length works
@@ -135,7 +158,7 @@ def blockwise_attention(q, k, v, causal: bool = False,
         kc, vc, idx = chunk
         m, l, o = attention_chunk(
             qf, kc.astype(jnp.float32), vc.astype(jnp.float32), m, l, o,
-            causal, scale, q_offset, kv_offset + idx * block_k)
+            causal, scale, q_offset, kv_offset + idx * block_k, window)
         return (m, l, o), None
 
     (m, l, o), _ = jax.lax.scan(
@@ -147,7 +170,7 @@ def blockwise_attention(q, k, v, causal: bool = False,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
-                  with_lse: bool):
+                  with_lse: bool, window: int | None = None):
     """Flash-attention forward for one (batch*head, q-block, kv-block) cell.
 
     KV streams through the grid's innermost dimension so VMEM holds only
@@ -181,8 +204,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
 
     # Causal: kv blocks strictly above the diagonal contribute nothing;
     # predicate the whole update away (restores the ~2x causal saving).
+    # A window switches to a BANDED grid (see _banded_kv): the inner
+    # dimension walks only the ~window/block_k blocks inside the
+    # lookback, so K/V HBM traffic — not just compute — is O(window).
     row0 = pl.program_id(1) * block_q
-    live = (not causal) or (j * block_k <= row0 + block_q - 1)
+    if window is None:
+        col0 = j * block_k
+        live = (not causal) or (col0 <= row0 + block_q - 1)
+    else:
+        # Must mirror _banded_kv's index_map exactly; raw < 0 are
+        # clamped duplicates of block 0 and predicated dead.
+        raw = (row0 + block_q - 1) // block_k - (n_kb - 1) + j
+        col0 = jnp.maximum(raw, 0) * block_k
+        live = ((raw >= 0)
+                & (col0 <= row0 + block_q - 1)
+                & (col0 + block_k - 1 >= row0 - (window - 1)))
 
     @pl.when(live)
     def _update():
@@ -196,8 +232,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
             rows = (jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
                     + row0)
             cols = (jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-                    + j * block_k)
-            logits = jnp.where(rows >= cols, logits, NEG_INF)
+                    + col0)
+            keep = rows >= cols
+            if window is not None:
+                keep = keep & (rows - cols < window)
+            logits = jnp.where(keep, logits, NEG_INF)
         m = m_scr[:, :1]
         l = l_scr[:, :1]
         m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
@@ -233,8 +272,39 @@ except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
 
+def _banded_kv(window: int, block_q: int, block_k: int, n_kb: int):
+    """Banded inner-grid spec for windowed kernels: (extent, index_map).
+
+    A q block's live kv blocks span floor((row0-window+1)/bk) ..
+    floor((row0+bq-1)/bk); the extent bounds that count over any
+    alignment, and the map walks them ascending so the last j is the
+    diagonal block.  Raw indices below 0 clamp to block 0 and the
+    kernels predicate them dead (they would otherwise double-count)."""
+    extent = min((window - 1 + block_q - 1) // block_k + 2, n_kb)
+
+    def index_map(bh, i, j):
+        last = (i * block_q + block_q - 1) // block_k
+        return (bh, jnp.maximum(last - (extent - 1) + j, 0), 0)
+
+    return extent, index_map
+
+
+def _banded_q(window: int, block_q: int, block_k: int, n_qb: int):
+    """Banded inner grid for the dkv kernel (q streams): a kv block's
+    live q blocks span floor(col0/bq) .. floor((col0+bk-1+window-1)/bq);
+    raw indices above the last block clamp down and are predicated
+    dead."""
+    extent = min((block_k - 1 + window - 1) // block_q + 2, n_qb)
+
+    def index_map(bh, i, j):
+        first = (i * block_k) // block_q
+        return (bh, jnp.minimum(first + j, n_qb - 1), 0)
+
+    return extent, index_map
+
+
 def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False,
-                  with_lse=True):
+                  with_lse=True, window=None):
     """Returns (out, lse) with ``with_lse`` (training), else (out, None) —
     inference skips the lse buffer's HBM writes entirely."""
     b, lq, h, d = q.shape
@@ -245,7 +315,7 @@ def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False,
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
-                               with_lse=with_lse)
+                               with_lse=with_lse, window=window)
 
     o_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
                           memory_space=pltpu.VMEM)
@@ -256,15 +326,21 @@ def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False,
     out_bytes = o_shape.size * q.dtype.itemsize + (
         lse_shape.size * 4 if with_lse else 0)
 
+    n_kb = lk // block_k
+    if window is not None:
+        inner, kv_map = _banded_kv(window, block_q, block_k, n_kb)
+    else:
+        inner, kv_map = n_kb, (lambda bh, i, j: (bh, j, 0))
+
     def call(): return pl.pallas_call(
         kernel,
-        grid=(b * h, lq // block_q, lk // block_k),
+        grid=(b * h, lq // block_q, inner),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
+            pl.BlockSpec((1, block_k, d), kv_map,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
+            pl.BlockSpec((1, block_k, d), kv_map,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(o_spec, lse_spec) if with_lse else o_spec,
@@ -295,7 +371,8 @@ def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scr, *, causal: bool, scale: float):
+                         dq_ref, dq_scr, *, causal: bool, scale: float,
+                         window: int | None = None):
     """dQ for one (batch*head, q-block, kv-block) cell.
 
     FA2 backward: probabilities are rebuilt per tile from the saved
@@ -313,7 +390,16 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = (not causal) or (j * block_k <= row0 + block_q - 1)
+    if window is None:
+        col0 = j * block_k
+        live = (not causal) or (col0 <= row0 + block_q - 1)
+    else:
+        # Banded inner grid (mirror _banded_kv; see _flash_kernel).
+        raw = (row0 + block_q - 1) // block_k - (n_kb - 1) + j
+        col0 = jnp.maximum(raw, 0) * block_k
+        live = ((raw >= 0)
+                & (col0 <= row0 + block_q - 1)
+                & (col0 + block_k - 1 >= row0 - (window - 1)))
 
     @pl.when(live)
     def _update():
@@ -326,8 +412,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             rows = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + row0)
             cols = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-                    + j * block_k)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+                    + col0)
+            keep = rows >= cols
+            if window is not None:
+                keep = keep & (rows - cols < window)
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])
         dp = jax.lax.dot_general(do, vj, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -343,7 +432,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
-                          scale: float):
+                          scale: float, window: int | None = None,
+                          n_qb_total: int = 0):
     """dK/dV for one (batch*head, kv-block, q-block) cell; q streams on
     the inner grid dimension, accumulating into the kv block's scratch."""
     jq = pl.program_id(2)
@@ -351,15 +441,26 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     block_k = k_ref.shape[1]
     block_q = q_ref.shape[1]
     col0 = pl.program_id(1) * block_k
-    row0 = jq * block_q
 
     @pl.when(jq == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    # Causal: a q block contributes unless entirely above the diagonal.
-    live = (not causal) or (row0 + block_q - 1 >= col0)
+    # Causal: a q block contributes unless entirely above the diagonal;
+    # with a window the inner grid is banded (mirror _banded_q): only
+    # the q blocks inside this kv block's horizon stream through, and
+    # clamped duplicates past the last block are predicated dead.
+    if window is None:
+        row0 = jq * block_q
+        live = (not causal) or (row0 + block_q - 1 >= col0)
+    else:
+        raw = col0 // block_q + jq
+        clamped = jnp.minimum(raw, n_qb_total - 1)
+        row0 = clamped * block_q
+        live = ((raw <= n_qb_total - 1)
+                & (row0 + block_q - 1 >= col0)
+                & (row0 - (col0 + block_k - 1) < window))
 
     @pl.when(live)
     def _update():
@@ -372,7 +473,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             rows = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + row0)
             cols = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + col0)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            keep = rows >= cols
+            if window is not None:
+                keep = keep & (rows - cols < window)
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])  # [block_q, block_k]
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -391,7 +495,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                      interpret=False):
+                      interpret=False, window=None):
     """Pallas dQ/dK/dV from the saved (out, lse) residuals."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -410,12 +514,19 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     kv_at_inner = ((1, block_k, d), lambda bh, i, j: (bh, j, 0))
     row_at = ((1, block_q, 128), lambda bh, i, j: (bh, i, 0))
 
+    n_kb = lk // block_k
+    if window is not None:
+        dq_inner, dq_kv_map = _banded_kv(window, block_q, block_k, n_kb)
+        kv_at_banded = ((1, block_k, d), dq_kv_map)
+    else:
+        dq_inner, kv_at_banded = n_kb, kv_at_inner
+
     def call_dq():
         return pl.pallas_call(
             functools.partial(_flash_bwd_dq_kernel, causal=causal,
-                              scale=scale),
-            grid=(b * h, lq // block_q, lk // block_k),
-            in_specs=[vspec(q_at), vspec(kv_at_inner), vspec(kv_at_inner),
+                              scale=scale, window=window),
+            grid=(b * h, lq // block_q, dq_inner),
+            in_specs=[vspec(q_at), vspec(kv_at_banded), vspec(kv_at_banded),
                       vspec(q_at), vspec(row_at), vspec(row_at)],
             out_specs=vspec(q_at),
             out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
@@ -431,14 +542,23 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     q_at_inner = ((1, block_q, d), lambda bh, i, j: (bh, j, 0))
     row_at_inner = ((1, block_q, 128), lambda bh, i, j: (bh, j, 0))
 
+    n_qb = lq // block_q
+    if window is not None:
+        dkv_inner, dkv_q_map = _banded_q(window, block_q, block_k, n_qb)
+        q_in = ((1, block_q, d), dkv_q_map)
+        row_in = ((1, block_q, 128), dkv_q_map)
+    else:
+        dkv_inner, q_in, row_in = n_qb, q_at_inner, row_at_inner
+
     def call_dkv():
         return pl.pallas_call(
             functools.partial(_flash_bwd_dkv_kernel, causal=causal,
-                              scale=scale),
-            grid=(b * h, lk // block_k, lq // block_q),
-            in_specs=[vspec(q_at_inner), vspec(kv_at), vspec(kv_at),
-                      vspec(q_at_inner), vspec(row_at_inner),
-                      vspec(row_at_inner)],
+                              scale=scale, window=window,
+                              n_qb_total=n_qb),
+            grid=(b * h, lk // block_k, dkv_inner),
+            in_specs=[vspec(q_in), vspec(kv_at), vspec(kv_at),
+                      vspec(q_in), vspec(row_in),
+                      vspec(row_in)],
             out_specs=(vspec(kv_at), vspec(kv_at)),
             out_shape=(jax.ShapeDtypeStruct((b * h, lk, d), k.dtype),
                        jax.ShapeDtypeStruct((b * h, lk, d), v.dtype)),
@@ -471,9 +591,10 @@ def _use_pallas(q, k, block_q, block_k) -> bool:
             and lk % min(block_k, lk) == 0 and min(lq, lk) >= 8)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
-                    block_q: int = 256, block_k: int = 512):
+                    block_q: int = 256, block_k: int = 512,
+                    window: int | None = None):
     """Fused attention: Pallas kernel on TPU, blockwise jnp elsewhere.
 
     Differentiable with O(L) residuals both ways: on the Pallas path
@@ -481,34 +602,44 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     rebuild probabilities per tile from the forward's saved
     log-sum-exp; on the fallback path the backward re-runs the
     blockwise forward under ``jax.vjp``.
+
+    ``window`` (with ``causal=True``) is sliding-window attention: each
+    query attends its last ``window`` positions (self included).  The
+    kernels skip kv blocks entirely beyond the lookback, so compute per
+    query is O(window), not O(L) — the long-context local-attention
+    primitive (Mistral-style).
     """
+    _check_window(window, causal)
     s = _scale_for(q, scale)
     if _use_pallas(q, k, block_q, block_k):
         return _flash_pallas(q, k, v, causal, s, block_q, block_k,
-                             with_lse=False)[0]
+                             with_lse=False, window=window)[0]
     return blockwise_attention(q, k, v, causal=causal, scale=s,
-                               block_k=block_k)
+                               block_k=block_k, window=window)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None):
+    _check_window(window, causal)
     s = _scale_for(q, scale)
     if _use_pallas(q, k, block_q, block_k):
-        out, lse = _flash_pallas(q, k, v, causal, s, block_q, block_k)
+        out, lse = _flash_pallas(q, k, v, causal, s, block_q, block_k,
+                                 window=window)
         return out, (q, k, v, out, lse)
     out = blockwise_attention(q, k, v, causal=causal, scale=s,
-                              block_k=block_k)
+                              block_k=block_k, window=window)
     return out, (q, k, v, None, None)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, window, res, g):
     q, k, v, out, lse = res
     s = _scale_for(q, scale)
     if lse is not None:
         return _flash_pallas_bwd(q, k, v, out, lse, g, causal, s,
-                                 block_q, block_k)
+                                 block_q, block_k, window=window)
     _, vjp = jax.vjp(
         lambda q, k, v: blockwise_attention(
-            q, k, v, causal=causal, scale=s, block_k=block_k),
+            q, k, v, causal=causal, scale=s, block_k=block_k,
+            window=window),
         q, k, v)
     return vjp(g)
 

@@ -157,6 +157,11 @@ class LMTrainer(CheckpointingBase):
                 "tp_rules shard K/V projections over their head "
                 "dimension. Use more KV heads, a smaller model axis, or "
                 "custom rules.")
+        if cfg.attention_window is not None and n_seq > 1:
+            raise ValueError(
+                "cfg.attention_window does not compose with a seq mesh "
+                "axis > 1 (ring attention) in this version — drop the "
+                "window or the seq axis")
         if cfg.dropout > 0 and n_pipe > 1:
             raise ValueError(
                 "cfg.dropout > 0 cannot compose with a pipeline axis > 1: "

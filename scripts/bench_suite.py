@@ -261,6 +261,17 @@ def bench_transformer_long_rope():
         batch=8, seq=4096, iters=20)
 
 
+def bench_transformer_long_window():
+    """Long config with sliding-window attention (window 1024 at seq
+    4096): the kernels skip blocks beyond the lookback, so the S^2
+    attention term drops ~4x."""
+    import dataclasses
+
+    return _measure_lm(
+        dataclasses.replace(_long_cfg(), attention_window=1024),
+        batch=8, seq=4096, iters=20)
+
+
 def bench_transformer_long_rematdots():
     """Long config with selective remat (policy='dots': matmul outputs
     saved, elementwise recomputed) — the middle point between full
@@ -515,6 +526,8 @@ BENCHES = {
     "generate_decode_int8": (bench_generate_decode_int8, "tokens/sec/chip"),
     "transformer_long": (bench_transformer_long, "tokens/sec/chip"),
     "transformer_long_rope": (bench_transformer_long_rope, "tokens/sec/chip"),
+    "transformer_long_window": (bench_transformer_long_window,
+                                "tokens/sec/chip"),
     "transformer_long_rematdots": (bench_transformer_long_rematdots,
                                    "tokens/sec/chip"),
     "transformer_long_noremat": (bench_transformer_long_noremat,

@@ -150,3 +150,113 @@ def test_ring_attention_grads(devices, rng):
         argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g, g_ref):
         np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+# ------------------------------------------------------- sliding window
+
+@pytest.mark.parametrize("window", [1, 3, 7, 16, 64])
+def test_blockwise_window_matches_naive(rng, window):
+    q, k, v = qkv(rng, b=2, l=16, h=2, d=8)
+    ref = naive_attention(q, k, v, causal=True, window=window)
+    out = blockwise_attention(q, k, v, causal=True, block_k=4,
+                              window=window)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+    if window >= 16:  # window >= L degenerates to plain causal
+        full = naive_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, full, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("window", [2, 5, 8])
+def test_pallas_window_interpret(rng, window):
+    """Windowed flash kernel (incl. dead-block skipping) == naive, via
+    the TPU-semantics interpreter on CPU."""
+    q, k, v = qkv(rng, b=1, l=16, h=1, d=128)
+    ref = naive_attention(q, k, v, causal=True, window=window)
+    out, _ = _flash_pallas(q, k, v, True, 1.0 / np.sqrt(128), block_q=8,
+                           block_k=8, interpret=True, window=window)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("window", [3, 8])
+def test_pallas_window_backward_interpret(rng, window):
+    """Windowed FA2 backward kernels == autodiff of the blockwise
+    windowed reference."""
+    from distkeras_tpu.ops.attention import _flash_pallas_bwd
+
+    q, k, v = qkv(rng, b=1, l=16, h=1, d=128)
+    scale = 1.0 / np.sqrt(128)
+    out, lse = _flash_pallas(q, k, v, True, scale, block_q=8, block_k=8,
+                             interpret=True, window=window)
+    g = np.asarray(jax.random.normal(jax.random.key(0), out.shape),
+                   np.float32)
+    dq, dk, dv = _flash_pallas_bwd(q, k, v, out, lse, g, True, scale,
+                                   8, 8, interpret=True, window=window)
+    ref, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(q, k, v, causal=True,
+                                            scale=scale, block_k=4,
+                                            window=window), q, k, v)
+    rdq, rdk, rdv = vjp(g)
+    np.testing.assert_allclose(dq, rdq, atol=2e-3, rtol=2e-3)
+    np.testing.assert_allclose(dk, rdk, atol=2e-3, rtol=2e-3)
+    np.testing.assert_allclose(dv, rdv, atol=2e-3, rtol=2e-3)
+
+
+def test_window_validation(rng):
+    q, k, v = qkv(rng, b=1, l=8, h=1, d=8)
+    with pytest.raises(ValueError, match="causal"):
+        naive_attention(q, k, v, causal=False, window=4)
+    with pytest.raises(ValueError, match="window"):
+        blockwise_attention(q, k, v, causal=True, window=0)
+    from distkeras_tpu.ops.attention import flash_attention
+
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, False, None, 8, 8, 4)
+
+
+def test_flash_attention_window_grads_fallback(rng):
+    """flash_attention with a window on the non-TPU fallback: value and
+    grads match the naive windowed oracle."""
+    from distkeras_tpu.ops.attention import flash_attention
+
+    q, k, v = qkv(rng, b=2, l=12, h=2, d=8)
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, True, None, 8, 4, 5).sum()
+
+    def f_naive(q, k, v):
+        return naive_attention(q, k, v, causal=True, window=5).sum()
+
+    np.testing.assert_allclose(float(f_flash(q, k, v)),
+                               float(f_naive(q, k, v)), rtol=1e-5)
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("bq,bk", [(8, 16), (16, 8), (8, 8)])
+@pytest.mark.parametrize("window", [3, 9, 20])
+def test_pallas_window_banded_grid_asymmetric_blocks(rng, bq, bk, window):
+    """The banded index maps must stay exact for block_q != block_k and
+    windows spanning multiple blocks (fwd + both backward kernels)."""
+    from distkeras_tpu.ops.attention import _flash_pallas_bwd
+
+    q, k, v = qkv(rng, b=1, l=32, h=1, d=128)
+    scale = 1.0 / np.sqrt(128)
+    ref = naive_attention(q, k, v, causal=True, window=window)
+    out, lse = _flash_pallas(q, k, v, True, scale, block_q=bq, block_k=bk,
+                             interpret=True, window=window)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+    g = np.asarray(jax.random.normal(jax.random.key(1), out.shape),
+                   np.float32)
+    dq, dk, dv = _flash_pallas_bwd(q, k, v, out, lse, g, True, scale,
+                                   bq, bk, interpret=True, window=window)
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(q, k, v, causal=True,
+                                            scale=scale, block_k=8,
+                                            window=window), q, k, v)
+    rdq, rdk, rdv = vjp(g)
+    np.testing.assert_allclose(dq, rdq, atol=2e-3, rtol=2e-3)
+    np.testing.assert_allclose(dk, rdk, atol=2e-3, rtol=2e-3)
+    np.testing.assert_allclose(dv, rdv, atol=2e-3, rtol=2e-3)

@@ -509,3 +509,32 @@ def test_beam_frozen_score_is_length_invariant(rng):
     np.testing.assert_allclose(np.asarray(s_long[:, 0]),
                                np.asarray(s_short[:, 0]),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_windowed_decode_matches_training_forward(rng):
+    """KV-cached decode with attention_window reproduces the training
+    forward's logits position by position (same banded mask)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(ROPE_CFG, attention_window=4)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    t = jnp.asarray(rng.integers(0, 64, (2, 10)), jnp.int32)
+    full_logits, _ = tfm.apply(params, t, cfg)
+    cache = init_cache(cfg, 2)
+    for pos in range(10):
+        step_logits, cache = _decode_step(params, cache, t[:, pos], pos,
+                                          cfg)
+        np.testing.assert_allclose(
+            np.asarray(step_logits), np.asarray(full_logits[:, pos]),
+            atol=2e-4, rtol=2e-4)
+
+
+def test_windowed_generate_prefill_matches_sequential(rng):
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, attention_window=3)
+    params = tfm.init_params(jax.random.key(1), cfg)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 7)), jnp.int32)
+    pre = generate(params, prompt, cfg, 6, use_prefill=True)
+    seq = generate(params, prompt, cfg, 6, use_prefill=False)
+    np.testing.assert_array_equal(np.asarray(pre), np.asarray(seq))
