@@ -96,8 +96,8 @@ class TransformerConfig:
     # causal past — compute per token drops from O(L) to O(window) in
     # the flash kernels (dead blocks skipped), the standard local-
     # attention long-context trade.  None = full causal attention.
-    # Composes with rope/GQA/remat/ce_chunks and the KV-cached decode;
-    # not with ring attention (the seq mesh axis) in this version.
+    # Composes with rope/GQA/remat/ce_chunks, the KV-cached decode, and
+    # ring attention (global-position masking per hop).
     attention_window: int | None = None
     # z-loss (ST-MoE eq. 6): z_loss_coef * mean(logsumexp(logits)^2)
     # added to the TRAINING loss only.  Keeps the softmax normalizer
@@ -394,13 +394,17 @@ def apply_hidden(params, tokens, cfg: TransformerConfig,
     if attention_fn is None:
         attention_fn = lambda q, k, v: flash_attention(
             q, k, v, True, window=cfg.attention_window)
-    elif cfg.attention_window is not None:
+    elif (cfg.attention_window is not None
+          and getattr(attention_fn, "handles_window", None)
+          != cfg.attention_window):
         raise ValueError(
             "cfg.attention_window only threads through the default "
-            "attention; a custom attention_fn must implement the window "
-            "itself (pass window= to flash_attention) or the config "
-            "must drop it — otherwise training would silently run full "
-            "attention while the KV-cached decode applies the band")
+            "attention; a custom attention_fn must implement the SAME "
+            "window (pass window= to flash_attention / "
+            "make_ring_attention, which sets fn.handles_window to the "
+            "value) or the config must drop it — a missing or "
+            "mismatched band would silently diverge training from the "
+            "KV-cached decode")
     dtype = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
     _check_len(s, cfg)
@@ -547,22 +551,21 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
                 "itself")
         from distkeras_tpu.parallel.ring import ring_attention
 
-        if cfg.attention_window is not None:
-            raise ValueError(
-                "attention_window does not compose with the seq mesh "
-                "axis (ring attention) in this version — drop the "
-                "window or the seq axis")
         attention_fn = functools.partial(ring_attention, axis_name=seq_axis,
-                                         causal=True)
+                                         causal=True,
+                                         window=cfg.attention_window)
         x_spec = P(None, seq_axis)
     elif attention_fn is None:
         attention_fn = lambda q, k, v: flash_attention(
             q, k, v, True, window=cfg.attention_window)
-    elif cfg.attention_window is not None:
+    elif (cfg.attention_window is not None
+          and getattr(attention_fn, "handles_window", None)
+          != cfg.attention_window):
         raise ValueError(
             "cfg.attention_window only threads through the default "
-            "attention; a custom attention_fn must implement the window "
-            "itself or the config must drop it")
+            "attention; a custom attention_fn must implement the SAME "
+            "window (fn.handles_window carries the value) or the "
+            "config must drop it")
     n_stages = int(mesh.shape[axis_name])
     if cfg.n_layers % n_stages:
         raise ValueError(

@@ -212,13 +212,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
         col0 = j * block_k
         live = (not causal) or (col0 <= row0 + block_q - 1)
     else:
-        # Must mirror _banded_kv's index_map exactly; raw < 0 are
-        # clamped duplicates of block 0 and predicated dead.
-        raw = (row0 + block_q - 1) // block_k - (n_kb - 1) + j
-        col0 = jnp.maximum(raw, 0) * block_k
-        live = ((raw >= 0)
-                & (col0 <= row0 + block_q - 1)
-                & (col0 + block_k - 1 >= row0 - (window - 1)))
+        col0, live = _banded_cols(row0, j, n_kb, block_q, block_k, window)
 
     @pl.when(live)
     def _update():
@@ -229,14 +223,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
             qi, kj, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [block_q, block_k]
         if causal:
-            rows = (jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
-                    + row0)
-            cols = (jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-                    + col0)
-            keep = rows >= cols
-            if window is not None:
-                keep = keep & (rows - cols < window)
-            logits = jnp.where(keep, logits, NEG_INF)
+            logits = jnp.where(_keep_mask(logits.shape, row0, col0, window),
+                               logits, NEG_INF)
         m = m_scr[:, :1]
         l = l_scr[:, :1]
         m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
@@ -270,6 +258,31 @@ try:  # Pallas import is cheap but keep non-TPU environments working.
     _HAVE_PALLAS = True
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
+
+
+def _banded_cols(row0, j, n_inner: int, block_q: int, block_k: int,
+                 window: int):
+    """(col0, live) for the kv-streaming banded kernels (forward and
+    dQ) — the ONE mirror of _banded_kv's index_map: raw < 0 are clamped
+    duplicates of block 0 and predicated dead."""
+    raw = (row0 + block_q - 1) // block_k - (n_inner - 1) + j
+    col0 = jnp.maximum(raw, 0) * block_k
+    live = ((raw >= 0)
+            & (col0 <= row0 + block_q - 1)
+            & (col0 + block_k - 1 >= row0 - (window - 1)))
+    return col0, live
+
+
+def _keep_mask(shape, row0, col0, window):
+    """Causal (optionally banded) keep-mask for a [block_q, block_k]
+    logits tile at global offsets (row0, col0) — shared by all three
+    kernels so forward and backward masks cannot drift."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0) + row0
+    cols = jax.lax.broadcasted_iota(jnp.int32, shape, 1) + col0
+    keep = rows >= cols
+    if window is not None:
+        keep = keep & (rows - cols < window)
+    return keep
 
 
 def _banded_kv(window: int, block_q: int, block_k: int, n_kb: int):
@@ -394,12 +407,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         col0 = j * block_k
         live = (not causal) or (col0 <= row0 + block_q - 1)
     else:
-        # Banded inner grid (mirror _banded_kv; see _flash_kernel).
-        raw = (row0 + block_q - 1) // block_k - (n_kb - 1) + j
-        col0 = jnp.maximum(raw, 0) * block_k
-        live = ((raw >= 0)
-                & (col0 <= row0 + block_q - 1)
-                & (col0 + block_k - 1 >= row0 - (window - 1)))
+        col0, live = _banded_cols(row0, j, n_kb, block_q, block_k, window)
 
     @pl.when(live)
     def _update():
@@ -410,13 +418,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(qi, kj, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + row0)
-            cols = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-                    + col0)
-            keep = rows >= cols
-            if window is not None:
-                keep = keep & (rows - cols < window)
-            s = jnp.where(keep, s, NEG_INF)
+            s = jnp.where(_keep_mask(s.shape, row0, col0, window),
+                          s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])
         dp = jax.lax.dot_general(do, vj, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -471,12 +474,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(qi, kj, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + row0)
-            cols = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + col0)
-            keep = rows >= cols
-            if window is not None:
-                keep = keep & (rows - cols < window)
-            s = jnp.where(keep, s, NEG_INF)
+            s = jnp.where(_keep_mask(s.shape, row0, col0, window),
+                          s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])  # [block_q, block_k]
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),

@@ -34,12 +34,18 @@ from distkeras_tpu.ops.attention import (
 
 
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
-                   scale: float | None = None):
+                   scale: float | None = None, window: int | None = None):
     """Per-shard ring attention body; call inside ``shard_map``.
 
     ``q/k/v: [B, L_local, H, D]`` — the local shard of a sequence of
     global length ``L_local * axis_size``.  Returns the local shard of
     the attention output.
+
+    ``window`` (causal sliding window) masks on *global* positions via
+    the per-hop offsets, so ring + local attention composes exactly
+    with the single-device result; hops whose KV shard lies entirely
+    beyond the lookback contribute nothing (masked, still rotated —
+    the ring must complete for the other devices).
     """
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -56,7 +62,8 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
         src = (my_idx - hop) % axis_size
         return attention_chunk(
             qf, kc.astype(jnp.float32), vc.astype(jnp.float32), m, l, o,
-            causal, s, q_offset=my_idx * lq, kv_offset=src * lk)
+            causal, s, q_offset=my_idx * lq, kv_offset=src * lk,
+            window=window)
 
     def body(carry, hop):
         m, l, o, kc, vc = carry
@@ -77,7 +84,8 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
 
 def make_ring_attention(mesh: Mesh, axis_name: str = "seq",
                         batch_axis: str | None = "data",
-                        causal: bool = False, scale: float | None = None):
+                        causal: bool = False, scale: float | None = None,
+                        window: int | None = None):
     """Wrap :func:`ring_attention` in shard_map over ``mesh``.
 
     Returns ``f(q, k, v) -> out`` taking/returning global arrays of
@@ -93,10 +101,19 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "seq",
     (transformer.apply_pipelined's ``seq_axis``).
     """
     fn = functools.partial(ring_attention, axis_name=axis_name,
-                           causal=causal, scale=scale)
+                           causal=causal, scale=scale, window=window)
     spec = P(batch_axis, axis_name, None, None)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)
+    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+
+    def ring_fn(q, k, v):
+        return mapped(q, k, v)
+
+    # Tells apply_hidden's window guard WHICH window this attention_fn
+    # implements; the guard requires it to equal cfg.attention_window
+    # (a mismatched band would silently diverge train from decode).
+    ring_fn.handles_window = window
+    return ring_fn
 
 
 def sequence_sharding(mesh: Mesh, batch_axis: str | None = "data",

@@ -157,11 +157,6 @@ class LMTrainer(CheckpointingBase):
                 "tp_rules shard K/V projections over their head "
                 "dimension. Use more KV heads, a smaller model axis, or "
                 "custom rules.")
-        if cfg.attention_window is not None and n_seq > 1:
-            raise ValueError(
-                "cfg.attention_window does not compose with a seq mesh "
-                "axis > 1 (ring attention) in this version — drop the "
-                "window or the seq axis")
         if cfg.dropout > 0 and n_pipe > 1:
             raise ValueError(
                 "cfg.dropout > 0 cannot compose with a pipeline axis > 1: "
@@ -198,7 +193,8 @@ class LMTrainer(CheckpointingBase):
                 cfg, opt, grad_accum=grad_accum, **fwd_kw)
             self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg, **fwd_kw)
         elif n_seq > 1:
-            ring = make_ring_attention(self.mesh, causal=True)
+            ring = make_ring_attention(self.mesh, causal=True,
+                                       window=cfg.attention_window)
             self._step_builder = lambda opt: tfm.make_train_step(
                 cfg, opt, attention_fn=ring, grad_accum=grad_accum)
             self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg,

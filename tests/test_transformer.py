@@ -564,13 +564,64 @@ def test_attention_window_trains(rng):
     assert float(loss) < first * 0.5
 
 
-def test_attention_window_rejects_ring(rng, devices):
+def test_attention_window_ring_matches_single(rng, devices):
+    """Windowed ring attention (global-position band per hop) == the
+    single-device windowed forward, with the windowed cfg flowing
+    through apply (the handles_window marker admits the ring fn)."""
+    import dataclasses
+
+    w = 5
+    cfg = dataclasses.replace(CFG, attention_window=w)
+    mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
+    params = tfm.init_params(jax.random.key(0), CFG)
+    t = toks(rng)
+    ref, _ = tfm.apply(params, jnp.asarray(t), cfg)
+    ring = make_ring_attention(mesh, causal=True, window=w)
+    assert ring.handles_window
+    out = _sharded_apply(params, t, cfg, mesh, [], attention_fn=ring)
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_attention_window_lm_trainer_ring(rng, devices):
+    """LMTrainer on a dp x sp mesh with attention_window trains (the
+    trainer builds the window-aware ring itself)."""
     import dataclasses
 
     import distkeras_tpu as dk
-    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+    from distkeras_tpu.parallel.mesh import MeshSpec as MS, make_mesh as mm
+
+    cfg = dataclasses.replace(CFG, attention_window=4, max_len=17)
+    mesh = mm(MS(data=2, seq=2), devices=devices[:4])
+    tr = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=8, num_epoch=4,
+                      mesh=mesh)
+    tokens = np.repeat(
+        rng.integers(0, CFG.vocab_size, (64, 1)), 17, axis=1
+    ).astype(np.int32)
+    tr.train(tokens)
+    assert tr.history[-1] < tr.history[0] * 0.5
+
+
+def test_attention_window_rejects_custom_attention_fn(rng):
+    import dataclasses
+
+    from distkeras_tpu.ops.attention import naive_attention
+
+    cfg = dataclasses.replace(CFG, attention_window=4)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    with pytest.raises(ValueError, match="attention_fn"):
+        tfm.apply(params, jnp.asarray(toks(rng)), cfg,
+                  attention_fn=lambda q, k, v: naive_attention(
+                      q, k, v, causal=True))
+
+
+def test_attention_window_rejects_mismatched_ring(rng, devices):
+    """A ring built with a DIFFERENT window than cfg must be refused —
+    a mismatched band would silently diverge train from decode."""
+    import dataclasses
 
     cfg = dataclasses.replace(CFG, attention_window=4)
     mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
-    with pytest.raises(ValueError, match="seq"):
-        dk.LMTrainer(cfg, batch_size=8, mesh=mesh)
+    params = tfm.init_params(jax.random.key(0), CFG)
+    ring8 = make_ring_attention(mesh, causal=True, window=8)
+    with pytest.raises(ValueError, match="SAME"):
+        tfm.apply(params, jnp.asarray(toks(rng)), cfg, attention_fn=ring8)
