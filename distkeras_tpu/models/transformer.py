@@ -252,6 +252,32 @@ def tp_rules():
     ]
 
 
+def _resolve_attention_fn(cfg: "TransformerConfig", attention_fn):
+    """ONE guard for the window/attention_fn pairing (apply_hidden and
+    apply_pipelined share it).
+
+    No fn: build the default windowed flash lambda.  Custom fn: its
+    ``handles_window`` attribute (set by make_ring_attention; set it
+    yourself on hand-rolled fns) must equal ``cfg.attention_window`` in
+    BOTH directions — a band applied on one side only would silently
+    diverge training from the KV-cached decode, which follows cfg.
+    """
+    if attention_fn is None:
+        return lambda q, k, v: flash_attention(
+            q, k, v, True, window=cfg.attention_window)
+    fn_window = getattr(attention_fn, "handles_window", None)
+    if fn_window != cfg.attention_window:
+        raise ValueError(
+            f"attention window mismatch: cfg.attention_window="
+            f"{cfg.attention_window} but the supplied attention_fn "
+            f"implements window={fn_window} (fn.handles_window). Build "
+            "the fn with the same window (make_ring_attention(..., "
+            "window=...) sets the attribute; set it yourself on custom "
+            "fns) or align the config — a one-sided band silently "
+            "diverges training from the KV-cached decode")
+    return attention_fn
+
+
 def _check_len(s: int, cfg: TransformerConfig) -> None:
     # RoPE has no trained position table: any training length is valid
     # (max_len only sizes the decode KV cache, models/generate.py).
@@ -391,20 +417,7 @@ def apply_hidden(params, tokens, cfg: TransformerConfig,
     chunked cross-entropy path consumes the hidden states directly so
     the full-vocab logits never materialize.  Returns (hidden, aux).
     """
-    if attention_fn is None:
-        attention_fn = lambda q, k, v: flash_attention(
-            q, k, v, True, window=cfg.attention_window)
-    elif (cfg.attention_window is not None
-          and getattr(attention_fn, "handles_window", None)
-          != cfg.attention_window):
-        raise ValueError(
-            "cfg.attention_window only threads through the default "
-            "attention; a custom attention_fn must implement the SAME "
-            "window (pass window= to flash_attention / "
-            "make_ring_attention, which sets fn.handles_window to the "
-            "value) or the config must drop it — a missing or "
-            "mismatched band would silently diverge training from the "
-            "KV-cached decode")
+    attention_fn = _resolve_attention_fn(cfg, attention_fn)
     dtype = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
     _check_len(s, cfg)
@@ -555,17 +568,8 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
                                          causal=True,
                                          window=cfg.attention_window)
         x_spec = P(None, seq_axis)
-    elif attention_fn is None:
-        attention_fn = lambda q, k, v: flash_attention(
-            q, k, v, True, window=cfg.attention_window)
-    elif (cfg.attention_window is not None
-          and getattr(attention_fn, "handles_window", None)
-          != cfg.attention_window):
-        raise ValueError(
-            "cfg.attention_window only threads through the default "
-            "attention; a custom attention_fn must implement the SAME "
-            "window (fn.handles_window carries the value) or the "
-            "config must drop it")
+    else:
+        attention_fn = _resolve_attention_fn(cfg, attention_fn)
     n_stages = int(mesh.shape[axis_name])
     if cfg.n_layers % n_stages:
         raise ValueError(

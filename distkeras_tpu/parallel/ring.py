@@ -29,6 +29,7 @@ from distkeras_tpu.ops.attention import (
     attention_chunk,
     online_finish,
     online_init,
+    _check_window,
     _scale_for,
 )
 
@@ -47,6 +48,7 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
     beyond the lookback contribute nothing (masked, still rotated —
     the ring must complete for the other devices).
     """
+    _check_window(window, causal)
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, lq, h, d = q.shape

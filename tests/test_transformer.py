@@ -623,5 +623,9 @@ def test_attention_window_rejects_mismatched_ring(rng, devices):
     mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
     params = tfm.init_params(jax.random.key(0), CFG)
     ring8 = make_ring_attention(mesh, causal=True, window=8)
-    with pytest.raises(ValueError, match="SAME"):
+    with pytest.raises(ValueError, match="mismatch"):
         tfm.apply(params, jnp.asarray(toks(rng)), cfg, attention_fn=ring8)
+    # The unchecked direction: a windowed fn with a window-less cfg is
+    # equally a silent train/decode divergence and must be refused.
+    with pytest.raises(ValueError, match="mismatch"):
+        tfm.apply(params, jnp.asarray(toks(rng)), CFG, attention_fn=ring8)
