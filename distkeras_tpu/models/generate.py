@@ -405,10 +405,19 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
 def beam_search(params, prompt, cfg: TransformerConfig,
                 max_new_tokens: int, beam_width: int = 4,
                 eos_token: int | None = None,
-                use_prefill: bool | None = None):
+                use_prefill: bool | None = None,
+                length_penalty: float = 0.0):
     """Beam search decode: ``prompt [B, P]`` -> ``(sequences, scores)``
     with ``sequences [B, W, P+N]`` and ``scores [B, W]`` (sum of token
     log-probabilities of the generated part), best beam first.
+
+    ``length_penalty`` > 0 re-ranks the RETURNED beams by the GNMT
+    normalization ``score / ((5 + n) / 6) ** alpha`` over each beam's
+    generated length n (frozen beams stop counting at their eos), so
+    short finished hypotheses compete fairly with long ones; the search
+    itself still prunes on raw scores (the standard construction), and
+    the returned ``scores`` are the normalized values.  0 = raw
+    log-probability ordering.
 
     XLA-shaped like :func:`generate`: static beam width, one compiled
     ``lax.scan`` over positions, the KV cache tiled to ``B*W`` rows and
@@ -430,6 +439,9 @@ def beam_search(params, prompt, cfg: TransformerConfig,
         raise ValueError(
             f"beam_width must be in [1, vocab_size={cfg.vocab_size}], "
             f"got {w}")
+    if length_penalty < 0:
+        raise ValueError(
+            f"length_penalty must be >= 0, got {length_penalty}")
     total = _check_decode_budget(p, max_new_tokens, cfg, eos_token)
     prompt = jnp.asarray(prompt, jnp.int32)
     use_prefill = _resolve_prefill(params, cfg, p, use_prefill,
@@ -463,6 +475,7 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     first = first.astype(jnp.int32)
     done = ((first == eos_token) if eos_token is not None
             else jnp.zeros((b, w), bool))
+    lengths = jnp.ones((b, w), jnp.int32)  # generated tokens per beam
 
     # Tile prompt/cache per beam: row b's beams are b*W .. b*W+W-1.
     buf = jnp.zeros((b, w, total), jnp.int32)
@@ -474,7 +487,7 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     neg_inf = jnp.float32(-1e30)
 
     def body(carry, q):
-        buf, cache, scores, done = carry
+        buf, cache, scores, done, lengths = carry
         tok = jax.lax.dynamic_index_in_dim(
             buf.reshape(b * w, total), q, axis=1, keepdims=False)
         logits, cache = _decode_step(params, cache, tok, q, cfg)
@@ -495,16 +508,23 @@ def beam_search(params, prompt, cfg: TransformerConfig,
         buf = jnp.take_along_axis(buf, parent[:, :, None], axis=1)
         buf = buf.at[:, :, q + 1].set(token)
         done = jnp.take_along_axis(done, parent, axis=1)
+        lengths = jnp.take_along_axis(lengths, parent, axis=1)
+        lengths = jnp.where(done, lengths, lengths + 1)
         if eos_token is not None:
             done = done | (token == eos_token)
         flat_parent = (parent
                        + jnp.arange(b, dtype=jnp.int32)[:, None] * w
                        ).reshape(b * w)
         cache = jax.tree.map(lambda a: a[:, flat_parent], cache)
-        return (buf, cache, scores, done), None
+        return (buf, cache, scores, done, lengths), None
 
     if max_new_tokens > 1:
-        (buf, _, scores, _), _ = jax.lax.scan(
-            body, (buf, cache, scores, done),
+        (buf, _, scores, _, lengths), _ = jax.lax.scan(
+            body, (buf, cache, scores, done, lengths),
             jnp.arange(p, total - 1))
+    if length_penalty > 0:
+        norm = scores / jnp.power((5.0 + lengths) / 6.0, length_penalty)
+        order = jnp.argsort(-norm, axis=1)
+        buf = jnp.take_along_axis(buf, order[:, :, None], axis=1)
+        scores = jnp.take_along_axis(norm, order, axis=1)
     return buf, scores

@@ -538,3 +538,58 @@ def test_windowed_generate_prefill_matches_sequential(rng):
     pre = generate(params, prompt, cfg, 6, use_prefill=True)
     seq = generate(params, prompt, cfg, 6, use_prefill=False)
     np.testing.assert_array_equal(np.asarray(pre), np.asarray(seq))
+
+
+def test_beam_length_penalty(rng):
+    """alpha=0 is the raw ordering; alpha>0 re-ranks by the GNMT
+    normalization and returns the normalized scores, consistent with
+    each beam's generated length."""
+    from distkeras_tpu.models.generate import beam_search
+
+    params = tfm.init_params(jax.random.key(4), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)), jnp.int32)
+    s0, sc0 = beam_search(params, prompt, CFG, 6, beam_width=4)
+    s1, sc1 = beam_search(params, prompt, CFG, 6, beam_width=4,
+                          length_penalty=0.0)
+    np.testing.assert_array_equal(np.asarray(s0), np.asarray(s1))
+    np.testing.assert_allclose(np.asarray(sc0), np.asarray(sc1))
+
+    # No eos: every beam generates exactly 6 tokens, so the alpha>0
+    # ordering matches raw and scores divide by the same factor.
+    s2, sc2 = beam_search(params, prompt, CFG, 6, beam_width=4,
+                          length_penalty=1.0)
+    np.testing.assert_array_equal(np.asarray(s2), np.asarray(s0))
+    np.testing.assert_allclose(np.asarray(sc2),
+                               np.asarray(sc0) / ((5.0 + 6.0) / 6.0),
+                               rtol=1e-5)
+    # Scores come back sorted under the normalization too.
+    assert np.all(np.diff(np.asarray(sc2), axis=1) <= 1e-6)
+
+    with pytest.raises(ValueError, match="length_penalty"):
+        beam_search(params, prompt, CFG, 4, beam_width=2,
+                    length_penalty=-1.0)
+
+
+def test_beam_length_penalty_frozen_lengths(rng):
+    """Frozen (eos) beams stop accumulating length: with a model that
+    emits eos immediately, the best beam's normalized score uses n=1."""
+    import optax
+
+    from distkeras_tpu.models.generate import beam_search
+
+    c = 9
+    params = tfm.init_params(jax.random.key(0), CFG)
+    opt = optax.adam(1e-2)
+    step = jax.jit(tfm.make_train_step(CFG, opt))
+    carry = (params, opt.init(params))
+    data = jnp.full((16, 16), c, jnp.int32)
+    for _ in range(25):
+        carry, _ = step(carry, data)
+    trained = carry[0]
+    prompt = jnp.full((1, 3), c, jnp.int32)
+    _, raw = beam_search(trained, prompt, CFG, 8, beam_width=2,
+                         eos_token=c)
+    _, norm = beam_search(trained, prompt, CFG, 8, beam_width=2,
+                          eos_token=c, length_penalty=1.0)
+    np.testing.assert_allclose(float(norm[0, 0]),
+                               float(raw[0, 0]) / 1.0, rtol=1e-5)
