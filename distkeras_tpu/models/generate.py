@@ -148,10 +148,14 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
             # Keys cache post-rotation (each key's rotation depends only
             # on its own position), matching the training forward.
             q, k = rope_rotate(q, rope_ang), rope_rotate(k, rope_ang)
+        # Windowed configs write the ring-buffer slot pos % C (identical
+        # to pos while pos < C): with window <= C the cache then
+        # supports generation beyond max_len (rolling decode).
+        slot = pos % cfg.max_len if cfg.attention_window else pos
         ck = jax.lax.dynamic_update_index_in_dim(
-            cache["k"][i], k.astype(cache["k"].dtype), pos, axis=1)
+            cache["k"][i], k.astype(cache["k"].dtype), slot, axis=1)
         cv = jax.lax.dynamic_update_index_in_dim(
-            cache["v"][i], v.astype(cache["v"].dtype), pos, axis=1)
+            cache["v"][i], v.astype(cache["v"].dtype), slot, axis=1)
         new_cache_k.append(ck)
         new_cache_v.append(cv)
 
@@ -165,13 +169,20 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
                             ck.astype(jnp.float32))
         logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
         span = jnp.arange(cfg.max_len)
-        mask = (span <= pos)[None, None, None, :]
         if cfg.attention_window is not None:
-            # Sliding window: only the last `window` positions (self
-            # included); pos - span is pad-invariant, so this is exact
-            # for left-padded ragged rows too.
-            mask = mask & (span > pos - cfg.attention_window
-                           )[None, None, None, :]
+            # Ring-buffer band: slot s holds global position
+            # g = pos - ((pos - s) mod C).  Keep iff the position is
+            # real (g >= 0 — this also excludes every future slot while
+            # pos < C, so prefilled prompts stay causal) and inside the
+            # window (delta < W).  For pos < C this reduces exactly to
+            # span in (pos - W, pos]; for pos >= C it implements the
+            # rolling window.  Distances are pad-invariant, so the
+            # ragged pad mask below composes unchanged.
+            delta = jnp.mod(pos - span, cfg.max_len)
+            mask = ((delta < cfg.attention_window)
+                    & (pos - delta >= 0))[None, None, None, :]
+        else:
+            mask = (span <= pos)[None, None, None, :]
         if pad_lens is not None:  # left-pad slots never enter attention
             mask = mask & (span[None, :] >= pad_lens[:, None]
                            )[:, None, None, :]
@@ -242,18 +253,30 @@ def top_p_mask(logits, p: float):
 
 def _check_decode_budget(p: int, max_new_tokens: int,
                          cfg: TransformerConfig,
-                         eos_token: int | None) -> int:
+                         eos_token: int | None,
+                         rolling_ok: bool = False) -> int:
     """Shared prompt/length/eos validation for generate and beam_search;
-    returns ``total``."""
+    returns ``total``.
+
+    ``rolling_ok``: a rope + attention_window config decodes past
+    ``max_len`` on a ring-buffer cache (the window must fit the cache),
+    so the total-length cap is waived for eligible callers.
+    """
     if p < 1:
         raise ValueError(
             "prompt must contain at least one token (decoding starts from "
             "its last position; pass a BOS token for unconditional samples)")
     total = p + max_new_tokens
-    if total > cfg.max_len:
+    rolling = (rolling_ok and cfg.rope and cfg.attention_window is not None
+               and cfg.attention_window <= cfg.max_len)
+    if total > cfg.max_len and not rolling:
         raise ValueError(
             f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"max_len={cfg.max_len}")
+            f"max_len={cfg.max_len}" + (
+                "" if cfg.attention_window is None or not cfg.rope else
+                " (rolling decode past max_len needs rope=True, an "
+                "attention_window <= max_len, and a uniform-length "
+                "generate() call)"))
     if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
         raise ValueError(
             f"eos_token must be in [0, vocab_size={cfg.vocab_size}), "
@@ -265,17 +288,19 @@ def _resolve_prefill(params, cfg: TransformerConfig, p: int,
                      use_prefill: bool | None, ragged: bool) -> bool:
     """Shared prefill-eligibility rule (ONE definition: generate and
     beam_search must not drift)."""
-    can = (not ragged and not cfg.num_experts and p > 1
+    can = (not ragged and not cfg.num_experts and 1 < p <= cfg.max_len
            and not is_quantized(params))
     if use_prefill is None:
         return can
     if use_prefill and not can:
         raise ValueError(
             "use_prefill=True needs a uniform-length (no prompt_lengths) "
-            "prompt of >= 2 tokens, a dense-FFN config (prefill does not "
-            "reproduce decode-time MoE routing), and full-precision "
-            "params (the batched prefill forward wants the training "
-            "weights — quantize for decode-heavy work)")
+            "prompt of >= 2 tokens that fits the cache (p <= max_len; "
+            "longer rolling prompts teacher-force sequentially), a "
+            "dense-FFN config (prefill does not reproduce decode-time "
+            "MoE routing), and full-precision params (the batched "
+            "prefill forward wants the training weights — quantize for "
+            "decode-heavy work)")
     return use_prefill
 
 
@@ -317,7 +342,8 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     the training ``apply`` instead of the cached step.
     """
     b, p = prompt.shape
-    total = _check_decode_budget(p, max_new_tokens, cfg, eos_token)
+    total = _check_decode_budget(p, max_new_tokens, cfg, eos_token,
+                                 rolling_ok=prompt_lengths is None)
     if temperature > 0 and key is None:
         raise ValueError("temperature sampling needs an explicit PRNG key")
     if (top_k is not None or top_p is not None) and temperature <= 0:

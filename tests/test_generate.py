@@ -593,3 +593,82 @@ def test_beam_length_penalty_frozen_lengths(rng):
                           eos_token=c, length_penalty=1.0)
     np.testing.assert_allclose(float(norm[0, 0]),
                                float(raw[0, 0]) / 1.0, rtol=1e-5)
+
+
+# ----------------------------------------------------------- rolling decode
+
+def test_rolling_decode_matches_large_cache(rng):
+    """Generation past max_len on the ring-buffer cache must reproduce
+    a non-wrapping run of the same windowed model with a big cache —
+    the window makes everything beyond the last W positions irrelevant,
+    so wrap-around must be invisible."""
+    import dataclasses
+
+    base = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, rope=True,
+                                 attention_window=6, max_len=64)
+    small = dataclasses.replace(base, max_len=16)  # will wrap
+    params = tfm.init_params(jax.random.key(0), base)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 5)), jnp.int32)
+    n_new = 35  # 5 + 35 = 40 > 16: several full wraps
+    big = generate(params, prompt, base, n_new)
+    rolled = generate(params, prompt, small, n_new)
+    np.testing.assert_array_equal(np.asarray(rolled), np.asarray(big))
+
+
+def test_rolling_decode_sampling_and_eos(rng):
+    import dataclasses
+
+    base = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, rope=True,
+                                 attention_window=4, max_len=48,
+                                 n_kv_heads=1)
+    small = dataclasses.replace(base, max_len=12)
+    params = tfm.init_params(jax.random.key(1), base)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)), jnp.int32)
+    kw = dict(temperature=0.8, key=jax.random.key(7), top_k=8, eos_token=3)
+    big = generate(params, prompt, base, 25, **kw)
+    rolled = generate(params, prompt, small, 25, **kw)
+    np.testing.assert_array_equal(np.asarray(rolled), np.asarray(big))
+
+
+def test_rolling_decode_requires_rope_and_window(rng):
+    """Past-max_len decoding without the rolling prerequisites must
+    still raise, including for ragged prompts."""
+    import dataclasses
+
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)), jnp.int32)
+    with pytest.raises(ValueError, match="max_len"):
+        generate(params, prompt, CFG, 20)  # no rope, no window
+    win = dataclasses.replace(CFG, attention_window=4)  # window, no rope
+    pw = tfm.init_params(jax.random.key(0), win)
+    with pytest.raises(ValueError, match="max_len"):
+        generate(pw, prompt, win, 20)
+    roll = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=1, d_ff=64, rope=True,
+                                 attention_window=4, max_len=12)
+    pr = tfm.init_params(jax.random.key(0), roll)
+    with pytest.raises(ValueError, match="max_len"):  # ragged: no rolling
+        generate(pr, prompt, roll, 20, prompt_lengths=np.array([2, 4]))
+    out = generate(pr, prompt, roll, 20)  # eligible: runs past max_len
+    assert out.shape == (2, 24)
+
+
+def test_rolling_decode_long_prompt_sequential_fallback(rng):
+    """A prompt longer than max_len is rolling-eligible: auto path must
+    fall back to sequential teacher-forcing (prefill cannot hold it)
+    and still match the big-cache run."""
+    import dataclasses
+
+    base = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, rope=True,
+                                 attention_window=4, max_len=48)
+    small = dataclasses.replace(base, max_len=12)
+    params = tfm.init_params(jax.random.key(2), base)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 20)), jnp.int32)  # > 12
+    big = generate(params, prompt, base, 10)
+    rolled = generate(params, prompt, small, 10)
+    np.testing.assert_array_equal(np.asarray(rolled), np.asarray(big))
+    with pytest.raises(ValueError, match="fits the cache"):
+        generate(params, prompt, small, 10, use_prefill=True)
