@@ -19,9 +19,11 @@ row gather + DeviceFeed + multi-step scan with on-device normalization;
 minibatches on device from host-sent index blocks.
 
 Usage: python scripts/bench_suite.py [config ...]
-Configs: mnist_mlp cifar_cnn cifar_cnn_hostdata cifar_cnn_resident
-         higgs_mlp imdb_lstm resnet50 transformer transformer_long
-         transformer_long_noremat transformer_long_xla
+Configs: see BENCHES at the bottom of this file (python
+scripts/bench_suite.py bogus lists them) — training configs for every
+zoo model + the transformer at short/long/windowed/chunked-CE/remat
+variants, decode throughput (prefill + int8), and the end-to-end input
+pipeline pair.
 """
 
 import json
