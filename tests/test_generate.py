@@ -672,3 +672,19 @@ def test_rolling_decode_long_prompt_sequential_fallback(rng):
     np.testing.assert_array_equal(np.asarray(rolled), np.asarray(big))
     with pytest.raises(ValueError, match="fits the cache"):
         generate(params, prompt, small, 10, use_prefill=True)
+
+
+def test_beam_search_windowed_cfg(rng):
+    """Beam search composes with attention_window (the banded decode
+    mask drives every beam's cache reads); width 1 == windowed greedy."""
+    import dataclasses
+
+    cfg = dataclasses.replace(ROPE_CFG, attention_window=4)
+    params = tfm.init_params(jax.random.key(3), cfg)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)), jnp.int32)
+    from distkeras_tpu.models.generate import beam_search
+
+    greedy = generate(params, prompt, cfg, 6)
+    seqs, _ = beam_search(params, prompt, cfg, 6, beam_width=1)
+    np.testing.assert_array_equal(np.asarray(seqs[:, 0]),
+                                  np.asarray(greedy))

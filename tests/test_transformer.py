@@ -629,3 +629,21 @@ def test_attention_window_rejects_mismatched_ring(rng, devices):
     # equally a silent train/decode divergence and must be refused.
     with pytest.raises(ValueError, match="mismatch"):
         tfm.apply(params, jnp.asarray(toks(rng)), CFG, attention_fn=ring8)
+
+
+def test_attention_window_composes_with_moe(rng):
+    """Window + MoE: the band applies at attention level, routing is
+    untouched — loss finite and training moves."""
+    import dataclasses
+
+    cfg = dataclasses.replace(MOE_CFG, attention_window=4)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    opt = optax.adam(1e-2)
+    step = jax.jit(tfm.make_train_step(cfg, opt))
+    carry = (params, opt.init(params))
+    t = jnp.asarray(toks(rng, b=8, s=16))
+    first = None
+    for _ in range(15):
+        carry, loss = step(carry, t)
+        first = first if first is not None else float(loss)
+    assert np.isfinite(float(loss)) and float(loss) < first
