@@ -10,9 +10,9 @@ functional over an explicit PRNG key.
 
 Decoding strategies: greedy, temperature sampling with top-k / top-p
 (nucleus) filtering (:func:`generate`), and beam search
-(:func:`beam_search`).  Uniform dense prompts run the prefill/decode
-split (:func:`prefill`); int8-quantized trees (models/quant) decode on
-the sequential path.  Batch decoding shards over the mesh ``data``
+(:func:`beam_search`).  Uniform prompts run the prefill/decode split
+(:func:`prefill`; MoE configs use decode-parity dense routing there);
+int8-quantized trees (models/quant) decode on the sequential path.  Batch decoding shards over the mesh ``data``
 axis like every other batch op.
 """
 
@@ -61,15 +61,14 @@ def prefill(params, prompt, cfg: TransformerConfig,
     skips the final norm + unembed (``generate`` re-derives the last
     position's logits inside its scan; under jit XLA DCE would prune
     the unused head anyway, the flag keeps eager callers cheap too).
-    Dense-FFN configs only: decode-time MoE routes dense top-1
-    *without* capacity, which the batched training forward does not
-    reproduce — ``generate`` keeps the sequential prompt path for MoE.
+
+    MoE configs prefill with the same capacity-free dense top-1
+    routing as ``_decode_step`` — every expert runs on every token
+    (E x the dense-FFN compute; prefill happens once) and the selected
+    expert's output is gathered, so prefilled and sequential prompt
+    processing match exactly (the train/decode MoE divergence caveat in
+    ``generate`` is unchanged).
     """
-    if cfg.num_experts:
-        raise ValueError(
-            "prefill supports dense-FFN configs only: decode-time MoE "
-            "uses capacity-free top-1 routing that the batched training "
-            "forward does not reproduce (see generate's MoE caveat)")
     dtype = jnp.dtype(cfg.dtype)
     b, p_len = prompt.shape
     if p_len > cfg.max_len:
@@ -90,8 +89,12 @@ def prefill(params, prompt, cfg: TransformerConfig,
     ks, vs = [], []
     for i in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
+        # moe_dense_routing: MoE configs run the capacity-free
+        # decode-parity FFN (transformer._moe_dense_block) so prefilled
+        # and sequential prompts match; dense configs are unaffected.
         x, _, (k, v) = block_apply(lp, x, cfg, attention_fn, rope_ang,
-                                   return_kv=True)
+                                   return_kv=True,
+                                   moe_dense_routing=True)
         ks.append(k.astype(cache["k"].dtype))
         vs.append(v.astype(cache["v"].dtype))
 
@@ -288,7 +291,7 @@ def _resolve_prefill(params, cfg: TransformerConfig, p: int,
                      use_prefill: bool | None, ragged: bool) -> bool:
     """Shared prefill-eligibility rule (ONE definition: generate and
     beam_search must not drift)."""
-    can = (not ragged and not cfg.num_experts and 1 < p <= cfg.max_len
+    can = (not ragged and 1 < p <= cfg.max_len
            and not is_quantized(params))
     if use_prefill is None:
         return can
@@ -296,11 +299,9 @@ def _resolve_prefill(params, cfg: TransformerConfig, p: int,
         raise ValueError(
             "use_prefill=True needs a uniform-length (no prompt_lengths) "
             "prompt of >= 2 tokens that fits the cache (p <= max_len; "
-            "longer rolling prompts teacher-force sequentially), a "
-            "dense-FFN config (prefill does not reproduce decode-time "
-            "MoE routing), and full-precision params (the batched "
-            "prefill forward wants the training weights — quantize for "
-            "decode-heavy work)")
+            "longer rolling prompts teacher-force sequentially) and "
+            "full-precision params (the batched prefill forward wants "
+            "the training weights — quantize for decode-heavy work)")
     return use_prefill
 
 
@@ -311,12 +312,13 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
              use_prefill: bool | None = None):
     """Decode ``max_new_tokens`` past ``prompt [B, P]``; returns [B, P+N].
 
-    Prefill/decode split: uniform-length dense-FFN prompts run through
+    Prefill/decode split: uniform-length prompts run through
     :func:`prefill` (one batched flash-attention forward fills the
-    whole cache) and the scan covers only generation positions; ragged
-    or MoE prompts fall back to teacher-forcing every prompt position
-    through the cached step.  ``use_prefill`` overrides the automatic
-    choice (True raises if the config cannot prefill).
+    whole cache — MoE configs use decode-parity dense routing) and the
+    scan covers only generation positions; ragged prompts fall back to
+    teacher-forcing every prompt position through the cached step.
+    ``use_prefill`` overrides the automatic choice (True raises if the
+    config cannot prefill).
     temperature == 0 is greedy argmax; with temperature
     > 0, ``top_k`` and/or ``top_p`` (nucleus) restrict the sampling
     support — both applied to the temperature-scaled logits, top-k

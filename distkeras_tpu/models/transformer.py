@@ -371,13 +371,40 @@ def _moe_block(lp, x, cfg: TransformerConfig):
     return out.astype(x.dtype).reshape(b, s, d), aux
 
 
+def _moe_dense_block(lp, x, cfg: TransformerConfig):
+    """Capacity-FREE top-1 MoE over [B, S, D] — the batched twin of
+    _decode_step's per-token branch (models/generate.py): every expert
+    runs on every token (E x compute) and the router's pick is
+    gathered.  Used by generate.prefill so prefilled and sequential
+    prompt processing match exactly; training keeps :func:`_moe_block`
+    (capacity dispatch).  Unselected experts are zero-masked BEFORE the
+    combine so a non-finite value in an unpicked expert cannot poison
+    the token (0 * inf is NaN; where() is not).
+    """
+    dtype = x.dtype
+    router = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), lp["wg"])
+    gate = jax.nn.softmax(router, axis=-1)
+    sel = (jax.nn.one_hot(gate.argmax(-1), cfg.num_experts,
+                          dtype=jnp.float32)
+           * gate.max(-1, keepdims=True))
+    h1 = jax.nn.gelu(jnp.einsum("bsd,edf->bsef", x,
+                                lp["w1"].astype(dtype)))
+    y_all = jnp.einsum("bsef,efd->bsed", h1, lp["w2"].astype(dtype))
+    y_all = jnp.where(sel[..., None] > 0, y_all, 0.0)
+    return jnp.einsum("bsed,bse->bsd", y_all, sel.astype(y_all.dtype)
+                      ).astype(dtype)
+
+
 def block_apply(layer_params, x, cfg: TransformerConfig,
                 attention_fn: Callable, rope_ang=None, drop_key=None,
-                return_kv=False):
+                return_kv=False, moe_dense_routing=False):
     """One transformer block (pre-norm).  Returns (x, aux_loss), or
     (x, aux_loss, (k, v)) with ``return_kv`` (post-rope, kv-heads-only —
     the decode-cache layout; generate.prefill consumes it so there is
     exactly ONE definition of the block body to keep in sync).
+    ``moe_dense_routing`` swaps the MoE FFN for the capacity-free
+    decode-parity :func:`_moe_dense_block` (prefill's inference
+    semantics); aux comes back 0 on that path.
 
     ``rope_ang`` and ``drop_key`` are *traced array* arguments (not
     closures) so the remat wrapper's static_argnums stay (2, 3) — a
@@ -395,7 +422,10 @@ def block_apply(layer_params, x, cfg: TransformerConfig,
         a = _dropout(a, cfg.dropout, jax.random.fold_in(drop_key, 0))
     x = x + a
     h = _rms_norm(x, layer_params["ln2_scale"])
-    if cfg.num_experts:
+    if cfg.num_experts and moe_dense_routing:
+        y = _moe_dense_block(layer_params["moe"], h, cfg)
+        aux = jnp.zeros((), jnp.float32)
+    elif cfg.num_experts:
         y, aux = _moe_block(layer_params["moe"], h, cfg)
     else:
         y = jnp.einsum(

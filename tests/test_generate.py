@@ -284,22 +284,24 @@ def test_prefill_eos_matches_sequential(rng):
 
 
 def test_prefill_rejections(rng):
-    from distkeras_tpu.models.generate import prefill
-
-    params = tfm.init_params(jax.random.key(0), MOE_CFG)
     prompt = jnp.asarray(rng.integers(0, 64, (2, 5)), jnp.int32)
-    with pytest.raises(ValueError, match="dense-FFN"):
-        prefill(params, prompt, MOE_CFG)
-    with pytest.raises(ValueError, match="use_prefill"):
-        generate(params, prompt, MOE_CFG, 4, use_prefill=True)
     # Ragged prompts keep the sequential path.
     params_d = tfm.init_params(jax.random.key(0), CFG)
     with pytest.raises(ValueError, match="use_prefill"):
         generate(params_d, prompt, CFG, 4, use_prefill=True,
                  prompt_lengths=np.array([3, 5]))
-    # MoE + auto gate: silently sequential, still works.
-    out = generate(params, prompt, MOE_CFG, 4)
-    assert out.shape == (2, 9)
+
+
+def test_prefill_moe_matches_sequential(rng):
+    """MoE prompts prefill with decode-parity dense routing: outputs
+    equal the all-sequential path exactly (same per-token math)."""
+    params = tfm.init_params(jax.random.key(1), MOE_CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (3, 7)), jnp.int32)
+    seq = generate(params, prompt, MOE_CFG, 6, use_prefill=False)
+    pre = generate(params, prompt, MOE_CFG, 6, use_prefill=True)
+    np.testing.assert_array_equal(np.asarray(pre), np.asarray(seq))
+    auto = generate(params, prompt, MOE_CFG, 6)  # auto now prefills
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(seq))
 
 
 def test_prefill_rejects_overlong_prompt(rng):
