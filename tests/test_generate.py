@@ -690,3 +690,21 @@ def test_beam_search_windowed_cfg(rng):
     seqs, _ = beam_search(params, prompt, cfg, 6, beam_width=1)
     np.testing.assert_array_equal(np.asarray(seqs[:, 0]),
                                   np.asarray(greedy))
+
+
+def test_rolling_decode_quantized(rng):
+    """int8 weights x rolling window cache: sequential decode past
+    max_len with a quantized tree matches the quantized big-cache run."""
+    import dataclasses
+
+    from distkeras_tpu.models.quant import quantize_params
+
+    base = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, rope=True,
+                                 attention_window=4, max_len=40)
+    small = dataclasses.replace(base, max_len=10)
+    qp = quantize_params(tfm.init_params(jax.random.key(5), base))
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)), jnp.int32)
+    big = generate(qp, prompt, base, 20)
+    rolled = generate(qp, prompt, small, 20)
+    np.testing.assert_array_equal(np.asarray(rolled), np.asarray(big))
