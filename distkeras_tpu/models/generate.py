@@ -9,7 +9,7 @@ compiled program regardless of prompt/output length), and sampling is
 functional over an explicit PRNG key.
 
 Decoding strategies: greedy, temperature sampling with top-k / top-p
-(nucleus) filtering (:func:`generate`), and beam search
+(nucleus) / min-p filtering (:func:`generate`), and beam search
 (:func:`beam_search`).  Uniform prompts run the prefill/decode split
 (:func:`prefill`; MoE configs use decode-parity dense routing there);
 int8-quantized trees (models/quant) decode on the sequential path.  Batch decoding shards over the mesh ``data``
@@ -237,6 +237,23 @@ def top_k_mask(logits, k: int):
     return jnp.where(logits < kth, -jnp.inf, logits)
 
 
+def min_p_mask(logits, min_p: float):
+    """Keep tokens whose probability is at least ``min_p`` times the
+    top token's probability; the rest go to -inf.
+
+    The entropy-adaptive filter (min-p sampling): permissive when the
+    model is uncertain (flat distribution -> many tokens clear the
+    relative bar), strict when confident.  Static shapes; the top token
+    always survives (ratio 1 >= min_p).
+    """
+    if not 0.0 < min_p <= 1.0:
+        raise ValueError(f"min_p must be in (0, 1], got {min_p}")
+    # log p_i - log p_max >= log(min_p), computed on logits directly
+    # (the softmax normalizer cancels in the difference).
+    gap = logits - logits.max(axis=-1, keepdims=True)
+    return jnp.where(gap >= jnp.log(min_p), logits, -jnp.inf)
+
+
 def top_p_mask(logits, p: float):
     """Nucleus filtering: keep the smallest set of tokens whose
     probability mass reaches ``p``; the rest go to -inf.
@@ -308,6 +325,7 @@ def _resolve_prefill(params, cfg: TransformerConfig, p: int,
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
              temperature: float = 0.0, key=None,
              top_k: int | None = None, top_p: float | None = None,
+             min_p: float | None = None,
              prompt_lengths=None, eos_token: int | None = None,
              use_prefill: bool | None = None):
     """Decode ``max_new_tokens`` past ``prompt [B, P]``; returns [B, P+N].
@@ -320,9 +338,10 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     ``use_prefill`` overrides the automatic choice (True raises if the
     config cannot prefill).
     temperature == 0 is greedy argmax; with temperature
-    > 0, ``top_k`` and/or ``top_p`` (nucleus) restrict the sampling
-    support — both applied to the temperature-scaled logits, top-k
-    first, the standard composition.
+    > 0, ``top_k``, ``top_p`` (nucleus) and/or ``min_p`` restrict the
+    sampling support — all applied to the temperature-scaled logits in
+    that order (top-k, then nucleus, then the min-p relative-
+    probability floor), the standard composition.
 
     ``eos_token`` makes completion sticky: once a row emits it, every
     later generated slot in that row is ``eos_token`` (static shapes —
@@ -348,16 +367,19 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
                                  rolling_ok=prompt_lengths is None)
     if temperature > 0 and key is None:
         raise ValueError("temperature sampling needs an explicit PRNG key")
-    if (top_k is not None or top_p is not None) and temperature <= 0:
+    if ((top_k is not None or top_p is not None or min_p is not None)
+            and temperature <= 0):
         raise ValueError(
-            "top_k/top_p filter a sampling distribution; they need "
-            "temperature > 0 (greedy decoding always takes the single "
-            "best token, so filtering would be a no-op)")
+            "top_k/top_p/min_p filter a sampling distribution; they "
+            "need temperature > 0 (greedy decoding always takes the "
+            "single best token, so filtering would be a no-op)")
     if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
         raise ValueError(
             f"top_k must be in [1, vocab_size={cfg.vocab_size}], got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if min_p is not None and not 0.0 < min_p <= 1.0:
+        raise ValueError(f"min_p must be in (0, 1], got {min_p}")
     key = key if key is not None else jax.random.key(0)
 
     pad_lens = None
@@ -406,6 +428,8 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
                 scaled = top_k_mask(scaled, top_k)
             if top_p is not None:
                 scaled = top_p_mask(scaled, top_p)
+            if min_p is not None:
+                scaled = min_p_mask(scaled, min_p)
             nxt = jax.random.categorical(sub, scaled, axis=-1)
         else:
             nxt = logits.argmax(axis=-1)

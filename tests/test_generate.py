@@ -708,3 +708,32 @@ def test_rolling_decode_quantized(rng):
     big = generate(qp, prompt, base, 20)
     rolled = generate(qp, prompt, small, 20)
     np.testing.assert_array_equal(np.asarray(rolled), np.asarray(big))
+
+
+def test_min_p_mask_semantics():
+    from distkeras_tpu.models.generate import min_p_mask
+
+    logits = jnp.asarray([[0.0, -1.0, -10.0]])
+    out = np.asarray(min_p_mask(logits, 0.5))
+    # p1/pmax = e^-1 ~ 0.37 < 0.5 -> dropped; p2/pmax tiny -> dropped.
+    assert np.isfinite(out[0, 0])
+    assert np.isneginf(out[0, 1]) and np.isneginf(out[0, 2])
+    out2 = np.asarray(min_p_mask(logits, 0.3))
+    assert np.isfinite(out2[0, 1])  # 0.37 >= 0.3 survives
+    with pytest.raises(ValueError, match="min_p"):
+        min_p_mask(logits, 0.0)
+
+
+def test_generate_min_p_sampling(rng):
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 5)), jnp.int32)
+    out = generate(params, prompt, CFG, 6, temperature=0.9, min_p=0.1,
+                   key=jax.random.key(1))
+    assert out.shape == (2, 11)
+    # min_p=1.0 keeps only the argmax -> equals greedy.
+    strict = generate(params, prompt, CFG, 6, temperature=0.9, min_p=1.0,
+                      key=jax.random.key(1))
+    greedy = generate(params, prompt, CFG, 6)
+    np.testing.assert_array_equal(np.asarray(strict), np.asarray(greedy))
+    with pytest.raises(ValueError, match="temperature"):
+        generate(params, prompt, CFG, 6, min_p=0.1)
