@@ -647,3 +647,19 @@ def test_attention_window_composes_with_moe(rng):
         carry, loss = step(carry, t)
         first = first if first is not None else float(loss)
     assert np.isfinite(float(loss)) and float(loss) < first
+
+
+def test_attention_window_pipelined_ring_matches_single(devices, rng):
+    """PP x SP x window: the pipeline's per-stage ring body carries the
+    band (global positions per hop) — must reproduce the un-pipelined
+    windowed forward exactly."""
+    import dataclasses
+
+    cfg = dataclasses.replace(ROPE_CFG, attention_window=5)
+    mesh = make_mesh(MeshSpec(data=2, pipeline=2, seq=2), devices=devices)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    t = jnp.asarray(toks(rng, b=4, s=16))
+    ref, _ = tfm.apply(params, t, cfg)
+    out, _ = jax.jit(lambda p, tk: tfm.apply_pipelined(
+        p, tk, cfg, mesh, microbatches=2, seq_axis="seq"))(params, t)
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
