@@ -140,7 +140,13 @@ class DenseTransformer(Transformer):
 
     Reference parity: distkeras/transformers.py::DenseTransformer
     (Spark sparse vectors -> dense).  Input is a pair of object-arrays of
-    per-row index/value arrays, or an already-dense column (passthrough).
+    per-row index/value arrays (scalars accepted as length-1 rows), or an
+    already-dense column (passthrough).
+
+    Behavior note vs the per-row-loop implementation: negative sparse
+    indices raise ``ValueError`` here instead of silently wrapping to the
+    end of the row — wrapping was never meaningful for Spark sparse
+    vectors, whose indices are non-negative by contract.
     """
 
     def __init__(self, input_col: str = "features",
@@ -164,7 +170,10 @@ class DenseTransformer(Transformer):
                 # ragged per-row index/value arrays concatenate to flat
                 # (row, col, val) triples and assign in a single fancy
                 # index (duplicate (row, col) keeps last-wins semantics,
-                # same as the row-at-a-time assignment).
+                # same as the row-at-a-time assignment).  atleast_1d
+                # accepts scalar rows (a single index/value per row).
+                idx = [np.atleast_1d(ii) for ii in idx]
+                val = [np.atleast_1d(vv) for vv in val]
                 lengths = np.fromiter((len(ii) for ii in idx),
                                       dtype=np.int64, count=len(dataset))
                 vlengths = np.fromiter((len(vv) for vv in val),

@@ -24,13 +24,19 @@ import numpy as np
 
 from distkeras_tpu.models.transformer import (
     TransformerConfig,
+    _moe_gates,
     _rms_norm,
     _unembed,
     block_apply,
     rope_angles,
     rope_rotate,
 )
-from distkeras_tpu.models.quant import deq, embed_rows, is_quantized
+from distkeras_tpu.models.quant import (
+    deq,
+    embed_rows,
+    is_quantized,
+    unembed_logits,
+)
 from distkeras_tpu.ops.attention import flash_attention
 
 
@@ -62,10 +68,11 @@ def prefill(params, prompt, cfg: TransformerConfig,
     position's logits inside its scan; under jit XLA DCE would prune
     the unused head anyway, the flag keeps eager callers cheap too).
 
-    MoE configs prefill with the same capacity-free dense top-1
-    routing as ``_decode_step`` — every expert runs on every token
-    (E x the dense-FFN compute; prefill happens once) and the selected
-    expert's output is gathered, so prefilled and sequential prompt
+    MoE configs prefill with the same capacity-free dense top-k
+    routing as ``_decode_step`` (``_moe_gates`` — Switch top-1 or
+    renormalized top-2) — every expert runs on every token (E x the
+    dense-FFN compute; prefill happens once) and the selected experts'
+    outputs are gathered, so prefilled and sequential prompt
     processing match exactly (the train/decode MoE divergence caveat in
     ``generate`` is unchanged).
     """
@@ -199,18 +206,19 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
 
         h = _rms_norm(x, lp["ln2_scale"])
         if cfg.num_experts:
-            # Decode-time MoE: dense top-1 without capacity (batch is
-            # small; correctness over dispatch efficiency).
+            # Decode-time MoE: dense top-k without capacity (batch is
+            # small; correctness over dispatch efficiency).  Same
+            # gate rule as training/prefill via _moe_gates.
             router = jnp.einsum("bd,de->be", h.astype(jnp.float32),
                                 lp["moe"]["wg"])
-            gate = jax.nn.softmax(router, axis=-1)
-            expert = gate.argmax(axis=-1)
-            w1 = lp["moe"]["w1"][expert]  # [B, D, F]
-            w2 = lp["moe"]["w2"][expert]  # [B, F, D]
-            y = jnp.einsum(
-                "bf,bfd->bd",
-                jax.nn.gelu(jnp.einsum("bd,bdf->bf", h, w1.astype(dtype))),
-                w2.astype(dtype)) * gate.max(-1, keepdims=True).astype(dtype)
+            probs = jax.nn.softmax(router, axis=-1)
+            gates, expert = _moe_gates(probs, cfg)   # [B, k]
+            w1 = lp["moe"]["w1"][expert]  # [B, k, D, F]
+            w2 = lp["moe"]["w2"][expert]  # [B, k, F, D]
+            hk = jax.nn.gelu(jnp.einsum("bd,bkdf->bkf", h,
+                                        w1.astype(dtype)))
+            yk = jnp.einsum("bkf,bkfd->bkd", hk, w2.astype(dtype))
+            y = jnp.einsum("bkd,bk->bd", yk, gates.astype(dtype))
         else:
             y = jnp.einsum(
                 "bf,fd->bd",
@@ -220,7 +228,10 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
         x = x + y
 
     x = _rms_norm(x, params["ln_f_scale"])
-    out = jnp.einsum("bd,vd->bv", x, deq(params["tok_emb"], dtype))
+    # Vocab head: int8 trees contract the raw q table and scale the
+    # result (int8 stays the HBM operand by construction — see
+    # quant.unembed_logits), instead of dequantizing [V, d] per step.
+    out = unembed_logits(x, params["tok_emb"], dtype)
     cache = {"k": jnp.stack(new_cache_k), "v": jnp.stack(new_cache_v)}
     return out.astype(jnp.float32), cache
 
@@ -343,6 +354,14 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     that order (top-k, then nucleus, then the min-p relative-
     probability floor), the standard composition.
 
+    PRNG stream contract (changed in round 2): the key for position
+    ``pos`` is ``jax.random.fold_in(key, pos)`` — a pure function of
+    (key, position) — NOT the earlier sequential ``jax.random.split``
+    chain.  This makes the prefill and all-sequential paths sample
+    identically (the prefill scan skips prompt positions), at the cost
+    that a given ``key`` emits different tokens than the pre-fold_in
+    release; seed-pinned downstream tests should re-pin.
+
     ``eos_token`` makes completion sticky: once a row emits it, every
     later generated slot in that row is ``eos_token`` (static shapes —
     the scan always runs ``max_new_tokens`` positions; trim on the
@@ -354,13 +373,15 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     row i carries its L_i prompt tokens, then its N generated tokens,
     then the original padding.
 
-    MoE caveat: decode-time routing is dense top-1 *without* expert
-    capacity (see ``step_fn``), so logits diverge from the training
-    forward (``transformer.apply``) for any token the training router
-    would capacity-drop.  Exact train/infer parity holds only when
-    ``capacity_factor`` is large enough that nothing is dropped; if
-    parity matters at realistic capacity factors, evaluate logits with
-    the training ``apply`` instead of the cached step.
+    MoE caveat: decode-time routing is dense top-k *without* expert
+    capacity, so logits diverge from the TRAINING forward
+    (``transformer.apply`` default routing) for any token the training
+    router would capacity-drop.  The matching batched semantics is
+    ``apply(..., moe_dense_routing=True)`` / ``lm_nll(...,
+    moe_dense_routing=True)`` — exact decode parity at any capacity
+    factor (tested at 1.25); the measured capacity-vs-dense NLL gap on
+    a trained model is bounded in
+    tests/test_generate.py::test_moe_capacity_vs_dense_divergence_bounded.
     """
     b, p = prompt.shape
     total = _check_decode_budget(p, max_new_tokens, cfg, eos_token,

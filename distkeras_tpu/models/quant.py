@@ -129,6 +129,26 @@ def deq(w, dtype=None):
     return w if dtype is None else jnp.asarray(w).astype(dtype)
 
 
+def unembed_logits(x, tok_emb, dtype):
+    """Unembedding head ``x [B, d] @ tok_emb^T [V, d] -> [B, V]``.
+
+    Quantized path: contract against the raw int8 table and apply the
+    per-vocab-row scale to the [B, V] *result* — algebraically identical
+    (the scale is constant over the contracted ``d`` axis) but the [V, d]
+    HBM operand is int8 **by construction**: the only op between the
+    table and the MXU is a dtype convert, which XLA always fuses into
+    the operand read.  The alternative (dequantize then einsum) leaves a
+    full-precision [V, d] temporary unless XLA happens to fuse the
+    multiply — for the usually-dominant vocab head we don't want to
+    depend on that.  int8 values are exact in bf16 (|q| <= 127 < 2^8),
+    so converting q to the compute dtype loses nothing.
+    """
+    if isinstance(tok_emb, QTensor):
+        out = jnp.einsum("bd,vd->bv", x, tok_emb.q.astype(x.dtype))
+        return out.astype(jnp.float32) * tok_emb.s[:, 0][None, :]
+    return jnp.einsum("bd,vd->bv", x, jnp.asarray(tok_emb).astype(dtype))
+
+
 def embed_rows(tok_emb, tokens, dtype):
     """Embedding lookup that gathers int8 rows THEN dequantizes (the
     gather touches B rows, not the whole [V, d] table)."""

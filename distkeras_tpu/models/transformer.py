@@ -26,6 +26,7 @@ NamedShardings.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Callable
 
@@ -45,8 +46,15 @@ class TransformerConfig:
     d_ff: int = 512
     max_len: int = 128
     # MoE: 0 experts = dense FFN.  With E > 0 every layer's FFN is a
-    # Switch top-1 MoE with `capacity_factor` slack per expert.
+    # top-k MoE with `capacity_factor` slack per expert: moe_top_k=1 is
+    # Switch routing (combine weight = the raw top-1 probability),
+    # moe_top_k=2 is GShard/Mixtral-style top-2 (combine weights =
+    # top-k probabilities renormalized over the selected experts;
+    # first choices take capacity priority over second choices).
+    # Expert capacity scales with k: cap = capacity_factor * k * N / E
+    # (capacity_factor stays "slack per assignment" at any k).
     num_experts: int = 0
+    moe_top_k: int = 1
     capacity_factor: float = 1.25
     aux_loss_coef: float = 0.01
     dtype: str = "float32"  # activation/compute dtype (bfloat16 on TPU)
@@ -151,18 +159,25 @@ def _validate_remat_policy(cfg: "TransformerConfig",
             "remat=True (or drop the policy)")
 
 
-def _remat_block(cfg: "TransformerConfig"):
-    """``block_apply`` wrapped per cfg.remat / cfg.remat_policy."""
+def _remat_block(cfg: "TransformerConfig", moe_dense_routing: bool = False):
+    """``block_apply`` wrapped per cfg.remat / cfg.remat_policy.
+
+    ``moe_dense_routing`` is bound OUTSIDE the checkpoint wrapper (a
+    plain-Python partial, not a traced argument): a bool passed through
+    ``jax.checkpoint`` would become a tracer and break the block's
+    Python-level routing branch.
+    """
     # Unknown names are rejected even with remat=False (typos must not
     # pass silently); only the remat-required pairing check is relaxed
     # (an inert leftover policy is fine at eval time).
     _validate_remat_policy(cfg, require_remat=False)
+    fn = (functools.partial(block_apply, moe_dense_routing=True)
+          if moe_dense_routing else block_apply)
     if not cfg.remat:
-        return block_apply
+        return fn
     name = _REMAT_POLICIES[cfg.remat_policy]
     policy = getattr(jax.checkpoint_policies, name) if name else None
-    return jax.checkpoint(block_apply, static_argnums=(2, 3),
-                          policy=policy)
+    return jax.checkpoint(fn, static_argnums=(2, 3), policy=policy)
 
 
 def _dense_init(rng, shape, fan_in):
@@ -184,6 +199,10 @@ def init_params(rng, cfg: TransformerConfig):
     if cfg.attention_window is not None and cfg.attention_window < 1:
         raise ValueError(
             f"attention_window must be >= 1, got {cfg.attention_window}")
+    if cfg.num_experts and not 1 <= cfg.moe_top_k <= cfg.num_experts:
+        raise ValueError(
+            f"moe_top_k={cfg.moe_top_k} must be in [1, num_experts="
+            f"{cfg.num_experts}]")
     _validate_remat_policy(cfg)
     keys = jax.random.split(rng, 12)
     d, f, h, hd = cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.head_dim
@@ -333,48 +352,78 @@ def _attention_block(lp, x, attention_fn, rope_ang=None, kv_groups=1,
     return (out, kv) if return_kv else out
 
 
+def _moe_gates(probs, cfg: TransformerConfig):
+    """Top-k expert choice shared by every routing path.
+
+    Returns ``(gates [..., k], expert [..., k])``: k=1 keeps the raw
+    top-1 probability as the combine weight (Switch semantics — the
+    router gradient flows through the gate magnitude); k>1 renormalizes
+    the top-k probabilities over the selected experts (GShard/Mixtral).
+    ONE definition so capacity, dense, and decode routing cannot drift.
+    """
+    gates, expert = jax.lax.top_k(probs, cfg.moe_top_k)
+    if cfg.moe_top_k > 1:
+        gates = gates / gates.sum(axis=-1, keepdims=True)
+    return gates, expert
+
+
 def _moe_block(lp, x, cfg: TransformerConfig):
-    """Switch top-1 MoE with capacity dropping.
+    """Top-k MoE with capacity dropping (Switch at k=1, GShard at k=2).
 
     Tokens flatten to [N, D]; the dispatch/combine einsums carry the
     expert axis, which the EP sharding rules place on the mesh
-    ``expert`` axis — XLA emits the all-to-alls.  Dropped tokens pass
-    through with 0 (the residual connection keeps their stream).
-    Returns (out, aux_loss).
+    ``expert`` axis — XLA emits the all-to-alls.  Dropped assignments
+    contribute 0 (the residual connection keeps the token's stream;
+    with k>1 a token's other choice may still land).  First choices
+    take capacity priority over second choices (choice-major cumsum) —
+    GShard's sequential assignment.  Returns (out, aux_loss).
     """
     b, s, d = x.shape
     n = b * s
     e = cfg.num_experts
-    cap = max(1, int(cfg.capacity_factor * n / e))
+    k_sel = cfg.moe_top_k
+    # Capacity per expert scales with k so capacity_factor keeps
+    # meaning "slack per assignment" (t5x convention).
+    cap = max(1, int(cfg.capacity_factor * k_sel * n / e))
     flat = x.reshape(n, d)
 
     router = jnp.einsum("nd,de->ne", flat.astype(jnp.float32), lp["wg"])
     probs = jax.nn.softmax(router, axis=-1)
-    gate = probs.max(axis=-1)
-    expert = probs.argmax(axis=-1)
-    one_hot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+    gates, expert = _moe_gates(probs, cfg)          # [N, k] each
+    one_hot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [N, k, E]
 
-    # Load-balancing aux loss (Switch Transformer eq. 4).
-    density = one_hot.mean(axis=0)
+    # Load-balancing aux loss (Switch Transformer eq. 4) on FIRST
+    # choices — reduces exactly to Switch at k=1, and first-choice
+    # density is the balance that matters at any k.
+    density = one_hot[:, 0].mean(axis=0)
     density_proxy = probs.mean(axis=0)
     aux = jnp.sum(density * density_proxy) * e * cfg.aux_loss_coef
 
-    pos = jnp.cumsum(one_hot, axis=0) * one_hot  # 1-based slot, [N, E]
-    keep = (pos <= cap).astype(jnp.float32) * one_hot
+    # Choice-major flattening: all first choices claim slots before any
+    # second choice competes.
+    oh_cm = one_hot.transpose(1, 0, 2).reshape(k_sel * n, e)
+    pos = jnp.cumsum(oh_cm, axis=0) * oh_cm  # 1-based slot, [kN, E]
+    keep = (pos <= cap).astype(jnp.float32) * oh_cm
     slot_oh = jax.nn.one_hot((pos - 1.0).astype(jnp.int32), cap,
-                             dtype=jnp.float32) * keep[..., None]  # [N,E,C]
+                             dtype=jnp.float32) * keep[..., None]  # [kN,E,C]
+    slot_k = slot_oh.reshape(k_sel, n, e, cap)
 
-    xe = jnp.einsum("nec,nd->ecd", slot_oh, flat.astype(jnp.float32))
+    # Dispatch sums over choices: a token picked by both its choices
+    # (different experts — top_k indices are distinct) lands in both.
+    xe = jnp.einsum("knec,nd->ecd", slot_k, flat.astype(jnp.float32))
     h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, lp["w1"]))
     ye = jnp.einsum("ecf,efd->ecd", h, lp["w2"])
-    out = jnp.einsum("ecd,nec->nd", ye, slot_oh) * (gate * keep.sum(-1))[:, None]
+    # Combine weights ride the slot one-hots: choice k of token n
+    # contributes gates[n, k] iff its assignment survived capacity.
+    comb = slot_k * gates.T.reshape(k_sel, n)[:, :, None, None]
+    out = jnp.einsum("ecd,knec->nd", ye, comb)
     return out.astype(x.dtype).reshape(b, s, d), aux
 
 
 def _moe_dense_block(lp, x, cfg: TransformerConfig):
-    """Capacity-FREE top-1 MoE over [B, S, D] — the batched twin of
+    """Capacity-FREE top-k MoE over [B, S, D] — the batched twin of
     _decode_step's per-token branch (models/generate.py): every expert
-    runs on every token (E x compute) and the router's pick is
+    runs on every token (E x compute) and the router's picks are
     gathered.  Used by generate.prefill so prefilled and sequential
     prompt processing match exactly; training keeps :func:`_moe_block`
     (capacity dispatch).  Unselected experts are zero-masked BEFORE the
@@ -383,10 +432,13 @@ def _moe_dense_block(lp, x, cfg: TransformerConfig):
     """
     dtype = x.dtype
     router = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), lp["wg"])
-    gate = jax.nn.softmax(router, axis=-1)
-    sel = (jax.nn.one_hot(gate.argmax(-1), cfg.num_experts,
-                          dtype=jnp.float32)
-           * gate.max(-1, keepdims=True))
+    probs = jax.nn.softmax(router, axis=-1)
+    gates, expert = _moe_gates(probs, cfg)               # [B, S, k]
+    # Per-expert combined weight: top_k indices are distinct, so this
+    # sums each selected expert's gate into its slot.
+    sel = jnp.einsum("bske,bsk->bse",
+                     jax.nn.one_hot(expert, cfg.num_experts,
+                                    dtype=jnp.float32), gates)
     h1 = jax.nn.gelu(jnp.einsum("bsd,edf->bsef", x,
                                 lp["w1"].astype(dtype)))
     y_all = jnp.einsum("bsef,efd->bsed", h1, lp["w2"].astype(dtype))
@@ -440,12 +492,21 @@ def block_apply(layer_params, x, cfg: TransformerConfig,
 
 
 def apply_hidden(params, tokens, cfg: TransformerConfig,
-                 attention_fn: Callable | None = None, dropout_rng=None):
+                 attention_fn: Callable | None = None, dropout_rng=None,
+                 moe_dense_routing: bool = False):
     """Trunk forward: tokens [B, S] int32 -> final-norm hidden [B, S, D].
 
     Everything in :func:`apply` except the unembedding matmul; the
     chunked cross-entropy path consumes the hidden states directly so
     the full-vocab logits never materialize.  Returns (hidden, aux).
+
+    ``moe_dense_routing=True`` scores MoE configs with the capacity-FREE
+    dense routing that :func:`~distkeras_tpu.models.generate.generate`
+    and ``prefill`` use — the *inference semantics* (aux comes back 0).
+    Evaluating a trained MoE this way agrees exactly with the KV-cached
+    decode at ANY capacity factor; the default (training capacity
+    dispatch) diverges for every token the router would capacity-drop.
+    No-op for dense configs.
     """
     attention_fn = _resolve_attention_fn(cfg, attention_fn)
     dtype = jnp.dtype(cfg.dtype)
@@ -466,7 +527,7 @@ def apply_hidden(params, tokens, cfg: TransformerConfig,
 
     aux_total = jnp.zeros((), jnp.float32)
 
-    block = _remat_block(cfg)
+    block = _remat_block(cfg, moe_dense_routing=moe_dense_routing)
 
     # Python loop (not scan): attention_fn may close over shard_map /
     # pallas calls whose tracing under scan complicates sharding; layer
@@ -495,17 +556,20 @@ def _unembed(hidden, params, cfg: TransformerConfig):
 
 
 def apply(params, tokens, cfg: TransformerConfig,
-          attention_fn: Callable | None = None, dropout_rng=None):
+          attention_fn: Callable | None = None, dropout_rng=None,
+          moe_dense_routing: bool = False):
     """Forward pass: tokens [B, S] int32 -> logits [B, S, V].
 
     ``attention_fn(q, k, v) -> out`` defaults to causal flash attention
     (Pallas on TPU); pass a ``make_ring_attention(...)`` wrapper for
     sequence parallelism.  ``dropout_rng`` non-None (with cfg.dropout
     > 0) enables training dropout; omit it for deterministic
-    inference/eval.  Returns (logits, aux_loss).
+    inference/eval.  ``moe_dense_routing=True`` selects the decode-
+    parity capacity-free MoE routing (see :func:`apply_hidden`).
+    Returns (logits, aux_loss).
     """
     x, aux_total = apply_hidden(params, tokens, cfg, attention_fn,
-                                dropout_rng)
+                                dropout_rng, moe_dense_routing)
     return _unembed(x, params, cfg), aux_total
 
 
@@ -652,7 +716,8 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
 def _forward_nll(params, tokens, cfg: TransformerConfig,
                  attention_fn: Callable | None,
                  apply_fn: Callable | None, dropout_rng=None,
-                 hidden_fn: Callable | None = None):
+                 hidden_fn: Callable | None = None,
+                 moe_dense_routing: bool = False):
     """(mean next-token NLL, aux) — shared by train loss and eval.
 
     Three forward routes:
@@ -686,7 +751,8 @@ def _forward_nll(params, tokens, cfg: TransformerConfig,
         return full_head(logits, aux)
     if hidden_fn is None:
         hidden_fn = lambda p, t: apply_hidden(p, t, cfg, attention_fn,
-                                              dropout_rng)
+                                              dropout_rng,
+                                              moe_dense_routing)
     hidden, aux = hidden_fn(params, tokens[:, :-1])
     if cfg.ce_chunks > 1:
         nll, z_mean = chunked_softmax_xent(hidden, params["tok_emb"],
@@ -723,12 +789,21 @@ def lm_loss(params, tokens, cfg: TransformerConfig,
 def lm_nll(params, tokens, cfg: TransformerConfig,
            attention_fn: Callable | None = None,
            apply_fn: Callable | None = None,
-           hidden_fn: Callable | None = None):
+           hidden_fn: Callable | None = None,
+           moe_dense_routing: bool = False):
     """Mean next-token NLL *without* the MoE aux regularizer — the
     evaluation quantity (``exp`` of it is perplexity; the router load
-    penalty is a training device, not model quality)."""
+    penalty is a training device, not model quality).
+
+    ``moe_dense_routing=True`` evaluates MoE configs with the decode-
+    parity capacity-free routing (see :func:`apply_hidden`) — the right
+    lens for "what perplexity will the served model show": identical to
+    the KV-cached decode at any capacity factor.  Only affects the
+    default trunk (a custom apply_fn/hidden_fn controls its own
+    routing)."""
     return _forward_nll(params, tokens, cfg, attention_fn, apply_fn,
-                        hidden_fn=hidden_fn)[0]
+                        hidden_fn=hidden_fn,
+                        moe_dense_routing=moe_dense_routing)[0]
 
 
 def make_train_step(cfg: TransformerConfig, optimizer,

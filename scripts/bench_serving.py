@@ -1,0 +1,245 @@
+"""Serving benchmark: KV-cached decode through the bandwidth lens.
+
+Decode is HBM-bandwidth-bound, not FLOPs-bound: each generated token
+re-reads every matmul weight plus the KV cache at batch sizes far too
+small to amortize them, so the right utilization metric is **achieved
+bytes/s against the chip's HBM bandwidth**, not MFU (the roofline
+argument of docs/perf_resnet50.md applied to inference — decode lives
+on the bandwidth-bound side of the ridge).
+
+Per config this prints one JSON line with:
+
+- ``tokens_per_s`` (batch x new_tokens / wall) and ``ms_per_token``
+  (per decode step — the user-visible latency between tokens),
+- ``bw_util``: modeled HBM traffic per step / (step time x peak HBM
+  bandwidth).  Traffic model, intentionally minimal: weight bytes are
+  read once per step (batch shares them — that IS batching's win) and
+  each batch row reads its cache slots once; activations are noise at
+  decode shapes.  ``bw_util`` near 1.0 = the decode loop is running at
+  the hardware's bandwidth roofline; the headroom 1 - bw_util is what
+  software (fusion, layout, quantization) can still claim.
+
+Workloads: greedy and sampled (top-k=50, temperature 0.8) at batch
+1/8/64, bf16 vs int8 weights, rolling-window cache, and beam width 4 —
+every serving surface models/generate.py offers.
+
+Usage: python scripts/bench_serving.py [config ...]
+(no args = all; unknown name lists the choices).  Results land in
+BASELINE.md's Serving section; analysis in docs/perf_serving.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Peak HBM GB/s per chip, keyed on jax device_kind (public spec sheets:
+# v5e 819, v4 1228, v5p 2765).
+PEAK_HBM = {
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v4": 1228e9,
+    "TPU v5p": 2765e9,
+}
+
+
+def _cfg(window=None):
+    from distkeras_tpu.models import transformer as tfm
+
+    # The flagship serving config (>= d1024 L8 per the round-2 review):
+    # 32k vocab, 8 layers, d_model 1024 — ~152M weight params, the tied
+    # embedding table is ~22% of weight bytes.
+    return tfm.TransformerConfig(
+        vocab_size=32768, d_model=1024, n_heads=8, n_layers=8, d_ff=4096,
+        max_len=1025, dtype="bfloat16", rope=True,
+        attention_window=window)
+
+
+def weight_bytes(cfg, bytes_per_el=2):
+    """Matmul-weight bytes one decode step reads (norm scales ignored:
+    <0.01%).  Tied embedding counts once (embed gather touches B rows,
+    the unembed reads the full [V, D] table)."""
+    d, f, l, v = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+    attn = 4 * d * d          # wq wk wv wo
+    ffn = 2 * d * f
+    return (l * (attn + ffn) + v * d) * bytes_per_el
+
+
+def cache_bytes_per_row(cfg, filled, bytes_per_el=2):
+    """KV bytes one decode step reads per batch row.
+
+    Static shapes: the masked attention reads all ``cfg.max_len`` slots
+    regardless of how many are filled — that is the real traffic, and
+    exactly why the rolling-window config (small max_len ring buffer)
+    wins on long generations.  ``filled`` is kept for reporting only.
+    """
+    del filled
+    return 2 * cfg.n_layers * cfg.max_len * cfg.kv_heads * cfg.head_dim \
+        * bytes_per_el
+
+
+def _measure_decode(cfg, params, batch, new, p_len=64, iters=3,
+                    w_bytes=None, seq_steps=None, **gen_kw):
+    """``seq_steps``: actual decode-step count of the compiled scan.
+    Defaults to ``new`` (the prefill path); the quantized tree forces
+    the sequential path, which teacher-forces p_len - 1 extra steps —
+    callers on that path must pass ``p_len - 1 + new`` or ms_per_token
+    and bw_util are biased against it."""
+    import jax
+    import numpy as np
+    from distkeras_tpu.models.generate import generate
+
+    prompt = jax.device_put(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, p_len)).astype(np.int32))
+    gen = jax.jit(lambda pp, pr: generate(pp, pr, cfg, new, **gen_kw))
+    int(np.asarray(gen(params, prompt))[0, -1])  # compile + barrier
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = gen(params, prompt)
+    int(np.asarray(out)[0, -1])
+    dt = (time.perf_counter() - t0) / iters
+
+    step_s = dt / (seq_steps if seq_steps is not None else new)
+    w_bytes = w_bytes if w_bytes is not None else weight_bytes(cfg)
+    step_bytes = w_bytes + batch * cache_bytes_per_row(cfg, p_len + new)
+    extras = {"batch": batch, "prompt_len": p_len, "new_tokens": new,
+              "step_bytes_mb": round(step_bytes / 1e6, 1)}
+    import jax as _j
+
+    peak = PEAK_HBM.get(_j.devices()[0].device_kind)
+    if peak:
+        extras["bw_util"] = round(step_bytes / step_s / peak, 4)
+    return batch * new / dt, step_s, 0.0, extras
+
+
+def _params(quant=False):
+    import jax
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.models.quant import quantize_params
+
+    p = tfm.init_params(jax.random.key(0), _cfg())
+    return quantize_params(p) if quant else p
+
+
+def bench_greedy(batch):
+    def run():
+        return _measure_decode(_cfg(), _params(), batch, new=512)
+    return run
+
+
+def bench_sampled(batch):
+    def run():
+        import jax
+
+        return _measure_decode(_cfg(), _params(), batch, new=512,
+                               temperature=0.8, top_k=50,
+                               key=jax.random.key(0))
+    return run
+
+
+def bench_int8(batch):
+    def run():
+        # int8 params force the sequential path (no prefill): short
+        # prompt keeps the measured region decode-dominated, and
+        # seq_steps counts the p_len-1 teacher-forcing steps the scan
+        # really runs so per-step numbers compare fairly vs bf16.
+        return _measure_decode(_cfg(), _params(quant=True), batch,
+                               new=512, p_len=16, seq_steps=15 + 512,
+                               w_bytes=weight_bytes(_cfg(), bytes_per_el=1))
+    return run
+
+
+def bench_rolling_window():
+    """Sliding-window serving: window 256 on a 256-slot ring-buffer
+    cache, generating PAST the cache size (the rolling-decode path).
+    Cache traffic/row drops ~4x vs the full-1025-slot config."""
+    import dataclasses
+
+    def run():
+        import jax
+        from distkeras_tpu.models import transformer as tfm
+
+        cfg = dataclasses.replace(_cfg(window=256), max_len=256)
+        params = tfm.init_params(jax.random.key(0), cfg)
+        return _measure_decode(cfg, params, batch=8, new=512, p_len=64)
+    return run
+
+
+def bench_beam4():
+    def run():
+        import jax
+        import numpy as np
+        from distkeras_tpu.models.generate import beam_search
+
+        cfg = _cfg()
+        params = _params()
+        batch, p_len, new, width = 8, 64, 256, 4
+        prompt = jax.device_put(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch, p_len)).astype(np.int32))
+        bs = jax.jit(lambda pp, pr: beam_search(pp, pr, cfg, new,
+                                                beam_width=width)[0])
+        int(np.asarray(bs(params, prompt))[0, 0, -1])
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = bs(params, prompt)
+        int(np.asarray(out)[0, 0, -1])
+        dt = (time.perf_counter() - t0) / iters
+        step_s = dt / new
+        # Beam traffic: weights once, cache per beam row (B x W rows).
+        step_bytes = (weight_bytes(cfg)
+                      + batch * width * cache_bytes_per_row(cfg, 0))
+        extras = {"batch": batch, "beam_width": width, "prompt_len": p_len,
+                  "new_tokens": new,
+                  "step_bytes_mb": round(step_bytes / 1e6, 1)}
+        peak = PEAK_HBM.get(jax.devices()[0].device_kind)
+        if peak:
+            extras["bw_util"] = round(step_bytes / step_s / peak, 4)
+        # tokens/s counts kept tokens (batch x new), not beam work.
+        return batch * new / dt, step_s, 0.0, extras
+    return run
+
+
+BENCHES = {
+    "decode_greedy_b1": (bench_greedy(1), "tokens/sec/chip"),
+    "decode_greedy_b8": (bench_greedy(8), "tokens/sec/chip"),
+    "decode_greedy_b64": (bench_greedy(64), "tokens/sec/chip"),
+    "decode_sampled_b1": (bench_sampled(1), "tokens/sec/chip"),
+    "decode_sampled_b8": (bench_sampled(8), "tokens/sec/chip"),
+    "decode_sampled_b64": (bench_sampled(64), "tokens/sec/chip"),
+    "decode_int8_b1": (bench_int8(1), "tokens/sec/chip"),
+    "decode_int8_b8": (bench_int8(8), "tokens/sec/chip"),
+    "decode_int8_b64": (bench_int8(64), "tokens/sec/chip"),
+    "decode_rolling_window": (bench_rolling_window(), "tokens/sec/chip"),
+    "beam4": (bench_beam4(), "tokens/sec/chip"),
+}
+
+
+def main(names):
+    import jax
+
+    unknown = set(names) - set(BENCHES)
+    if unknown:
+        sys.exit(f"unknown config(s) {sorted(unknown)}; "
+                 f"choose from {sorted(BENCHES)}")
+    print(f"# backend={jax.default_backend()} device={jax.devices()[0]}",
+          file=sys.stderr)
+    for name in names or BENCHES:
+        fn, unit = BENCHES[name]
+        try:
+            rate, step_s, _, extra = fn()
+        except Exception as e:
+            print(json.dumps({"metric": name, "error": repr(e)[:200]}))
+            continue
+        print(json.dumps({
+            "metric": name, "value": round(rate, 1), "unit": unit,
+            "ms_per_token": round(step_s * 1e3, 3), **extra,
+        }))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

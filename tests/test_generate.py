@@ -13,24 +13,70 @@ CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
                             n_layers=2, d_ff=64, max_len=16)
 MOE_CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
                                 n_layers=1, d_ff=64, max_len=16,
-                                num_experts=4, capacity_factor=8.0)
+                                num_experts=4, capacity_factor=1.25)
+MOE2_CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=1, d_ff=64, max_len=16,
+                                 num_experts=4, moe_top_k=2,
+                                 capacity_factor=1.25)
 ROPE_CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
                                  n_layers=2, d_ff=64, max_len=16, rope=True)
 
 
-@pytest.mark.parametrize("cfg", [CFG, MOE_CFG, ROPE_CFG],
-                         ids=["dense", "moe", "rope"])
+@pytest.mark.parametrize("cfg", [CFG, MOE_CFG, MOE2_CFG, ROPE_CFG],
+                         ids=["dense", "moe", "moe2", "rope"])
 def test_cached_decode_matches_full_forward(rng, cfg):
-    """Teacher-forcing through the cache == apply() at every position."""
+    """Teacher-forcing through the cache == apply() at every position.
+
+    The MoE case pins parity at a REALISTIC capacity factor (1.25):
+    the batched forward scores with ``moe_dense_routing=True`` — the
+    decode semantics — so nothing depends on capacity being large
+    enough to never drop (a no-op flag for the dense/rope configs).
+    """
     params = tfm.init_params(jax.random.key(0), cfg)
     toks = jnp.asarray(rng.integers(0, 64, (2, 12)).astype(np.int32))
-    full_logits, _ = tfm.apply(params, toks, cfg)
+    full_logits, _ = tfm.apply(params, toks, cfg,
+                               moe_dense_routing=bool(cfg.num_experts))
 
     cache = init_cache(cfg, 2)
     for pos in range(12):
         logits, cache = _decode_step(params, cache, toks[:, pos], pos, cfg)
         np.testing.assert_allclose(logits, full_logits[:, pos], atol=2e-4,
                                    rtol=2e-4)
+
+
+def test_moe_capacity_vs_dense_divergence_bounded(rng):
+    """Quantified train/serve routing contract on a TRAINED MoE.
+
+    Trains briefly at capacity_factor=1.25 (tokens really drop), then
+    measures the capacity-routing vs dense-routing eval NLL gap.  The
+    served model (decode == dense routing by the parity test above)
+    must track the training-time forward within a modest bound — this
+    is the measured form of the divergence caveat in ``generate``'s
+    docstring, asserted so a regression in either routing path shows
+    up as a blown bound rather than silent quality drift.
+    """
+    import optax
+
+    cfg = MOE_CFG
+    params = tfm.init_params(jax.random.key(3), cfg)
+    opt = optax.adam(3e-3)
+    step = jax.jit(tfm.make_train_step(cfg, opt))
+    carry = (params, opt.init(params))
+    toks = jnp.asarray(rng.integers(0, 64, (8, 13)).astype(np.int32))
+    for _ in range(30):
+        carry, _ = step(carry, toks)
+    trained = carry[0]
+
+    nll_cap = float(tfm.lm_nll(trained, toks, cfg))
+    nll_dense = float(tfm.lm_nll(trained, toks, cfg,
+                                 moe_dense_routing=True))
+    # Routing genuinely differs at this capacity (the contract is a
+    # bound, not equality)...
+    assert nll_cap != nll_dense
+    # ...but serving quality must track training quality: |gap| within
+    # 5% relative.  Observed gap on this config is well under 1%; 5%
+    # leaves headroom across seeds without letting real drift pass.
+    assert abs(nll_dense - nll_cap) <= 0.05 * nll_cap, (nll_cap, nll_dense)
 
 
 def test_generate_greedy_matches_argmax_rollout(rng):
@@ -292,15 +338,16 @@ def test_prefill_rejections(rng):
                  prompt_lengths=np.array([3, 5]))
 
 
-def test_prefill_moe_matches_sequential(rng):
+@pytest.mark.parametrize("cfg", [MOE_CFG, MOE2_CFG], ids=["top1", "top2"])
+def test_prefill_moe_matches_sequential(rng, cfg):
     """MoE prompts prefill with decode-parity dense routing: outputs
     equal the all-sequential path exactly (same per-token math)."""
-    params = tfm.init_params(jax.random.key(1), MOE_CFG)
+    params = tfm.init_params(jax.random.key(1), cfg)
     prompt = jnp.asarray(rng.integers(0, 64, (3, 7)), jnp.int32)
-    seq = generate(params, prompt, MOE_CFG, 6, use_prefill=False)
-    pre = generate(params, prompt, MOE_CFG, 6, use_prefill=True)
+    seq = generate(params, prompt, cfg, 6, use_prefill=False)
+    pre = generate(params, prompt, cfg, 6, use_prefill=True)
     np.testing.assert_array_equal(np.asarray(pre), np.asarray(seq))
-    auto = generate(params, prompt, MOE_CFG, 6)  # auto now prefills
+    auto = generate(params, prompt, cfg, 6)  # auto now prefills
     np.testing.assert_array_equal(np.asarray(auto), np.asarray(seq))
 
 
