@@ -127,9 +127,18 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
     row i's attention forever, and position *ids* (rotary angles /
     pos_emb rows) count from the row's true start, so each row decodes
     exactly as it would alone.
+
+    The plain path (no window, no padding) delegates to
+    :func:`_decode_chunk` with T = 1 — ONE layer-body definition for
+    both; only the ring-buffer slot arithmetic and the ragged pad
+    masking below justify a separate body.
     """
     dtype = jnp.dtype(cfg.dtype)
     b = tokens.shape[0]
+    if cfg.attention_window is None and pad_lens is None:
+        out, cache = _decode_chunk(params, cache, tokens[:, None],
+                                   jnp.full((b,), pos, jnp.int32), cfg)
+        return out[:, 0], cache
     x = embed_rows(params["tok_emb"], tokens, dtype)  # [B, D]
     if pad_lens is None:
         pos_ids = jnp.full((b,), pos)
@@ -233,6 +242,101 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
     # quant.unembed_logits), instead of dequantizing [V, d] per step.
     out = unembed_logits(x, params["tok_emb"], dtype)
     cache = {"k": jnp.stack(new_cache_k), "v": jnp.stack(new_cache_v)}
+    return out.astype(jnp.float32), cache
+
+
+def _rows_update(cache_layer, rows, pos0):
+    """Write ``rows [B, T, kv, hd]`` into ``cache_layer [B, S, kv, hd]``
+    at per-row offsets ``pos0 [B]`` (a batched dynamic_update_slice —
+    XLA lowers the vmap to a scatter).  Callers clamp pos0 to S - T;
+    dynamic_update_slice would silently shift an out-of-range write."""
+    return jax.vmap(
+        lambda c, r, p: jax.lax.dynamic_update_slice(
+            c, r.astype(c.dtype), (p, 0, 0)))(cache_layer, rows, pos0)
+
+
+def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig):
+    """Process T new tokens per row against the cache in ONE pass:
+    ``tokens [B, T]`` at global positions ``pos0[b] + (0..T-1)`` ->
+    ``(logits [B, T, V] f32, cache)``.
+
+    The chunked generalization of :func:`_decode_step` (T = 1 is the
+    same math): queries attend every cached position <= their own
+    global position — in-chunk causality included — and the chunk's
+    K/V land in the cache at per-row offsets, so rows at different
+    positions (speculative decoding's per-row accept divergence) share
+    one compiled program.  Full-cache configs only: the sliding-window
+    ring buffer's slot arithmetic is per-scalar-position
+    (_decode_step); speculative decoding rejects windowed configs.
+
+    Stale cache slots beyond a row's final position are harmless by
+    construction: the position mask excludes them, and every slot is
+    rewritten before the row's position passes it.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    b, t_len = tokens.shape
+    x = embed_rows(params["tok_emb"], tokens, dtype)        # [B, T, D]
+    pos_ids = pos0[:, None] + jnp.arange(t_len)[None, :]    # [B, T]
+    rope_ang = None
+    if cfg.rope:
+        rope_ang = rope_angles(pos_ids, cfg.head_dim,
+                               cfg.rope_theta)[:, :, None, :]
+    else:
+        x = x + params["pos_emb"][pos_ids].astype(dtype)
+
+    new_k, new_v = [], []
+    span = jnp.arange(cfg.max_len)
+    mask = (span[None, None, :] <= pos_ids[:, :, None]
+            )[:, :, None, None, :]                # [B, T, 1, 1, S]
+    for i in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+        h = _rms_norm(x, lp["ln1_scale"])
+        q = jnp.einsum("btd,dhk->bthk", h, deq(lp["attn"]["wq"]))
+        k = jnp.einsum("btd,dhk->bthk", h, deq(lp["attn"]["wk"]))
+        v = jnp.einsum("btd,dhk->bthk", h, deq(lp["attn"]["wv"]))
+        if rope_ang is not None:
+            q, k = rope_rotate(q, rope_ang), rope_rotate(k, rope_ang)
+        ck = _rows_update(cache["k"][i], k, pos0)
+        cv = _rows_update(cache["v"][i], v, pos0)
+        new_k.append(ck)
+        new_v.append(cv)
+
+        groups = cfg.n_heads // cfg.kv_heads
+        qg = q.astype(jnp.float32).reshape(
+            b, t_len, cfg.kv_heads, groups, cfg.head_dim)
+        logits = jnp.einsum("btcgk,bsck->btcgs", qg,
+                            ck.astype(jnp.float32))
+        logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("btcgs,bsck->btcgk", probs,
+                          cv.astype(jnp.float32)).reshape(
+            b, t_len, cfg.n_heads, cfg.head_dim)
+        x = x + jnp.einsum("bthk,hkd->btd", attn.astype(dtype),
+                           deq(lp["attn"]["wo"]))
+
+        h = _rms_norm(x, lp["ln2_scale"])
+        if cfg.num_experts:
+            router = jnp.einsum("btd,de->bte", h.astype(jnp.float32),
+                                lp["moe"]["wg"])
+            gates, expert = _moe_gates(jax.nn.softmax(router, -1), cfg)
+            w1 = lp["moe"]["w1"][expert]          # [B, T, k, D, F]
+            w2 = lp["moe"]["w2"][expert]
+            hk = jax.nn.gelu(jnp.einsum("btd,btkdf->btkf", h,
+                                        w1.astype(dtype)))
+            yk = jnp.einsum("btkf,btkfd->btkd", hk, w2.astype(dtype))
+            y = jnp.einsum("btkd,btk->btd", yk, gates.astype(dtype))
+        else:
+            y = jnp.einsum(
+                "btf,fd->btd",
+                jax.nn.gelu(jnp.einsum("btd,df->btf", h,
+                                       deq(lp["ffn"]["w1"]))),
+                deq(lp["ffn"]["w2"]))
+        x = x + y
+
+    x = _rms_norm(x, params["ln_f_scale"])
+    out = unembed_logits(x, params["tok_emb"], dtype)
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
     return out.astype(jnp.float32), cache
 
 

@@ -130,7 +130,7 @@ def deq(w, dtype=None):
 
 
 def unembed_logits(x, tok_emb, dtype):
-    """Unembedding head ``x [B, d] @ tok_emb^T [V, d] -> [B, V]``.
+    """Unembedding head ``x [..., d] @ tok_emb^T [V, d] -> [..., V]``.
 
     Quantized path: contract against the raw int8 table and apply the
     per-vocab-row scale to the [B, V] *result* — algebraically identical
@@ -144,9 +144,10 @@ def unembed_logits(x, tok_emb, dtype):
     so converting q to the compute dtype loses nothing.
     """
     if isinstance(tok_emb, QTensor):
-        out = jnp.einsum("bd,vd->bv", x, tok_emb.q.astype(x.dtype))
-        return out.astype(jnp.float32) * tok_emb.s[:, 0][None, :]
-    return jnp.einsum("bd,vd->bv", x, jnp.asarray(tok_emb).astype(dtype))
+        out = jnp.einsum("...d,vd->...v", x, tok_emb.q.astype(x.dtype))
+        return out.astype(jnp.float32) * tok_emb.s[:, 0]
+    return jnp.einsum("...d,vd->...v", x,
+                      jnp.asarray(tok_emb).astype(dtype))
 
 
 def embed_rows(tok_emb, tokens, dtype):

@@ -80,6 +80,16 @@ MULTIPROCESS = {
 }
 
 SLOW = MULTIPROCESS | {
+    "test_speculative::test_decode_chunk_matches_decode_step",
+    "test_speculative::test_decode_chunk_per_row_offsets",
+    "test_speculative::test_greedy_matches_generate",
+    "test_speculative::test_greedy_rope_gqa_matches_generate",
+    "test_speculative::test_greedy_moe_matches_generate",
+    "test_speculative::test_nonuniform_acceptance_rows_finish_cleanly",
+    "test_speculative::test_perfect_draft_accepts_everything",
+    "test_speculative::test_quantized_target_matches_quantized_generate",
+    "test_speculative::test_sampled_matches_target_distribution",
+    "test_speculative::test_sampled_deterministic_per_key",
     "test_attention::test_flash_attention_window_grads_fallback",
     "test_attention::test_pallas_window_backward_interpret",
     "test_attention::test_pallas_window_banded_grid_asymmetric_blocks",
