@@ -64,6 +64,7 @@ from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
 from distkeras_tpu.parallel.sharding import (ShardingPlan, dp_plan,
                                               fsdp_plan, tp_plan)
 from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.packing import pack_documents, packing_efficiency
 from distkeras_tpu.data.tokenizer import BPETokenizer
 from distkeras_tpu.data.transformers import (
     Transformer,
@@ -106,6 +107,8 @@ __all__ = [
     "fsdp_plan",
     "tp_plan",
     "Dataset",
+    "pack_documents",
+    "packing_efficiency",
     "BPETokenizer",
     "Transformer",
     "OneHotTransformer",

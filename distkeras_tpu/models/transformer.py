@@ -271,11 +271,13 @@ def tp_rules():
     ]
 
 
-def _resolve_attention_fn(cfg: "TransformerConfig", attention_fn):
+def _resolve_attention_fn(cfg: "TransformerConfig", attention_fn,
+                          segment_ids=None):
     """ONE guard for the window/attention_fn pairing (apply_hidden and
     apply_pipelined share it).
 
-    No fn: build the default windowed flash lambda.  Custom fn: its
+    No fn: build the default windowed flash lambda (closing over
+    ``segment_ids`` for packed sequences).  Custom fn: its
     ``handles_window`` attribute (set by make_ring_attention; set it
     yourself on hand-rolled fns) must equal ``cfg.attention_window`` in
     BOTH directions — a band applied on one side only would silently
@@ -283,7 +285,14 @@ def _resolve_attention_fn(cfg: "TransformerConfig", attention_fn):
     """
     if attention_fn is None:
         return lambda q, k, v: flash_attention(
-            q, k, v, True, window=cfg.attention_window)
+            q, k, v, True, window=cfg.attention_window,
+            segment_ids=segment_ids)
+    if segment_ids is not None:
+        raise ValueError(
+            "segment_ids with a custom attention_fn is not supported: "
+            "the packed-document mask must be applied inside the "
+            "attention implementation (ring attention does not carry "
+            "segments yet) — drop the custom fn or unpack the batch")
     fn_window = getattr(attention_fn, "handles_window", None)
     if fn_window != cfg.attention_window:
         raise ValueError(
@@ -493,7 +502,7 @@ def block_apply(layer_params, x, cfg: TransformerConfig,
 
 def apply_hidden(params, tokens, cfg: TransformerConfig,
                  attention_fn: Callable | None = None, dropout_rng=None,
-                 moe_dense_routing: bool = False):
+                 moe_dense_routing: bool = False, segment_ids=None):
     """Trunk forward: tokens [B, S] int32 -> final-norm hidden [B, S, D].
 
     Everything in :func:`apply` except the unembedding matmul; the
@@ -507,8 +516,16 @@ def apply_hidden(params, tokens, cfg: TransformerConfig,
     decode at ANY capacity factor; the default (training capacity
     dispatch) diverges for every token the router would capacity-drop.
     No-op for dense configs.
+
+    ``segment_ids [B, S]`` int32 (packed sequences, data/packing.py):
+    attention is masked to within-segment pairs; 0 marks padding.
+    With ``rope=True`` the packed forward is EXACT vs running each
+    document alone — rotary scores depend only on within-document
+    relative distance, which a uniform position shift preserves.  With
+    a learned position table, packed documents see shifted rows
+    (standard packing behavior; prefer rope for packed training).
     """
-    attention_fn = _resolve_attention_fn(cfg, attention_fn)
+    attention_fn = _resolve_attention_fn(cfg, attention_fn, segment_ids)
     dtype = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
     _check_len(s, cfg)
@@ -557,7 +574,7 @@ def _unembed(hidden, params, cfg: TransformerConfig):
 
 def apply(params, tokens, cfg: TransformerConfig,
           attention_fn: Callable | None = None, dropout_rng=None,
-          moe_dense_routing: bool = False):
+          moe_dense_routing: bool = False, segment_ids=None):
     """Forward pass: tokens [B, S] int32 -> logits [B, S, V].
 
     ``attention_fn(q, k, v) -> out`` defaults to causal flash attention
@@ -565,11 +582,13 @@ def apply(params, tokens, cfg: TransformerConfig,
     sequence parallelism.  ``dropout_rng`` non-None (with cfg.dropout
     > 0) enables training dropout; omit it for deterministic
     inference/eval.  ``moe_dense_routing=True`` selects the decode-
-    parity capacity-free MoE routing (see :func:`apply_hidden`).
+    parity capacity-free MoE routing; ``segment_ids`` masks packed
+    sequences (see :func:`apply_hidden`).
     Returns (logits, aux_loss).
     """
     x, aux_total = apply_hidden(params, tokens, cfg, attention_fn,
-                                dropout_rng, moe_dense_routing)
+                                dropout_rng, moe_dense_routing,
+                                segment_ids)
     return _unembed(x, params, cfg), aux_total
 
 
@@ -581,9 +600,10 @@ def chunked_softmax_xent(hidden, emb, targets, n_chunks: int):
     ``cfg.z_loss_coef`` is set.
 
     ``hidden`` [B, S, D] (compute dtype), ``emb`` [V, D], ``targets``
-    [B, S] int.  Tokens flatten to N = B*S rows, padded up to a multiple
-    of ``n_chunks`` (padding carries target -1 and contributes 0); a
-    ``lax.scan`` over the chunks computes each [N/n_chunks, V] logits
+    [B, S] int — target -1 marks an EXCLUDED position (loss masking:
+    packed-sequence boundaries/padding, plus the internal chunk-pad
+    rows) and the mean divides by the VALID count only.  A ``lax.scan``
+    over the chunks computes each [N/n_chunks, V] logits
     slice, reduces it to its per-row ``logsumexp - target_logit``, and
     discards it.  ``jax.checkpoint`` on the body re-derives the slice in
     the backward, so peak HBM for the head is one slice fwd + bwd
@@ -604,7 +624,7 @@ def chunked_softmax_xent(hidden, emb, targets, n_chunks: int):
     emb_c = emb.astype(hidden.dtype)
 
     def body(carry, sl):
-        nll_total, z_total = carry
+        nll_total, z_total, n_valid = carry
         hc, tc = sl
         logits = jnp.einsum("cd,vd->cv", hc, emb_c).astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
@@ -613,12 +633,15 @@ def chunked_softmax_xent(hidden, emb, targets, n_chunks: int):
         valid = tc >= 0
         nll = jnp.where(valid, lse - tgt, 0.0)
         z = jnp.where(valid, jnp.square(lse), 0.0)
-        return (nll_total + nll.sum(), z_total + z.sum()), None
+        return (nll_total + nll.sum(), z_total + z.sum(),
+                n_valid + valid.sum()), None
 
-    (total, z_total), _ = jax.lax.scan(
+    (total, z_total, n_valid), _ = jax.lax.scan(
         jax.checkpoint(body),
-        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), (h, t))
-    return total / n_tok, z_total / n_tok
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+         jnp.zeros((), jnp.int32)), (h, t))
+    denom = jnp.maximum(n_valid, 1).astype(jnp.float32)
+    return total / denom, z_total / denom
 
 
 def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
@@ -717,7 +740,8 @@ def _forward_nll(params, tokens, cfg: TransformerConfig,
                  attention_fn: Callable | None,
                  apply_fn: Callable | None, dropout_rng=None,
                  hidden_fn: Callable | None = None,
-                 moe_dense_routing: bool = False):
+                 moe_dense_routing: bool = False,
+                 segment_ids=None):
     """(mean next-token NLL, aux) — shared by train loss and eval.
 
     Three forward routes:
@@ -729,21 +753,50 @@ def _forward_nll(params, tokens, cfg: TransformerConfig,
       ``return_hidden=True``); the head honors ``cfg.ce_chunks``.
     - neither: the default :func:`apply_hidden` trunk; the head honors
       ``cfg.ce_chunks``.
+
+    ``segment_ids [B, S+1]`` (aligned with ``tokens``, packed
+    sequences): attention is segment-masked on the default trunk, and
+    the loss EXCLUDES targets that cross a document boundary or sit in
+    padding (segment 0) — the mean divides by the valid count.  A
+    custom apply_fn/hidden_fn gets only the loss masking (its forward
+    masks its own attention).
     """
     if apply_fn is not None and hidden_fn is not None:
         raise ValueError("pass apply_fn or hidden_fn, not both")
     targets = tokens[:, 1:]
+    valid = None
+    seg_in = None
+    if segment_ids is not None:
+        if segment_ids.shape != tokens.shape:
+            raise ValueError(
+                f"segment_ids must align with tokens {tokens.shape}, "
+                f"got {segment_ids.shape}")
+        seg_in = segment_ids[:, :-1]
+        # A target is trainable iff it continues its input's document
+        # (same nonzero segment) — boundary and pad targets are dead.
+        valid = ((segment_ids[:, 1:] == seg_in) & (seg_in != 0))
+        targets = jnp.where(valid, targets, -1)
     zc = cfg.z_loss_coef
 
     def full_head(logits, aux):
         # z-loss rides in aux (training-only, like the MoE penalty —
         # lm_nll drops aux, so eval perplexity stays pure).
         logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None],
-                                   axis=-1).mean()
+        per_tok = -jnp.take_along_axis(
+            logp, jnp.maximum(targets, 0)[..., None], axis=-1)[..., 0]
+        if valid is None:
+            nll = per_tok.mean()
+        else:
+            denom = jnp.maximum(valid.sum(), 1)
+            nll = jnp.where(valid, per_tok, 0.0).sum() / denom
         if zc > 0:
             lse = jax.scipy.special.logsumexp(logits, axis=-1)
-            aux = aux + zc * jnp.square(lse).mean()
+            if valid is None:
+                aux = aux + zc * jnp.square(lse).mean()
+            else:
+                denom = jnp.maximum(valid.sum(), 1)
+                aux = aux + zc * (jnp.where(valid, jnp.square(lse), 0.0)
+                                  .sum() / denom)
         return nll, aux
 
     if apply_fn is not None:
@@ -752,7 +805,8 @@ def _forward_nll(params, tokens, cfg: TransformerConfig,
     if hidden_fn is None:
         hidden_fn = lambda p, t: apply_hidden(p, t, cfg, attention_fn,
                                               dropout_rng,
-                                              moe_dense_routing)
+                                              moe_dense_routing,
+                                              seg_in)
     hidden, aux = hidden_fn(params, tokens[:, :-1])
     if cfg.ce_chunks > 1:
         nll, z_mean = chunked_softmax_xent(hidden, params["tok_emb"],
@@ -766,8 +820,10 @@ def _forward_nll(params, tokens, cfg: TransformerConfig,
 def lm_loss(params, tokens, cfg: TransformerConfig,
             attention_fn: Callable | None = None,
             apply_fn: Callable | None = None, dropout_rng=None,
-            hidden_fn: Callable | None = None):
-    """Next-token cross-entropy (+ MoE aux), mean over B*(S-1) targets.
+            hidden_fn: Callable | None = None, segment_ids=None):
+    """Next-token cross-entropy (+ MoE aux), mean over the trainable
+    targets (all B*(S-1) positions, or the within-document subset when
+    ``segment_ids`` marks packed sequences — see :func:`_forward_nll`).
 
     ``apply_fn(params, inputs) -> (logits, aux)`` defaults to
     :func:`apply`; pass ``hidden_fn`` (e.g. a closure over
@@ -782,7 +838,8 @@ def lm_loss(params, tokens, cfg: TransformerConfig,
             "must take its own rng — pipeline parallelism does not "
             "support dropout (see TransformerConfig.dropout)")
     nll, aux = _forward_nll(params, tokens, cfg, attention_fn, apply_fn,
-                            dropout_rng, hidden_fn)
+                            dropout_rng, hidden_fn,
+                            segment_ids=segment_ids)
     return nll + aux
 
 
@@ -790,7 +847,7 @@ def lm_nll(params, tokens, cfg: TransformerConfig,
            attention_fn: Callable | None = None,
            apply_fn: Callable | None = None,
            hidden_fn: Callable | None = None,
-           moe_dense_routing: bool = False):
+           moe_dense_routing: bool = False, segment_ids=None):
     """Mean next-token NLL *without* the MoE aux regularizer — the
     evaluation quantity (``exp`` of it is perplexity; the router load
     penalty is a training device, not model quality).
@@ -803,7 +860,8 @@ def lm_nll(params, tokens, cfg: TransformerConfig,
     routing)."""
     return _forward_nll(params, tokens, cfg, attention_fn, apply_fn,
                         hidden_fn=hidden_fn,
-                        moe_dense_routing=moe_dense_routing)[0]
+                        moe_dense_routing=moe_dense_routing,
+                        segment_ids=segment_ids)[0]
 
 
 def make_train_step(cfg: TransformerConfig, optimizer,
@@ -825,7 +883,7 @@ def make_train_step(cfg: TransformerConfig, optimizer,
     """
     dropping = cfg.dropout > 0
 
-    def step(carry, tokens, dropout_rng=None):
+    def step(carry, tokens, dropout_rng=None, segment_ids=None):
         params, opt_state = carry
         grad_fn = jax.value_and_grad(lm_loss)
         if dropping and dropout_rng is None:
@@ -837,14 +895,16 @@ def make_train_step(cfg: TransformerConfig, optimizer,
         rng = dropout_rng if dropping else None
         if grad_accum == 1:
             loss, grads = grad_fn(params, tokens, cfg, attention_fn,
-                                  apply_fn, rng, hidden_fn)
+                                  apply_fn, rng, hidden_fn, segment_ids)
         else:
             grads = jax.tree.map(jnp.zeros_like, params)
             loss = jnp.zeros((), jnp.float32)
             for i in range(grad_accum):
                 ri = jax.random.fold_in(rng, i) if rng is not None else None
                 li, gi = grad_fn(params, tokens[i], cfg, attention_fn,
-                                 apply_fn, ri, hidden_fn)
+                                 apply_fn, ri, hidden_fn,
+                                 None if segment_ids is None
+                                 else segment_ids[i])
                 grads = jax.tree.map(jnp.add, grads, gi)
                 loss = loss + li
             grads = jax.tree.map(lambda g: g / grad_accum, grads)

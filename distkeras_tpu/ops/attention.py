@@ -69,8 +69,12 @@ def _check_window(window, causal) -> None:
 
 def naive_attention(q, k, v, causal: bool = False, scale: float | None = None,
                     q_offset: int = 0, kv_offset: int = 0,
-                    window: int | None = None):
-    """Materialized-logits attention; the test oracle."""
+                    window: int | None = None, segment_ids=None):
+    """Materialized-logits attention; the test oracle.
+
+    ``segment_ids [B, L]`` (packed sequences): positions attend only
+    within their own segment — the mask composes with causal/window.
+    """
     _check_window(window, causal)
     scale = _scale_for(q, scale)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -78,6 +82,9 @@ def naive_attention(q, k, v, causal: bool = False, scale: float | None = None,
         mask = _causal_mask(q.shape[1], k.shape[1], q_offset, kv_offset,
                             window)
         logits = jnp.where(mask[None, None], logits, NEG_INF)
+    if segment_ids is not None:
+        seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        logits = jnp.where(seg, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -86,19 +93,25 @@ def naive_attention(q, k, v, causal: bool = False, scale: float | None = None,
 
 
 def attention_chunk(q, k, v, m, l, o, causal: bool, scale: float,
-                    q_offset, kv_offset, window: int | None = None):
+                    q_offset, kv_offset, window: int | None = None,
+                    seg_q=None, seg_k=None):
     """One online-softmax update with a KV chunk.
 
     Running state (per q row): ``m`` max logit ``[B,H,Lq]``, ``l``
     normalizer ``[B,H,Lq]``, ``o`` unnormalized output ``[B,H,Lq,D]``.
     This is the flash-attention recurrence; ring attention replays it
     once per hop with the offsets of whichever shard's KV it holds.
+    ``seg_q [B, Lq]`` / ``seg_k [B, Lk]``: segment (packed-document)
+    masking — cross-segment pairs are dead.
     """
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         mask = _causal_mask(q.shape[1], k.shape[1], q_offset, kv_offset,
                             window)
         logits = jnp.where(mask[None, None], logits, NEG_INF)
+    if seg_q is not None:
+        seg = seg_q[:, None, :, None] == seg_k[:, None, None, :]
+        logits = jnp.where(seg, logits, NEG_INF)
     m_new = jnp.maximum(m, logits.max(axis=-1))
     correction = jnp.exp(m - m_new)
     p = jnp.exp(logits - m_new[..., None])
@@ -130,12 +143,13 @@ def online_finish(m, l, o):
 def blockwise_attention(q, k, v, causal: bool = False,
                         scale: float | None = None, block_k: int = 512,
                         q_offset: int = 0, kv_offset: int = 0,
-                        window: int | None = None):
+                        window: int | None = None, segment_ids=None):
     """Online-softmax attention scanning KV in chunks; O(block_k) logits.
 
     Pure jnp: the differentiable any-backend reference for
     :func:`flash_attention`, and the single-device semantics that ring
-    attention distributes.
+    attention distributes.  ``segment_ids [B, L]`` masks attention to
+    within-segment pairs (packed sequences); requires lq == lkv.
     """
     _check_window(window, causal)
     b, lq, h, d = q.shape
@@ -151,18 +165,29 @@ def blockwise_attention(q, k, v, causal: bool = False,
     # [n, B, block, H, D] chunk-major for lax.scan.
     ks = k.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
     vs = v.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    if segment_ids is not None:
+        if segment_ids.shape != (b, lk) or lq != lk:
+            raise ValueError(
+                f"segment_ids must be [batch, seq] = ({b}, {lk}) with "
+                f"lq == lkv, got {segment_ids.shape} (lq={lq})")
+        segs = segment_ids.reshape(b, n_blocks, block_k).transpose(1, 0, 2)
+    else:
+        segs = jnp.zeros((n_blocks, b, 1), jnp.int32)  # unused
     qf = q.astype(jnp.float32)
 
     def body(carry, chunk):
         m, l, o = carry
-        kc, vc, idx = chunk
+        kc, vc, sc, idx = chunk
         m, l, o = attention_chunk(
             qf, kc.astype(jnp.float32), vc.astype(jnp.float32), m, l, o,
-            causal, scale, q_offset, kv_offset + idx * block_k, window)
+            causal, scale, q_offset, kv_offset + idx * block_k, window,
+            seg_q=None if segment_ids is None else segment_ids,
+            seg_k=None if segment_ids is None else sc)
         return (m, l, o), None
 
     (m, l, o), _ = jax.lax.scan(
-        body, online_init(b, h, lq, d), (ks, vs, jnp.arange(n_blocks)))
+        body, online_init(b, h, lq, d),
+        (ks, vs, segs, jnp.arange(n_blocks)))
     return online_finish(m, l, o).astype(q.dtype)
 
 
@@ -170,7 +195,8 @@ def blockwise_attention(q, k, v, causal: bool = False,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
-                  with_lse: bool, window: int | None = None):
+                  with_lse: bool, window: int | None = None,
+                  segmented: bool = False):
     """Flash-attention forward for one (batch*head, q-block, kv-block) cell.
 
     KV streams through the grid's innermost dimension so VMEM holds only
@@ -186,7 +212,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
     backward kernels need to rebuild softmax probabilities tile-by-tile
     without O(L^2) memory; inference omits the output (and its HBM
     writes) entirely.
+
+    ``segmented``: two extra int32 inputs (q/k segment-id tiles) gate
+    the logits to within-segment pairs — packed-document masking.
     """
+    if segmented:
+        qseg_ref, kseg_ref, *refs = refs
     if with_lse:
         o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     else:
@@ -225,6 +256,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
         if causal:
             logits = jnp.where(_keep_mask(logits.shape, row0, col0, window),
                                logits, NEG_INF)
+        if segmented:
+            logits = jnp.where(
+                qseg_ref[0][:, None] == kseg_ref[0][None, :],
+                logits, NEG_INF)
         m = m_scr[:, :1]
         l = l_scr[:, :1]
         m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
@@ -317,7 +352,7 @@ def _banded_q(window: int, block_q: int, block_k: int, n_qb: int):
 
 
 def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False,
-                  with_lse=True, window=None):
+                  with_lse=True, window=None, segment_ids=None):
     """Returns (out, lse) with ``with_lse`` (training), else (out, None) —
     inference skips the lse buffer's HBM writes entirely."""
     b, lq, h, d = q.shape
@@ -328,7 +363,8 @@ def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False,
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
-                               with_lse=with_lse, window=window)
+                               with_lse=with_lse, window=window,
+                               segmented=segment_ids is not None)
 
     o_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
                           memory_space=pltpu.VMEM)
@@ -345,17 +381,34 @@ def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False,
     else:
         inner, kv_map = n_kb, (lambda bh, i, j: (bh, j, 0))
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), kv_map,
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), kv_map,
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [qf, kf, vf]
+    if segment_ids is not None:
+        # [B, S] -> [B*H, S] (b-major repeat matches the qf flattening);
+        # the kv-side map reuses kv_map's block index, so the banded
+        # walk stays in lockstep with the K/V tiles.
+        segf = jnp.repeat(segment_ids.astype(jnp.int32), h, axis=0)
+        in_specs += [
+            pl.BlockSpec((1, block_q),
+                         lambda bh, i, j: (bh, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k),
+                         lambda bh, i, j: kv_map(bh, i, j)[:2],
+                         memory_space=pltpu.VMEM),
+        ]
+        args += [segf, segf]
+
     def call(): return pl.pallas_call(
         kernel,
         grid=(b * h, lq // block_q, inner),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), kv_map,
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), kv_map,
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(o_spec, lse_spec) if with_lse else o_spec,
         out_shape=(o_shape, lse_shape) if with_lse else o_shape,
         scratch_shapes=[
@@ -368,7 +421,7 @@ def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False,
             bytes_accessed=(qf.nbytes + kf.nbytes + vf.nbytes + out_bytes),
             transcendentals=b * h * lq * lk,
         ),
-    )(qf, kf, vf)
+    )(*args)
 
     if interpret:
         # The TPU-semantics interpreter: validates the kernel (incl.
@@ -384,15 +437,22 @@ def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scr, *, causal: bool, scale: float,
-                         window: int | None = None):
+                         *refs, causal: bool, scale: float,
+                         window: int | None = None,
+                         segmented: bool = False):
     """dQ for one (batch*head, q-block, kv-block) cell.
 
     FA2 backward: probabilities are rebuilt per tile from the saved
     log-sum-exp (p = exp(s - lse)); ``delta = rowsum(dO * O)`` folds the
     softmax normalizer's gradient.  dq accumulates across the inner
-    kv-block dimension in VMEM scratch.
+    kv-block dimension in VMEM scratch.  Segment masking re-applies to
+    the rebuilt logits (masked pairs rebuild p = 0, so their gradient
+    contribution vanishes exactly as in the forward).
     """
+    if segmented:
+        qseg_ref, kseg_ref, dq_ref, dq_scr = refs
+    else:
+        dq_ref, dq_scr = refs
     j = pl.program_id(2)
     n_kb = pl.num_programs(2)
     block_q = q_ref.shape[1]
@@ -420,6 +480,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = jnp.where(_keep_mask(s.shape, row0, col0, window),
                           s, NEG_INF)
+        if segmented:
+            s = jnp.where(qseg_ref[0][:, None] == kseg_ref[0][None, :],
+                          s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])
         dp = jax.lax.dot_general(do, vj, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -434,11 +497,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                          *refs, causal: bool,
                           scale: float, window: int | None = None,
-                          n_qb_total: int = 0):
+                          n_qb_total: int = 0, segmented: bool = False):
     """dK/dV for one (batch*head, kv-block, q-block) cell; q streams on
     the inner grid dimension, accumulating into the kv block's scratch."""
+    if segmented:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = refs
     jq = pl.program_id(2)
     n_qb = pl.num_programs(2)
     block_k = k_ref.shape[1]
@@ -476,6 +543,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = jnp.where(_keep_mask(s.shape, row0, col0, window),
                           s, NEG_INF)
+        if segmented:
+            s = jnp.where(qseg_ref[0][:, None] == kseg_ref[0][None, :],
+                          s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])  # [block_q, block_k]
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -494,7 +564,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                      interpret=False, window=None):
+                      interpret=False, window=None, segment_ids=None):
     """Pallas dQ/dK/dV from the saved (out, lse) residuals."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -507,6 +577,13 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     # Lane-broadcast row vectors (TPU tiling; see _flash_kernel note).
     lane = lambda a: jnp.broadcast_to(a[:, :, None], (*a.shape, 128))
     lse_l, delta_l = lane(lse), lane(delta)
+    segmented = segment_ids is not None
+    segf = (jnp.repeat(segment_ids.astype(jnp.int32), h, axis=0)
+            if segmented else None)
+    # Rank-2 seg specs ride the SAME block index as their rank-3
+    # q/k twins ([:2] drops the trailing 0), so banded walks stay in
+    # lockstep.
+    seg_of = lambda at: ((1, at[0][1]), lambda bh, i, j: at[1](bh, i, j)[:2])
 
     vspec = lambda f: pl.BlockSpec(*f, memory_space=pltpu.VMEM)
     q_at = ((1, block_q, d), lambda bh, i, j: (bh, i, 0))
@@ -521,12 +598,18 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         dq_inner, kv_at_banded = n_kb, kv_at_inner
 
     def call_dq():
+        in_specs = [vspec(q_at), vspec(kv_at_banded), vspec(kv_at_banded),
+                    vspec(q_at), vspec(row_at), vspec(row_at)]
+        args = [qf, kf, vf, dof, lse_l, delta_l]
+        if segmented:
+            in_specs += [vspec(seg_of(q_at)), vspec(seg_of(kv_at_banded))]
+            args += [segf, segf]
         return pl.pallas_call(
             functools.partial(_flash_bwd_dq_kernel, causal=causal,
-                              scale=scale, window=window),
+                              scale=scale, window=window,
+                              segmented=segmented),
             grid=(b * h, lq // block_q, dq_inner),
-            in_specs=[vspec(q_at), vspec(kv_at_banded), vspec(kv_at_banded),
-                      vspec(q_at), vspec(row_at), vspec(row_at)],
+            in_specs=in_specs,
             out_specs=vspec(q_at),
             out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
@@ -535,7 +618,7 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
                 bytes_accessed=(qf.nbytes + kf.nbytes + vf.nbytes
                                 + dof.nbytes + lse_l.nbytes + delta_l.nbytes),
                 transcendentals=b * h * lq * lk),
-        )(qf, kf, vf, dof, lse_l, delta_l)
+        )(*args)
 
     kv_at = ((1, block_k, d), lambda bh, i, j: (bh, i, 0))
     q_at_inner = ((1, block_q, d), lambda bh, i, j: (bh, j, 0))
@@ -550,14 +633,19 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         dkv_inner, q_in, row_in = n_qb, q_at_inner, row_at_inner
 
     def call_dkv():
+        in_specs = [vspec(q_in), vspec(kv_at), vspec(kv_at),
+                    vspec(q_in), vspec(row_in),
+                    vspec(row_in)]
+        args = [qf, kf, vf, dof, lse_l, delta_l]
+        if segmented:
+            in_specs += [vspec(seg_of(q_in)), vspec(seg_of(kv_at))]
+            args += [segf, segf]
         return pl.pallas_call(
             functools.partial(_flash_bwd_dkv_kernel, causal=causal,
                               scale=scale, window=window,
-                              n_qb_total=n_qb),
+                              n_qb_total=n_qb, segmented=segmented),
             grid=(b * h, lk // block_k, dkv_inner),
-            in_specs=[vspec(q_in), vspec(kv_at), vspec(kv_at),
-                      vspec(q_in), vspec(row_in),
-                      vspec(row_in)],
+            in_specs=in_specs,
             out_specs=(vspec(kv_at), vspec(kv_at)),
             out_shape=(jax.ShapeDtypeStruct((b * h, lk, d), k.dtype),
                        jax.ShapeDtypeStruct((b * h, lk, d), v.dtype)),
@@ -568,7 +656,7 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
                 bytes_accessed=(qf.nbytes + kf.nbytes + vf.nbytes
                                 + dof.nbytes + lse_l.nbytes + delta_l.nbytes),
                 transcendentals=b * h * lq * lk),
-        )(qf, kf, vf, dof, lse_l, delta_l)
+        )(*args)
 
     if interpret:
         with pltpu.force_tpu_interpret_mode():
@@ -593,7 +681,7 @@ def _use_pallas(q, k, block_q, block_k) -> bool:
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
                     block_q: int = 256, block_k: int = 512,
-                    window: int | None = None):
+                    window: int | None = None, segment_ids=None):
     """Fused attention: Pallas kernel on TPU, blockwise jnp elsewhere.
 
     Differentiable with O(L) residuals both ways: on the Pallas path
@@ -607,40 +695,51 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     kernels skip kv blocks entirely beyond the lookback, so compute per
     query is O(window), not O(L) — the long-context local-attention
     primitive (Mistral-style).
+
+    ``segment_ids [B, L]`` int32 (packed sequences): attention is
+    masked to within-segment pairs on every tier, forward and backward
+    — the packed-document training primitive.  An integer input: its
+    cotangent is None.
     """
     _check_window(window, causal)
     s = _scale_for(q, scale)
     if _use_pallas(q, k, block_q, block_k):
         return _flash_pallas(q, k, v, causal, s, block_q, block_k,
-                             with_lse=False, window=window)[0]
+                             with_lse=False, window=window,
+                             segment_ids=segment_ids)[0]
     return blockwise_attention(q, k, v, causal=causal, scale=s,
-                               block_k=block_k, window=window)
+                               block_k=block_k, window=window,
+                               segment_ids=segment_ids)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None,
+               segment_ids=None):
     _check_window(window, causal)
     s = _scale_for(q, scale)
     if _use_pallas(q, k, block_q, block_k):
         out, lse = _flash_pallas(q, k, v, causal, s, block_q, block_k,
-                                 window=window)
-        return out, (q, k, v, out, lse)
+                                 window=window, segment_ids=segment_ids)
+        return out, (q, k, v, out, lse, segment_ids)
     out = blockwise_attention(q, k, v, causal=causal, scale=s,
-                              block_k=block_k, window=window)
-    return out, (q, k, v, None, None)
+                              block_k=block_k, window=window,
+                              segment_ids=segment_ids)
+    return out, (q, k, v, None, None, segment_ids)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, window, res, g):
-    q, k, v, out, lse = res
+    q, k, v, out, lse, segment_ids = res
     s = _scale_for(q, scale)
     if lse is not None:
-        return _flash_pallas_bwd(q, k, v, out, lse, g, causal, s,
-                                 block_q, block_k, window=window)
+        dq, dk, dv = _flash_pallas_bwd(q, k, v, out, lse, g, causal, s,
+                                       block_q, block_k, window=window,
+                                       segment_ids=segment_ids)
+        return dq, dk, dv, None
     _, vjp = jax.vjp(
         lambda q, k, v: blockwise_attention(
             q, k, v, causal=causal, scale=s, block_k=block_k,
-            window=window),
+            window=window, segment_ids=segment_ids),
         q, k, v)
-    return vjp(g)
+    return (*vjp(g), None)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -177,6 +177,10 @@ class LMTrainer(CheckpointingBase):
                 f"(mesh has pipeline={n_pipe})")
         self.microbatches = microbatches or (2 * n_pipe if n_pipe > 1 else 1)
 
+        # segments (packed sequences) ride only the default flash
+        # attention; the pipelined and ring trunks would silently skip
+        # the attention-side mask, so train() rejects the combination.
+        self._supports_segments = n_pipe == 1 and n_seq == 1
         if n_pipe > 1:
             # PP x SP: the pipeline shard_map goes manual over
             # {pipeline, seq} and runs the ring attention body per stage.
@@ -191,18 +195,20 @@ class LMTrainer(CheckpointingBase):
             fwd_kw = {"hidden_fn" if chunked else "apply_fn": fwd}
             self._step_builder = lambda opt: tfm.make_train_step(
                 cfg, opt, grad_accum=grad_accum, **fwd_kw)
-            self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg, **fwd_kw)
+            self._nll_fn = lambda p, t, seg=None: tfm.lm_nll(p, t, cfg,
+                                                             **fwd_kw)
         elif n_seq > 1:
             ring = make_ring_attention(self.mesh, causal=True,
                                        window=cfg.attention_window)
             self._step_builder = lambda opt: tfm.make_train_step(
                 cfg, opt, attention_fn=ring, grad_accum=grad_accum)
-            self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg,
-                                                   attention_fn=ring)
+            self._nll_fn = lambda p, t, seg=None: tfm.lm_nll(
+                p, t, cfg, attention_fn=ring)
         else:
             self._step_builder = lambda opt: tfm.make_train_step(
                 cfg, opt, grad_accum=grad_accum)
-            self._nll_fn = lambda p, t: tfm.lm_nll(p, t, cfg)
+            self._nll_fn = lambda p, t, seg=None: tfm.lm_nll(
+                p, t, cfg, segment_ids=seg)
 
     # ------------------------------------------------------------------
 
@@ -255,7 +261,9 @@ class LMTrainer(CheckpointingBase):
         return psh, osh
 
     def train(self, dataset: Dataset | np.ndarray, params=None,
-              eval_tokens: np.ndarray | None = None):
+              eval_tokens: np.ndarray | None = None,
+              segments: np.ndarray | None = None,
+              eval_segments: np.ndarray | None = None):
         """Train over the token rows; returns the trained params pytree.
 
         ``eval_tokens [M, seq+1]`` (with ``eval_every``) runs a held-out
@@ -263,6 +271,12 @@ class LMTrainer(CheckpointingBase):
         and once at the end (round -1) into ``eval_history``; fed in
         ``batch_size`` chunks, dropping a remainder of up to
         ``batch_size - 1`` rows (static shapes, one compiled program).
+
+        ``segments`` (with optional ``eval_segments``): packed-sequence
+        segment ids aligned with the rows (data/packing.pack_documents)
+        — attention stays within-document and the loss skips boundary/
+        padding targets.  Default flash-attention meshes only (a
+        pipeline or seq axis would skip the attention-side mask).
 
         Multi-process: BOTH ``dataset`` and ``eval_tokens`` are this
         host's shard (e.g. ``rows[process_index::process_count]``), and
@@ -275,6 +289,20 @@ class LMTrainer(CheckpointingBase):
                   else dataset[self.tokens_col])
         if tokens.ndim != 2:
             raise ValueError(f"tokens must be [N, seq+1], got {tokens.shape}")
+        if segments is not None:
+            if not self._supports_segments:
+                raise ValueError(
+                    "segments (packed sequences) need the default "
+                    "flash-attention path; this mesh has a pipeline or "
+                    "seq axis, whose trunks do not carry the "
+                    "attention-side segment mask yet")
+            if segments.shape != tokens.shape:
+                raise ValueError(
+                    f"segments must align with the token rows "
+                    f"{tokens.shape}, got {segments.shape}")
+        if eval_segments is not None and segments is None:
+            raise ValueError("eval_segments without segments — pack "
+                             "train and eval the same way")
         # Multi-process SPMD: every process runs this same loop over its
         # OWN rows (feed tokens[process_index::process_count] or
         # Dataset.shard) — all hosts must pass the same row count or
@@ -310,6 +338,8 @@ class LMTrainer(CheckpointingBase):
 
             perm = np.random.default_rng(self.seed).permutation(len(tokens))
             tokens = gather_rows(tokens, perm)  # gather_rows coerces to C-order
+            if segments is not None:
+                segments = gather_rows(segments, perm)
 
         self.eval_history = []
         if self.eval_every and eval_tokens is None:
@@ -321,6 +351,11 @@ class LMTrainer(CheckpointingBase):
                 raise ValueError(
                     f"eval_tokens must be [M, {tokens.shape[1]}] like the "
                     f"training rows, got {eval_tokens.shape}")
+            if (eval_segments is not None
+                    and eval_segments.shape != eval_tokens.shape):
+                raise ValueError(
+                    f"eval_segments must align with eval_tokens "
+                    f"{eval_tokens.shape}, got {eval_segments.shape}")
             if len(eval_tokens) < global_bs // n_proc:
                 raise ValueError(
                     f"eval_tokens has {len(eval_tokens)} rows; one eval "
@@ -359,8 +394,10 @@ class LMTrainer(CheckpointingBase):
                 # (scattered params under FSDP, Megatron splits under TP)
                 # across steps instead of resharding at its own whim.
                 # The pipelined trunk is exempt: its manual shard_map
-                # governs placement internally.
-                in_sh = ((psh, osh), step_sh) + ((rep,) if dropping else ())
+                # governs placement internally.  rng and segment slots
+                # are always present positionally (None when unused —
+                # an empty pytree binds no sharding).
+                in_sh = ((psh, osh), step_sh, rep, step_sh)
                 jit_kw = dict(in_shardings=in_sh,
                               out_shardings=((psh, osh), rep))
             step = jax.jit(self._step_builder(self.optimizer),
@@ -384,11 +421,33 @@ class LMTrainer(CheckpointingBase):
                         np.asarray(eval_tokens[j:j + eval_bs], np.int32),
                         tok_sh)
                     for j in range(0, n_eval, eval_bs)]
+                eval_seg_chunks = eval_weights = None
+                if eval_segments is not None:
+                    eval_seg_chunks, eval_weights = [], []
+                    for j in range(0, n_eval, eval_bs):
+                        seg = np.asarray(eval_segments[j:j + eval_bs],
+                                         np.int32)
+                        eval_seg_chunks.append(
+                            self._global_batch(seg, tok_sh))
+                        # Packed chunks carry different VALID-target
+                        # counts; each chunk's mean NLL must be
+                        # weighted by its count or the corpus mean is
+                        # biased toward padding-heavy tail chunks.
+                        eval_weights.append(int(
+                            ((seg[:, 1:] == seg[:, :-1])
+                             & (seg[:, :-1] != 0)).sum()))
 
                 def eval_fn(carry, rnd):
                     ps = carry[0]
-                    mean = sum(float(nll(ps, c))
-                               for c in eval_chunks) / len(eval_chunks)
+                    if eval_seg_chunks is None:
+                        mean = sum(float(nll(ps, c))
+                                   for c in eval_chunks) / len(eval_chunks)
+                    else:
+                        tot = sum(w * float(nll(ps, c, sc))
+                                  for c, sc, w in zip(
+                                      eval_chunks, eval_seg_chunks,
+                                      eval_weights))
+                        mean = tot / max(sum(eval_weights), 1)
                     self.eval_history.append(
                         (rnd, {"loss": mean,
                                "perplexity": nll_to_perplexity(mean)}))
@@ -425,6 +484,15 @@ class LMTrainer(CheckpointingBase):
                     if rnd <= start:
                         continue
                     block = np.asarray(tokens[i:i + rows_per_step], np.int32)
+                    seg_batch = None
+                    if segments is not None:
+                        seg_block = np.asarray(
+                            segments[i:i + rows_per_step], np.int32)
+                        if self.grad_accum > 1:
+                            seg_block = seg_block.reshape(
+                                self.grad_accum, global_bs // n_proc,
+                                seg_block.shape[1])
+                        seg_batch = self._global_batch(seg_block, step_sh)
                     if self.grad_accum > 1:
                         block = block.reshape(self.grad_accum,
                                               global_bs // n_proc,
@@ -433,11 +501,9 @@ class LMTrainer(CheckpointingBase):
                     if self.profile_dir and rnd == prof_start:
                         jax.profiler.start_trace(self.profile_dir)
                         profiling = True
-                    if dropping:
-                        carry, loss = step(
-                            carry, batch, jax.random.fold_in(drop_base, rnd))
-                    else:
-                        carry, loss = step(carry, batch)
+                    rng = (jax.random.fold_in(drop_base, rnd)
+                           if dropping else None)
+                    carry, loss = step(carry, batch, rng, seg_batch)
                     if (profiling
                             and rnd >= prof_start - 1 + self.profile_steps):
                         jax.block_until_ready(loss)  # flush async device work

@@ -80,6 +80,12 @@ MULTIPROCESS = {
 }
 
 SLOW = MULTIPROCESS | {
+    "test_packing::test_packed_forward_equals_separate_docs",
+    "test_packing::test_pallas_interpret_segments_fwd_bwd",
+    "test_packing::test_lm_trainer_packed_tp_fsdp_mesh",
+    "test_packing::test_packed_loss_equals_weighted_separate_losses",
+    "test_packing::test_lm_trainer_packed_end_to_end",
+    "test_packing::test_flash_fallback_segments_grads_match_naive",
     "test_speculative::test_decode_chunk_matches_decode_step",
     "test_speculative::test_decode_chunk_per_row_offsets",
     "test_speculative::test_greedy_matches_generate",
