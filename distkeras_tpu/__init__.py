@@ -90,6 +90,7 @@ from distkeras_tpu.trainers import (
     AveragingTrainer,
     EnsembleTrainer,
     LMTrainer,
+    LoRATrainer,
 )
 
 __all__ = [
@@ -133,4 +134,5 @@ __all__ = [
     "AveragingTrainer",
     "EnsembleTrainer",
     "LMTrainer",
+    "LoRATrainer",
 ]

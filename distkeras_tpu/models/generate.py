@@ -386,6 +386,14 @@ def top_p_mask(logits, p: float):
     return jnp.where(logits < thr, -jnp.inf, logits)
 
 
+def _device_tree(params):
+    """Coerce a host-numpy tree (load_lm output) to jnp leaves: a raw
+    numpy leaf cannot be fancy-indexed by the scan's traced tokens
+    (TracerArrayConversionError); asarray is a no-op for leaves already
+    on device, so placed/sharded trees pass through untouched."""
+    return jax.tree.map(jnp.asarray, params)
+
+
 def _check_decode_budget(p: int, max_new_tokens: int,
                          cfg: TransformerConfig,
                          eos_token: int | None,
@@ -487,6 +495,7 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     a trained model is bounded in
     tests/test_generate.py::test_moe_capacity_vs_dense_divergence_bounded.
     """
+    params = _device_tree(params)
     b, p = prompt.shape
     total = _check_decode_budget(p, max_new_tokens, cfg, eos_token,
                                  rolling_ok=prompt_lengths is None)
@@ -607,6 +616,7 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     ragged batches); quantized trees decode like everywhere else, but
     force the sequential prompt path.
     """
+    params = _device_tree(params)
     b, p = prompt.shape
     w = beam_width
     if max_new_tokens < 1:

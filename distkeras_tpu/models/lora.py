@@ -1,0 +1,167 @@
+"""LoRA fine-tuning: low-rank adapters over a frozen transformer base.
+
+Full fine-tuning updates (and keeps optimizer moments for) every
+parameter; LoRA trains a rank-r delta ``W + (alpha/r) * A @ B`` on the
+chosen projections only — the adapter tree is ~1000x smaller than the
+base at typical ranks, so optimizer state shrinks accordingly and the
+finished artifact is a small delta that merges back into the base for
+serving (``lora_merge`` -> every decode path in models/generate and
+models/speculative works unchanged).
+
+TPU-first design choice: the adapters merge into the base INSIDE the
+jitted step (one fused add per target weight, O(params) elementwise —
+noise next to the matmuls) instead of patching each matmul with a
+second low-rank contraction.  The forward therefore stays byte-for-byte
+the standard :func:`~distkeras_tpu.models.transformer.apply`, which
+means LoRA composes with every mesh axis, attention path (ring,
+window, pipeline), remat policy, chunked CE, and packed segments with
+zero new parallelism code — GSPMD shards the merge like any other
+elementwise op.  Gradients flow only into A/B (the base is
+stop_gradient'ed; its zero cotangents fold away in XLA).
+
+The reference has no fine-tuning story (it trains Keras models from
+scratch, reference: distkeras/trainers.py); this module is TPU-first
+surplus on the train-then-adapt axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.models import transformer as tfm
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    """``rank`` r and scale ``alpha`` (delta = alpha/r * A@B);
+    ``targets`` name the adapted weights: attention projections
+    ("wq", "wk", "wv", "wo") and/or the dense-FFN mats ("w1", "w2")."""
+
+    rank: int = 8
+    alpha: float = 16.0
+    targets: tuple = ("wq", "wv")
+
+
+# target -> (group, a-shape fn, b-shape fn, merge einsum).  Shapes get
+# (cfg, r); the leading L axis stacks layers like every other param.
+_ATTN = {
+    "wq": (lambda c, r: (c.n_layers, c.d_model, r),
+           lambda c, r: (c.n_layers, r, c.n_heads, c.head_dim),
+           "ldr,lrhk->ldhk"),
+    "wk": (lambda c, r: (c.n_layers, c.d_model, r),
+           lambda c, r: (c.n_layers, r, c.kv_heads, c.head_dim),
+           "ldr,lrhk->ldhk"),
+    "wv": (lambda c, r: (c.n_layers, c.d_model, r),
+           lambda c, r: (c.n_layers, r, c.kv_heads, c.head_dim),
+           "ldr,lrhk->ldhk"),
+    "wo": (lambda c, r: (c.n_layers, c.n_heads, c.head_dim, r),
+           lambda c, r: (c.n_layers, r, c.d_model),
+           "lhkr,lrd->lhkd"),
+}
+_FFN = {
+    "w1": (lambda c, r: (c.n_layers, c.d_model, r),
+           lambda c, r: (c.n_layers, r, c.d_ff),
+           "ldr,lrf->ldf"),
+    "w2": (lambda c, r: (c.n_layers, c.d_ff, r),
+           lambda c, r: (c.n_layers, r, c.d_model),
+           "lfr,lrd->lfd"),
+}
+
+
+def _validate(cfg: tfm.TransformerConfig, lcfg: LoRAConfig):
+    known = set(_ATTN) | set(_FFN)
+    bad = set(lcfg.targets) - known
+    if bad:
+        raise ValueError(f"unknown LoRA targets {sorted(bad)}; "
+                         f"known: {sorted(known)}")
+    if not lcfg.targets:
+        raise ValueError("LoRAConfig.targets is empty — nothing to train")
+    if len(set(lcfg.targets)) != len(lcfg.targets):
+        raise ValueError(
+            f"duplicate LoRA targets in {lcfg.targets} — likely a typo "
+            "for a different projection; a duplicate would silently "
+            "collapse into one adapter")
+    if lcfg.rank < 1:
+        raise ValueError(f"rank must be >= 1, got {lcfg.rank}")
+    if cfg.num_experts and set(lcfg.targets) & set(_FFN):
+        raise ValueError(
+            "LoRA FFN targets (w1/w2) need a dense-FFN config; this MoE "
+            "config's expert mats are not adapted (attention targets "
+            "work fine on MoE configs)")
+
+
+def lora_init(rng, cfg: tfm.TransformerConfig, lcfg: LoRAConfig):
+    """Adapter tree {"attn": {name: {"a", "b"}}, "ffn": {...}}.
+
+    Standard LoRA init: A ~ N(0, 1/sqrt(d_in)), B = 0 — the delta
+    starts at exactly zero, so step 0 reproduces the base model.
+    """
+    _validate(cfg, lcfg)
+    tree = {}
+    keys = jax.random.split(rng, len(lcfg.targets))
+    for key, name in zip(keys, sorted(lcfg.targets)):
+        group, specs = (("attn", _ATTN) if name in _ATTN
+                        else ("ffn", _FFN))
+        a_shape = specs[name][0](cfg, lcfg.rank)
+        b_shape = specs[name][1](cfg, lcfg.rank)
+        fan_in = math.prod(a_shape[1:-1])
+        tree.setdefault(group, {})[name] = {
+            "a": (jax.random.normal(key, a_shape, jnp.float32)
+                  / math.sqrt(fan_in)),
+            "b": jnp.zeros(b_shape, jnp.float32),
+        }
+    return tree
+
+
+def lora_merge(params, adapters, cfg: tfm.TransformerConfig,
+               lcfg: LoRAConfig):
+    """Base params + scaled low-rank deltas -> a servable params tree
+    (same structure as ``tfm.init_params``; feed to apply/generate/
+    quantize_params/save_lm unchanged)."""
+    _validate(cfg, lcfg)
+    scale = lcfg.alpha / lcfg.rank
+    params = dict(params)
+    layers = dict(params["layers"])
+    for group, specs in (("attn", _ATTN), ("ffn", _FFN)):
+        if group not in adapters:
+            continue
+        sub = dict(layers[group])
+        for name, ab in adapters[group].items():
+            eq = specs[name][2]
+            delta = jnp.einsum(eq, ab["a"], ab["b"]) * scale
+            sub[name] = sub[name] + delta.astype(sub[name].dtype)
+        layers[group] = sub
+    params["layers"] = layers
+    return params
+
+
+def make_lora_loss(cfg: tfm.TransformerConfig, lcfg: LoRAConfig):
+    """An ``lm_loss``-signature callable over the packed
+    ``(adapters, base)`` tree: merges (base frozen via stop_gradient)
+    then defers to :func:`~distkeras_tpu.models.transformer.lm_loss` —
+    plug into ``make_train_step(..., loss_fn=...)``."""
+
+    def loss(packed, tokens, cfg_, attention_fn=None, apply_fn=None,
+             dropout_rng=None, hidden_fn=None, segment_ids=None):
+        adapters, base = packed
+        merged = lora_merge(jax.lax.stop_gradient(base), adapters,
+                            cfg_, lcfg)
+        return tfm.lm_loss(merged, tokens, cfg_, attention_fn, apply_fn,
+                           dropout_rng, hidden_fn, segment_ids)
+
+    del cfg
+    return loss
+
+
+def lora_mask(packed):
+    """Trainability mask over the packed ``(adapters, base)`` tree for
+    ``optax.masked``: True on adapter leaves, False on the base — the
+    optimizer allocates moments for the adapters ONLY (the memory win
+    that makes LoRA LoRA)."""
+    adapters, base = packed
+    return (jax.tree.map(lambda _: True, adapters),
+            jax.tree.map(lambda _: False, base))

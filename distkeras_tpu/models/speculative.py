@@ -124,6 +124,10 @@ def speculative_generate(params, draft_params, prompt, cfg: TransformerConfig,
     trees work — the chunk path dequantizes per read, and the prompt
     falls back to sequential warm for a quantized tree.
     """
+    from distkeras_tpu.models.generate import _device_tree
+
+    params = _device_tree(params)
+    draft_params = _device_tree(draft_params)
     b, p = prompt.shape
     total = _validate(params, draft_params, cfg, draft_cfg, p,
                       max_new_tokens, n_draft, temperature, key)

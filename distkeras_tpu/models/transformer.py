@@ -868,7 +868,8 @@ def make_train_step(cfg: TransformerConfig, optimizer,
                     attention_fn: Callable | None = None,
                     apply_fn: Callable | None = None,
                     grad_accum: int = 1,
-                    hidden_fn: Callable | None = None):
+                    hidden_fn: Callable | None = None,
+                    loss_fn: Callable | None = None):
     """``step((params, opt_state), tokens) -> ((params', opt_state'), loss)``.
 
     Pure; callers jit it with NamedShardings (see __graft_entry__ and
@@ -880,12 +881,18 @@ def make_train_step(cfg: TransformerConfig, optimizer,
     microbatch loop is unrolled, not scanned: attention_fn may close
     over shard_map/pallas calls whose tracing under scan complicates
     sharding (same reason apply() unrolls its layer loop).
+
+    ``loss_fn`` (default :func:`lm_loss`) must share lm_loss's
+    signature; a custom hook reinterprets the differentiated "params"
+    tree (e.g. models/lora's (adapters, base) packing, which merges
+    before calling lm_loss).
     """
     dropping = cfg.dropout > 0
 
     def step(carry, tokens, dropout_rng=None, segment_ids=None):
         params, opt_state = carry
-        grad_fn = jax.value_and_grad(lm_loss)
+        grad_fn = jax.value_and_grad(loss_fn if loss_fn is not None
+                                     else lm_loss)
         if dropping and dropout_rng is None:
             raise ValueError(
                 f"cfg.dropout={cfg.dropout} but the train step got no "

@@ -4,7 +4,7 @@ from distkeras_tpu.trainers.distributed import (
     ADAG,
     DynSGD,
 )
-from distkeras_tpu.trainers.lm import LMTrainer
+from distkeras_tpu.trainers.lm import LMTrainer, LoRATrainer
 from distkeras_tpu.trainers.elastic import (
     AEASGD,
     EAMSGD,
@@ -25,4 +25,5 @@ __all__ = [
     "AveragingTrainer",
     "EnsembleTrainer",
     "LMTrainer",
+    "LoRATrainer",
 ]

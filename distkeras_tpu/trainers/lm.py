@@ -23,6 +23,7 @@ from __future__ import annotations
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -192,23 +193,21 @@ class LMTrainer(CheckpointingBase):
                 p, t, cfg, self.mesh, microbatches=self.microbatches,
                 seq_axis="seq" if n_seq > 1 else None,
                 return_hidden=chunked)
-            fwd_kw = {"hidden_fn" if chunked else "apply_fn": fwd}
-            self._step_builder = lambda opt: tfm.make_train_step(
-                cfg, opt, grad_accum=grad_accum, **fwd_kw)
-            self._nll_fn = lambda p, t, seg=None: tfm.lm_nll(p, t, cfg,
-                                                             **fwd_kw)
+            self._fwd_kw = {"hidden_fn" if chunked else "apply_fn": fwd}
         elif n_seq > 1:
             ring = make_ring_attention(self.mesh, causal=True,
                                        window=cfg.attention_window)
-            self._step_builder = lambda opt: tfm.make_train_step(
-                cfg, opt, attention_fn=ring, grad_accum=grad_accum)
-            self._nll_fn = lambda p, t, seg=None: tfm.lm_nll(
-                p, t, cfg, attention_fn=ring)
+            self._fwd_kw = {"attention_fn": ring}
         else:
-            self._step_builder = lambda opt: tfm.make_train_step(
-                cfg, opt, grad_accum=grad_accum)
-            self._nll_fn = lambda p, t, seg=None: tfm.lm_nll(
-                p, t, cfg, segment_ids=seg)
+            self._fwd_kw = {}
+        # _fwd_kw captures the mesh-specific forward once; the step and
+        # eval builders (and LoRATrainer's overrides) share it.
+        self._step_builder = lambda opt: tfm.make_train_step(
+            cfg, opt, grad_accum=grad_accum, **self._fwd_kw)
+        self._nll_fn = lambda p, t, seg=None: tfm.lm_nll(
+            p, t, cfg,
+            segment_ids=seg if self._supports_segments else None,
+            **self._fwd_kw)
 
     # ------------------------------------------------------------------
 
@@ -544,3 +543,89 @@ class LMTrainer(CheckpointingBase):
         self.history = [float(l) for l in losses]
         self.training_time = time.perf_counter() - t0
         return params
+
+
+class LoRATrainer(LMTrainer):
+    """Fine-tune a FROZEN pretrained base with LoRA adapters, under the
+    exact LMTrainer contract (history, eval, shuffle, checkpoints,
+    meshes, packing).
+
+    ``base_params``: the pretrained tree (tfm.init_params layout, e.g.
+    from ``dk.load_lm``).  The trained state is the packed
+    ``(adapters, base)`` pair: the optimizer is wrapped in
+    ``optax.masked`` so moments exist for the adapter leaves ONLY, the
+    loss stop-gradients the base, and the step's base output aliases
+    its input (donation keeps it in place).  ``train`` returns the
+    MERGED servable params (``self.adapters`` keeps the raw delta —
+    ship it with ``lora_merge`` for instant A/B of adapter versions).
+
+    The merge runs inside the jitted step, so every LMTrainer mesh
+    (TP, FSDP, ring, pipeline) and feature (grad_accum, segments,
+    chunked CE) composes unchanged.  Checkpoints store the packed pair
+    (base included — simple and correct; at LoRA scale the adapter
+    delta is the only part that changes between steps).
+    """
+
+    def __init__(self, cfg: tfm.TransformerConfig, base_params,
+                 lora_rank: int = 8, lora_alpha: float = 16.0,
+                 lora_targets=("wq", "wv"), **kw):
+        from distkeras_tpu.models.lora import (LoRAConfig, _validate,
+                                               lora_mask, make_lora_loss)
+
+        if base_params is None:
+            raise ValueError(
+                "LoRATrainer needs the pretrained base_params (load_lm "
+                "or a trained LMTrainer tree) — LoRA over a random base "
+                "is a sign the wrong trainer was picked")
+        self.lora = LoRAConfig(rank=lora_rank, alpha=lora_alpha,
+                               targets=tuple(lora_targets))
+        _validate(cfg, self.lora)
+        super().__init__(cfg, **kw)
+        self.optimizer = optax.masked(self.optimizer, lora_mask)
+        self._base_host = base_params
+        self.adapters = None
+        loss_fn = make_lora_loss(cfg, self.lora)
+        fwd_kw = self._fwd_kw
+        self._step_builder = lambda opt: tfm.make_train_step(
+            cfg, opt, grad_accum=self.grad_accum, loss_fn=loss_fn,
+            **fwd_kw)
+
+        def nll(packed, t, seg=None):
+            from distkeras_tpu.models.lora import lora_merge
+
+            adapters, base = packed
+            merged = lora_merge(base, adapters, cfg, self.lora)
+            return tfm.lm_nll(
+                merged, t, cfg,
+                segment_ids=seg if self._supports_segments else None,
+                **fwd_kw)
+
+        self._nll_fn = nll
+
+    def init_params(self):
+        from distkeras_tpu.models.lora import lora_init
+
+        adapters = lora_init(jax.random.key(self.seed + 1), self.cfg,
+                             self.lora)
+        # COPY the base into the packed state: the train loop donates
+        # its carry (the base aliases through the step, which is the
+        # point), so without a copy the first step would consume the
+        # caller's buffers and a second train()/serve on the same base
+        # would hit "Array has been deleted".
+        base = jax.tree.map(lambda x: jnp.array(x, copy=True),
+                            self._base_host)
+        packed = (adapters, base)
+        return self._put_global(
+            packed, self.plan.tree_shardings(self.mesh, packed))
+
+    def train(self, dataset, params=None, **kw):
+        from distkeras_tpu.models.lora import lora_merge
+
+        if params is not None:
+            raise ValueError(
+                "LoRATrainer builds its own (adapters, base) state from "
+                "the constructor's base_params; to resume, use "
+                "checkpoint_dir/resume like any trainer")
+        packed = super().train(dataset, **kw)
+        self.adapters, base = packed
+        return lora_merge(base, self.adapters, self.cfg, self.lora)

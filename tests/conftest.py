@@ -80,6 +80,11 @@ MULTIPROCESS = {
 }
 
 SLOW = MULTIPROCESS | {
+    "test_lora::test_lora_checkpoint_resume_matches_straight",
+    "test_lora::test_merged_model_serves",
+    "test_lora::test_zero_init_merge_is_identity",
+    "test_lora::test_lora_composes_with_tp_mesh_and_segments",
+    "test_lora::test_finetune_trains_adapters_and_freezes_base",
     "test_packing::test_packed_forward_equals_separate_docs",
     "test_packing::test_pallas_interpret_segments_fwd_bwd",
     "test_packing::test_lm_trainer_packed_tp_fsdp_mesh",

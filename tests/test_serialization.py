@@ -74,3 +74,26 @@ def test_save_lm_rejects_quantized(tmp_path):
     qp = quantize_params(tfm.init_params(jax.random.key(0), cfg))
     with pytest.raises(ValueError, match="full-precision"):
         dk.save_lm(str(tmp_path / "q.npz"), qp, cfg)
+
+
+def test_load_lm_decodes_eagerly_without_jit(tmp_path, rng):
+    """load_lm's host-numpy tree must decode WITHOUT an explicit outer
+    jit: generate's scan closes over the params, and a raw numpy leaf
+    cannot be fancy-indexed by traced tokens (regression — the decode
+    entries coerce the tree with _device_tree)."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.models.generate import beam_search, generate
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=1, d_ff=64, max_len=24)
+    params = tfm.init_params(jax.random.key(1), cfg)
+    path = str(tmp_path / "lm.npz")
+    dk.save_lm(path, params, cfg)
+    loaded, cfg2 = dk.load_lm(path)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)), jnp.int32)
+    want = np.asarray(generate(params, prompt, cfg, 5))
+    np.testing.assert_array_equal(
+        np.asarray(generate(loaded, prompt, cfg2, 5)), want)
+    seqs, _ = beam_search(loaded, prompt, cfg2, 4, beam_width=2)
+    assert np.asarray(seqs).shape == (2, 2, 8)
