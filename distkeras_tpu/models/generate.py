@@ -24,6 +24,7 @@ import numpy as np
 
 from distkeras_tpu.models.transformer import (
     TransformerConfig,
+    _moe_dense_block,
     _moe_gates,
     _rms_norm,
     _unembed,
@@ -316,7 +317,18 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig):
                            deq(lp["attn"]["wo"]))
 
         h = _rms_norm(x, lp["ln2_scale"])
-        if cfg.num_experts:
+        if cfg.num_experts and t_len > 1:
+            # Multi-token chunks take the batched dense-routing block
+            # (all experts on all tokens, one-hot combine): peak memory
+            # is [B, T, E, F] ACTIVATIONS, where the per-token weight
+            # gather below would materialize B*T*k copies of the [D, F]
+            # expert mats — GBs per layer at warm-chunk T.  Same math
+            # (_moe_gates shared), same decode-parity semantics.
+            y = _moe_dense_block(lp["moe"], h, cfg)
+        elif cfg.num_experts:
+            # T = 1 (the decode step): gather the k selected experts'
+            # slabs per row — fewer HBM bytes than all E at small
+            # batch, which is what the bandwidth-bound loop wants.
             router = jnp.einsum("btd,de->bte", h.astype(jnp.float32),
                                 lp["moe"]["wg"])
             gates, expert = _moe_gates(jax.nn.softmax(router, -1), cfg)

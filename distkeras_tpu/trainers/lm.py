@@ -426,15 +426,20 @@ class LMTrainer(CheckpointingBase):
                     for j in range(0, n_eval, eval_bs):
                         seg = np.asarray(eval_segments[j:j + eval_bs],
                                          np.int32)
-                        eval_seg_chunks.append(
-                            self._global_batch(seg, tok_sh))
+                        gseg = self._global_batch(seg, tok_sh)
+                        eval_seg_chunks.append(gseg)
                         # Packed chunks carry different VALID-target
                         # counts; each chunk's mean NLL must be
                         # weighted by its count or the corpus mean is
                         # biased toward padding-heavy tail chunks.
-                        eval_weights.append(int(
-                            ((seg[:, 1:] == seg[:, :-1])
-                             & (seg[:, :-1] != 0)).sum()))
+                        # Counted on the assembled GLOBAL chunk (not
+                        # the host-local shard): nll() returns the
+                        # global mean, and every process must weight
+                        # it identically or multi-host eval_history
+                        # desynchronizes.
+                        eval_weights.append(int(jnp.sum(
+                            (gseg[:, 1:] == gseg[:, :-1])
+                            & (gseg[:, :-1] != 0))))
 
                 def eval_fn(carry, rnd):
                     ps = carry[0]
