@@ -288,11 +288,22 @@ def _resolve_attention_fn(cfg: "TransformerConfig", attention_fn,
             q, k, v, True, window=cfg.attention_window,
             segment_ids=segment_ids)
     if segment_ids is not None:
-        raise ValueError(
-            "segment_ids with a custom attention_fn is not supported: "
-            "the packed-document mask must be applied inside the "
-            "attention implementation (ring attention does not carry "
-            "segments yet) — drop the custom fn or unpack the batch")
+        if getattr(attention_fn, "handles_segments", False):
+            # make_ring_attention sets the attribute: the fn takes the
+            # per-call segments itself (rotating the KV-side shard).
+            base_fn = attention_fn
+            attention_fn = lambda q, k, v: base_fn(
+                q, k, v, segment_ids=segment_ids)
+            attention_fn.handles_window = getattr(base_fn,
+                                                  "handles_window", None)
+        else:
+            raise ValueError(
+                "segment_ids with this custom attention_fn is not "
+                "supported: the packed-document mask must be applied "
+                "inside the attention implementation (set "
+                "fn.handles_segments = True and accept a segment_ids "
+                "kwarg, as make_ring_attention does) — or drop the "
+                "custom fn / unpack the batch")
     fn_window = getattr(attention_fn, "handles_window", None)
     if fn_window != cfg.attention_window:
         raise ValueError(

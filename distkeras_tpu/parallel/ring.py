@@ -35,7 +35,8 @@ from distkeras_tpu.ops.attention import (
 
 
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
-                   scale: float | None = None, window: int | None = None):
+                   scale: float | None = None, window: int | None = None,
+                   segment_ids=None):
     """Per-shard ring attention body; call inside ``shard_map``.
 
     ``q/k/v: [B, L_local, H, D]`` — the local shard of a sequence of
@@ -47,6 +48,12 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
     with the single-device result; hops whose KV shard lies entirely
     beyond the lookback contribute nothing (masked, still rotated —
     the ring must complete for the other devices).
+
+    ``segment_ids [B, L_local]`` (the local shard of packed-document
+    ids): the query-side shard stays put and a KV-side copy rotates
+    around the ring WITH its K/V shard, so every hop masks exactly the
+    cross-document pairs the single-device computation would — packed
+    long-context training over the seq axis.
     """
     _check_window(window, causal)
     axis_size = jax.lax.psum(1, axis_name)
@@ -56,8 +63,11 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
     s = _scale_for(q, scale)
     qf = q.astype(jnp.float32)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    # Segmented-ness is static at trace time: the unsegmented carry
+    # simply has no segment slot (no dead ppermute per hop).
+    segmented = segment_ids is not None
 
-    def update(m, l, o, kc, vc, hop):
+    def update(m, l, o, kc, vc, sc, hop):
         # After `hop` rotations we hold the KV shard originally on
         # (my_idx - hop) mod axis_size; offsets make causal masking
         # global-position-correct.
@@ -65,22 +75,27 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
         return attention_chunk(
             qf, kc.astype(jnp.float32), vc.astype(jnp.float32), m, l, o,
             causal, s, q_offset=my_idx * lq, kv_offset=src * lk,
-            window=window)
+            window=window, seg_q=segment_ids, seg_k=sc)
 
     def body(carry, hop):
-        m, l, o, kc, vc = carry
-        m, l, o = update(m, l, o, kc, vc, hop)
+        m, l, o, kc, vc, *sc = carry
+        m, l, o = update(m, l, o, kc, vc, sc[0] if segmented else None,
+                         hop)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        return (m, l, o, kc, vc), None
+        if segmented:
+            sc = [jax.lax.ppermute(sc[0], axis_name, perm)]
+        return (m, l, o, kc, vc, *sc), None
 
     # The last hop consumes its KV shard without rotating it onward —
     # scanning all `axis_size` hops would send one extra KV shard per
     # device over the ICI for nothing.
-    init = (*online_init(b, h, lq, d), k, v)
-    (m, l, o, kc, vc), _ = jax.lax.scan(
+    init = (*online_init(b, h, lq, d), k, v) + (
+        (segment_ids,) if segmented else ())
+    (m, l, o, kc, vc, *sc), _ = jax.lax.scan(
         body, init, jnp.arange(axis_size - 1))
-    m, l, o = update(m, l, o, kc, vc, axis_size - 1)
+    m, l, o = update(m, l, o, kc, vc, sc[0] if segmented else None,
+                     axis_size - 1)
     return online_finish(m, l, o).astype(q.dtype)
 
 
@@ -107,14 +122,25 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "seq",
     spec = P(batch_axis, axis_name, None, None)
     mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
+    seg_spec = P(batch_axis, axis_name)
+    mapped_seg = shard_map(
+        lambda q, k, v, seg: fn(q, k, v, segment_ids=seg),
+        mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+        out_specs=spec, check_vma=False)
 
-    def ring_fn(q, k, v):
-        return mapped(q, k, v)
+    def ring_fn(q, k, v, segment_ids=None):
+        if segment_ids is None:
+            return mapped(q, k, v)
+        return mapped_seg(q, k, v, segment_ids)
 
     # Tells apply_hidden's window guard WHICH window this attention_fn
     # implements; the guard requires it to equal cfg.attention_window
     # (a mismatched band would silently diverge train from decode).
     ring_fn.handles_window = window
+    # Tells _resolve_attention_fn this fn accepts packed segment_ids
+    # (it wraps the per-call segments in; fns without the attribute
+    # are rejected rather than silently skipping the attention mask).
+    ring_fn.handles_segments = True
     return ring_fn
 
 

@@ -178,10 +178,12 @@ class LMTrainer(CheckpointingBase):
                 f"(mesh has pipeline={n_pipe})")
         self.microbatches = microbatches or (2 * n_pipe if n_pipe > 1 else 1)
 
-        # segments (packed sequences) ride only the default flash
-        # attention; the pipelined and ring trunks would silently skip
-        # the attention-side mask, so train() rejects the combination.
-        self._supports_segments = n_pipe == 1 and n_seq == 1
+        # segments (packed sequences) ride the default flash attention
+        # AND the ring (seq-axis) path — make_ring_attention rotates
+        # the KV-side segment shard with its K/V.  Only the pipelined
+        # trunk would silently skip the attention-side mask, so train()
+        # rejects that combination.
+        self._supports_segments = n_pipe == 1
         if n_pipe > 1:
             # PP x SP: the pipeline shard_map goes manual over
             # {pipeline, seq} and runs the ring attention body per stage.
@@ -274,8 +276,9 @@ class LMTrainer(CheckpointingBase):
         ``segments`` (with optional ``eval_segments``): packed-sequence
         segment ids aligned with the rows (data/packing.pack_documents)
         — attention stays within-document and the loss skips boundary/
-        padding targets.  Default flash-attention meshes only (a
-        pipeline or seq axis would skip the attention-side mask).
+        padding targets.  Works on every data/model/fsdp/expert mesh
+        and the ``seq`` (ring) axis; only a pipeline axis is rejected
+        (its trunk would silently skip the attention-side mask).
 
         Multi-process: BOTH ``dataset`` and ``eval_tokens`` are this
         host's shard (e.g. ``rows[process_index::process_count]``), and
@@ -291,10 +294,10 @@ class LMTrainer(CheckpointingBase):
         if segments is not None:
             if not self._supports_segments:
                 raise ValueError(
-                    "segments (packed sequences) need the default "
-                    "flash-attention path; this mesh has a pipeline or "
-                    "seq axis, whose trunks do not carry the "
-                    "attention-side segment mask yet")
+                    "segments (packed sequences) cannot ride a pipeline "
+                    "mesh: the pipelined trunk does not carry the "
+                    "attention-side segment mask; use a "
+                    "data/model/seq/fsdp mesh for packed training")
             if segments.shape != tokens.shape:
                 raise ValueError(
                     f"segments must align with the token rows "

@@ -113,9 +113,13 @@ def main():
     g_spec = bench("speculative (int8 draft)",
                    lambda: spec_fn(merged, q, prompt))
     agree8 = (g_plain[:, 8:] == g_int8[:, 8:]).mean()
-    assert (g_spec == g_plain).all() or agree8 > 0.5  # spec == target greedy
+    spec_agree = (g_spec == g_plain).mean()
+    # Speculative output IS the target's greedy rollout (float-tie
+    # argmax flips between the chunked and per-step programs are the
+    # only allowed divergence — rare).
+    assert spec_agree > 0.99, spec_agree
     print(f"[serve] int8 token agreement vs f32: {agree8:.2f}; "
-          f"speculative == plain greedy: {(g_spec == g_plain).all()}")
+          f"speculative vs plain greedy agreement: {spec_agree:.3f}")
 
 
 if __name__ == "__main__":

@@ -262,16 +262,76 @@ def test_packed_eval_weighted_by_valid_counts(rng):
                                rtol=1e-6)
 
 
-def test_lm_trainer_segments_rejected_on_ring_mesh(devices, rng):
+def test_ring_attention_segments_match_single(devices, rng):
+    """Ring attention with rotating KV-side segment shards equals the
+    single-device segmented attention exactly (the packed long-context
+    combination)."""
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+    from distkeras_tpu.parallel.ring import make_ring_attention
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
+    q, k, v = _qkv(rng, b=2, s=64)
+    seg = _segs(2, 64, splits=(13, 37))
+    for window in (None, 24):
+        ref = naive_attention(q, k, v, causal=True, window=window,
+                              segment_ids=seg)
+        ring = make_ring_attention(mesh, causal=True, window=window)
+        out = jax.jit(lambda q, k, v, s: ring(q, k, v, segment_ids=s),
+                      in_shardings=(None, None, None,
+                                    NamedSharding(mesh, P("data", "seq"))
+                                    ))(q, k, v, seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_packed_forward_ring_mesh_matches_default(devices, rng):
+    """apply() with segments on a seq mesh (ring path) == the default
+    flash path — one segment semantics across parallelism choices."""
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+    from distkeras_tpu.parallel.ring import make_ring_attention
+
+    mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
+    cfg = dataclasses.replace(CFG, max_len=33)
+    params = tfm.init_params(jax.random.key(2), cfg)
+    row = rng.integers(1, 64, (2, 32)).astype(np.int32)
+    seg = np.asarray(_segs(2, 32, splits=(11, 21)))
+    ref, _ = tfm.apply(params, jnp.asarray(row), cfg,
+                       segment_ids=jnp.asarray(seg))
+    ring = make_ring_attention(mesh, causal=True)
+    out, _ = tfm.apply(params, jnp.asarray(row), cfg, attention_fn=ring,
+                       segment_ids=jnp.asarray(seg))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_lm_trainer_packed_ring_mesh(devices, rng):
+    """Packed training runs on a seq (ring) mesh end to end."""
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    docs = [rng.integers(1, 64, (int(n),)).tolist()
+            for n in rng.integers(5, 28, 48)]
+    rows, segs = pack_documents(docs, seq_len=16)
+    cfg = dataclasses.replace(CFG, max_len=17)
+    n = (len(rows) // 8) * 8
+    mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
+    tr = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=8, num_epoch=2,
+                      mesh=mesh)
+    tr.train(rows[:n], segments=segs[:n])
+    assert tr.history[-1] < tr.history[0]
+
+
+def test_lm_trainer_segments_rejected_on_pipeline_mesh(devices, rng):
     from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
 
     docs = [rng.integers(1, 64, (10,)).tolist() for _ in range(8)]
     rows, segs = pack_documents(docs, seq_len=16)
     cfg = dataclasses.replace(CFG, max_len=17)
-    mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
+    mesh = make_mesh(MeshSpec(data=2, pipeline=2, seq=2),
+                     devices=devices)
     tr = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=8,
                       mesh=mesh)
-    with pytest.raises(ValueError, match="seq axis"):
+    with pytest.raises(ValueError, match="pipeline"):
         tr.train(rows[:8], segments=segs[:8])
 
 
