@@ -113,13 +113,14 @@ def main():
     g_spec = bench("speculative (int8 draft)",
                    lambda: spec_fn(merged, q, prompt))
     agree8 = (g_plain[:, 8:] == g_int8[:, 8:]).mean()
-    spec_agree = (g_spec == g_plain).mean()
-    # Speculative output IS the target's greedy rollout (float-tie
-    # argmax flips between the chunked and per-step programs are the
-    # only allowed divergence — rare).
-    assert spec_agree > 0.99, spec_agree
+    # Speculative output IS the target's greedy rollout — assert exact
+    # equality.  (A float-tie argmax flip between the chunked and
+    # per-step programs would cascade autoregressively from that
+    # position; none observed on this config — if one ever appears on
+    # other hardware, compare per row up to first divergence instead.)
+    assert (g_spec == g_plain).all()
     print(f"[serve] int8 token agreement vs f32: {agree8:.2f}; "
-          f"speculative vs plain greedy agreement: {spec_agree:.3f}")
+          f"speculative == plain greedy: True")
 
 
 if __name__ == "__main__":

@@ -93,6 +93,7 @@ SLOW = MULTIPROCESS | {
     "test_packing::test_packed_loss_equals_weighted_separate_losses",
     "test_packing::test_lm_trainer_packed_end_to_end",
     "test_packing::test_flash_fallback_segments_grads_match_naive",
+    "test_sharded_decode::test_speculative_tp_sharded_matches_single",
     "test_speculative::test_decode_chunk_matches_decode_step",
     "test_speculative::test_decode_chunk_per_row_offsets",
     "test_speculative::test_greedy_matches_generate",

@@ -116,3 +116,29 @@ def test_beam_search_fsdp_scattered_matches_single(devices, rng):
     np.testing.assert_array_equal(seqs, np.asarray(ref_seqs))
     np.testing.assert_allclose(scores, np.asarray(ref_scores),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_speculative_tp_sharded_matches_single(devices, rng):
+    """Speculative decoding under a TP mesh: target and draft params
+    both Megatron-sharded, tokens equal to the unsharded speculative
+    run (which itself equals generate's greedy rollout)."""
+    from distkeras_tpu.models.speculative import speculative_generate
+
+    d_cfg = tfm.TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
+                                  n_layers=1, d_ff=32, max_len=32)
+    params = tfm.init_params(jax.random.key(4), CFG)
+    draft = tfm.init_params(jax.random.key(5), d_cfg)
+    prompt = _prompt(rng, b=4, p=4)
+    ref, _ = speculative_generate(params, draft, prompt, CFG, d_cfg, 9,
+                                  n_draft=3)
+
+    mesh, psh = _tp_layout(devices, params)
+    dsh = ShardingPlan(rules=tfm.tp_rules()).tree_shardings(mesh, draft)
+    tsh = NamedSharding(mesh, P("data", None))
+    fn = jax.jit(
+        lambda tp, dp, pr: speculative_generate(tp, dp, pr, CFG, d_cfg,
+                                                9, n_draft=3)[0],
+        in_shardings=(psh, dsh, tsh))
+    out = fn(jax.device_put(params, psh), jax.device_put(draft, dsh),
+             jax.device_put(prompt, tsh))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
