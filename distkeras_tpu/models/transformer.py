@@ -658,7 +658,7 @@ def chunked_softmax_xent(hidden, emb, targets, n_chunks: int):
 def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
                     microbatches: int, attention_fn: Callable | None = None,
                     axis_name: str = "pipeline", seq_axis: str | None = None,
-                    return_hidden: bool = False):
+                    return_hidden: bool = False, segment_ids=None):
     """Forward pass with the layer trunk pipelined over ``axis_name``.
 
     Embedding and the head run outside the pipeline (they change shape);
@@ -677,14 +677,30 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
     shard_map) transposes cleanly under AD.  MoE routing/capacity then
     applies per sequence shard.
 
+    ``segment_ids [B, S]`` (packed sequences): every stage masks
+    attention to within-document pairs — the per-microbatch segment
+    slice rides the pipeline as make_pipeline ``extras`` (each stage
+    indexes the microbatch it is processing), sharded over ``seq_axis``
+    under PP x SP so the ring body receives its local shard.  Only the
+    default-flash and seq_axis attention paths carry segments (a custom
+    attention_fn raises, as in :func:`apply_hidden`).
+
     Returns (logits, aux).
     """
     import functools
 
     from distkeras_tpu.parallel.pipeline import make_pipeline
 
+    segmented = segment_ids is not None
+    if segmented and attention_fn is not None:
+        raise ValueError(
+            "segment_ids with a custom attention_fn is not supported "
+            "under the pipeline — use the default flash path or "
+            "seq_axis (see apply_hidden's guard)")
     x_spec = P()
-    if seq_axis is not None and int(mesh.shape[seq_axis]) > 1:
+    extras_spec = P() if segmented else None
+    ring_seq = seq_axis is not None and int(mesh.shape[seq_axis]) > 1
+    if ring_seq:
         if attention_fn is not None:
             raise ValueError(
                 "pass either attention_fn or seq_axis, not both: under "
@@ -696,7 +712,9 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
                                          causal=True,
                                          window=cfg.attention_window)
         x_spec = P(None, seq_axis)
-    else:
+        if segmented:
+            extras_spec = P(None, None, seq_axis)
+    elif not segmented:
         attention_fn = _resolve_attention_fn(cfg, attention_fn)
     n_stages = int(mesh.shape[axis_name])
     if cfg.n_layers % n_stages:
@@ -719,7 +737,7 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
 
     seq_sharded = x_spec != P()
 
-    def stage_fn(lp, u):
+    def stage_fn(lp, u, seg=None):
         rope_ang = None
         if cfg.rope:
             # Positions must be *global*: under PP x SP this body runs
@@ -729,16 +747,34 @@ def apply_pipelined(params, tokens, cfg: TransformerConfig, mesh,
                      if seq_sharded else 0)
             rope_ang = rope_angles(start + jnp.arange(l_loc), cfg.head_dim,
                                    cfg.rope_theta)[None, :, None, :]
+        if seg is None:
+            att = attention_fn
+        elif ring_seq:
+            # The ring body with this microbatch's LOCAL segment shard.
+            att = functools.partial(attention_fn, segment_ids=seg)
+        else:
+            # ONE definition of the default segmented flash path —
+            # shared with apply_hidden via the resolver.
+            att = _resolve_attention_fn(cfg, None, seg)
         aux_stage = jnp.zeros((), jnp.float32)
         for i in range(per_stage):
             li = jax.tree.map(lambda a: a[i], lp)
-            u, aux = block(li, u, cfg, attention_fn, rope_ang)
+            u, aux = block(li, u, cfg, att, rope_ang)
             aux_stage = aux_stage + aux
         return u, aux_stage
 
     pipe = make_pipeline(stage_fn, mesh, microbatches, axis_name,
-                         x_spec=x_spec)
-    x, aux_total = pipe(stage_params, x)
+                         x_spec=x_spec, extras_spec=extras_spec)
+    if segmented:
+        if segment_ids.shape != tokens.shape:
+            raise ValueError(
+                f"segment_ids must align with tokens {tokens.shape}, "
+                f"got {segment_ids.shape}")
+        seg_mb = jnp.asarray(segment_ids, jnp.int32).reshape(
+            microbatches, b // microbatches, s)
+        x, aux_total = pipe(stage_params, x, seg_mb)
+    else:
+        x, aux_total = pipe(stage_params, x)
     x = _rms_norm(x, params["ln_f_scale"])
     if return_hidden:
         # The head runs outside the pipeline, so the chunked-CE loss can
@@ -769,8 +805,10 @@ def _forward_nll(params, tokens, cfg: TransformerConfig,
     sequences): attention is segment-masked on the default trunk, and
     the loss EXCLUDES targets that cross a document boundary or sit in
     padding (segment 0) — the mean divides by the valid count.  A
-    custom apply_fn/hidden_fn gets only the loss masking (its forward
-    masks its own attention).
+    custom apply_fn/hidden_fn with ``handles_segments = True`` is
+    called as ``fn(params, inputs, seg)`` so its forward can mask
+    attention too (LMTrainer's pipelined fwd does); without the
+    attribute it gets only the loss masking.
     """
     if apply_fn is not None and hidden_fn is not None:
         raise ValueError("pass apply_fn or hidden_fn, not both")
@@ -810,15 +848,20 @@ def _forward_nll(params, tokens, cfg: TransformerConfig,
                                   .sum() / denom)
         return nll, aux
 
+    def call_custom(fn, *args):
+        if seg_in is not None and getattr(fn, "handles_segments", False):
+            return fn(*args, seg_in)
+        return fn(*args)
+
     if apply_fn is not None:
-        logits, aux = apply_fn(params, tokens[:, :-1])
+        logits, aux = call_custom(apply_fn, params, tokens[:, :-1])
         return full_head(logits, aux)
     if hidden_fn is None:
         hidden_fn = lambda p, t: apply_hidden(p, t, cfg, attention_fn,
                                               dropout_rng,
                                               moe_dense_routing,
                                               seg_in)
-    hidden, aux = hidden_fn(params, tokens[:, :-1])
+    hidden, aux = call_custom(hidden_fn, params, tokens[:, :-1])
     if cfg.ce_chunks > 1:
         nll, z_mean = chunked_softmax_xent(hidden, params["tok_emb"],
                                            targets, cfg.ce_chunks)

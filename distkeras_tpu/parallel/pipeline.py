@@ -28,7 +28,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def make_pipeline(stage_fn: Callable, mesh: Mesh, microbatches: int,
-                  axis_name: str = "pipeline", x_spec: P = P()):
+                  axis_name: str = "pipeline", x_spec: P = P(),
+                  extras_spec: P | None = None):
     """Build ``f(stage_params, x) -> (y, aux)`` running ``stage_fn`` as a
     pipeline.
 
@@ -40,6 +41,16 @@ def make_pipeline(stage_fn: Callable, mesh: Mesh, microbatches: int,
     (0 when it has none); stages that change activation shape belong
     outside the pipeline (embed / head), matching how GPipe slices a
     residual trunk.
+
+    ``extras_spec`` non-None adds a third input: per-microbatch
+    side data ``extras`` with leaves ``[microbatches, mb, ...]``
+    (e.g. packed-sequence segment ids).  It is NOT piped stage to
+    stage: every stage holds the whole (small) array and indexes the
+    microbatch it is currently processing (tick t, stage i works
+    microbatch t - i), receiving it as ``stage_fn(params, u, extra)``.
+    Bubble ticks see a clamped index — garbage in, garbage out, masked
+    like the activations.  The spec names any extra manual axes the
+    trailing dims shard over (e.g. ``P(None, None, 'seq')``).
 
     ``aux`` is the per-stage aux summed over stages, averaged over
     microbatches — each microbatch computes its own full-forward aux, so
@@ -63,7 +74,7 @@ def make_pipeline(stage_fn: Callable, mesh: Mesh, microbatches: int,
         raise ValueError(f"x_spec {x_spec} must not name the pipeline "
                          f"axis {axis_name!r}")
 
-    def run(stage_params, x):
+    def run(stage_params, x, *maybe_extras):
         for leaf in jax.tree.leaves(stage_params):
             if leaf.shape[0] != 1:
                 raise ValueError(
@@ -85,7 +96,12 @@ def make_pipeline(stage_fn: Callable, mesh: Mesh, microbatches: int,
             recv, outputs, aux_acc = carry
             t_in = jnp.clip(t, 0, microbatches - 1)
             inp = jnp.where(idx == 0, x_mb[t_in], recv)
-            out, aux = stage_fn(local, inp)
+            if maybe_extras:
+                cur = jnp.clip(t - idx, 0, microbatches - 1)
+                extra = jax.tree.map(lambda a: a[cur], maybe_extras[0])
+                out, aux = stage_fn(local, inp, extra)
+            else:
+                out, aux = stage_fn(local, inp)
             # Stage `idx` holds real microbatch t - idx at tick t; other
             # ticks are bubble garbage and must not pollute the aux sum.
             valid = (t >= idx) & (t - idx < microbatches)
@@ -114,13 +130,39 @@ def make_pipeline(stage_fn: Callable, mesh: Mesh, microbatches: int,
         # aux contributions live on every stage.
         return outputs.reshape(b, *x.shape[1:])[None], aux_acc[None]
 
+    if extras_spec is not None:
+        extras_axes = {a for dim in extras_spec for a in (
+            dim if isinstance(dim, tuple) else (dim,)) if a is not None}
+        if axis_name in extras_axes:
+            raise ValueError(
+                f"extras_spec {extras_spec} must not name the pipeline "
+                f"axis {axis_name!r} (extras are not piped stage to "
+                "stage; every stage holds the whole array)")
+        if not extras_axes <= extra_axes:
+            # out_specs claims y replicated over exactly x_spec's axes;
+            # an extras-only manual axis would make each shard compute
+            # a DIFFERENT y while check_vma=False suppresses the check
+            # — reject instead of returning silently wrong outputs.
+            raise ValueError(
+                f"extras_spec {extras_spec} names axes "
+                f"{sorted(extras_axes - extra_axes)} that x_spec "
+                f"{x_spec} does not — activations must be manual over "
+                "every axis the extras shard over")
+    in_specs = (P(axis_name), x_spec) + (
+        (extras_spec,) if extras_spec is not None else ())
     f = shard_map(run, mesh=mesh, axis_names={axis_name} | extra_axes,
-                  in_specs=(P(axis_name), x_spec),
+                  in_specs=in_specs,
                   out_specs=(P(axis_name, *x_spec), P(axis_name)),
                   check_vma=False)
 
-    def apply(stage_params, x):
-        ys, aux = f(stage_params, x)
+    def apply(stage_params, x, extras=None):
+        if (extras is not None) != (extras_spec is not None):
+            raise ValueError(
+                "extras and extras_spec must be provided together "
+                f"(extras_spec={'set' if extras_spec is not None else None},"
+                f" extras={'given' if extras is not None else None})")
+        args = (stage_params, x) + ((extras,) if extras is not None else ())
+        ys, aux = f(*args)
         return ys[-1], aux.sum() / microbatches
 
     return apply

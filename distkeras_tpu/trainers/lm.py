@@ -178,12 +178,11 @@ class LMTrainer(CheckpointingBase):
                 f"(mesh has pipeline={n_pipe})")
         self.microbatches = microbatches or (2 * n_pipe if n_pipe > 1 else 1)
 
-        # segments (packed sequences) ride the default flash attention
-        # AND the ring (seq-axis) path — make_ring_attention rotates
-        # the KV-side segment shard with its K/V.  Only the pipelined
-        # trunk would silently skip the attention-side mask, so train()
-        # rejects that combination.
-        self._supports_segments = n_pipe == 1
+        # segments (packed sequences) ride EVERY trunk: the default
+        # flash attention, the ring (seq-axis) path — make_ring_attention
+        # rotates the KV-side segment shard with its K/V — and the
+        # pipelined trunk (per-microbatch segment slices ride the
+        # pipeline as make_pipeline extras).
         if n_pipe > 1:
             # PP x SP: the pipeline shard_map goes manual over
             # {pipeline, seq} and runs the ring attention body per stage.
@@ -191,10 +190,14 @@ class LMTrainer(CheckpointingBase):
             # the loss takes the trunk's hidden states (hidden_fn) and
             # chunks the vocab head exactly like the un-pipelined path.
             chunked = cfg.ce_chunks > 1
-            fwd = lambda p, t: tfm.apply_pipelined(
-                p, t, cfg, self.mesh, microbatches=self.microbatches,
-                seq_axis="seq" if n_seq > 1 else None,
-                return_hidden=chunked)
+            def fwd(p, t, seg=None):
+                return tfm.apply_pipelined(
+                    p, t, cfg, self.mesh, microbatches=self.microbatches,
+                    seq_axis="seq" if n_seq > 1 else None,
+                    return_hidden=chunked, segment_ids=seg)
+            # _forward_nll calls fwd(params, inputs, seg) so the trunk
+            # masks attention, not just the loss.
+            fwd.handles_segments = True
             self._fwd_kw = {"hidden_fn" if chunked else "apply_fn": fwd}
         elif n_seq > 1:
             ring = make_ring_attention(self.mesh, causal=True,
@@ -208,7 +211,7 @@ class LMTrainer(CheckpointingBase):
             cfg, opt, grad_accum=grad_accum, **self._fwd_kw)
         self._nll_fn = lambda p, t, seg=None: tfm.lm_nll(
             p, t, cfg,
-            segment_ids=seg if self._supports_segments else None,
+            segment_ids=seg,
             **self._fwd_kw)
 
     # ------------------------------------------------------------------
@@ -276,9 +279,9 @@ class LMTrainer(CheckpointingBase):
         ``segments`` (with optional ``eval_segments``): packed-sequence
         segment ids aligned with the rows (data/packing.pack_documents)
         — attention stays within-document and the loss skips boundary/
-        padding targets.  Works on every data/model/fsdp/expert mesh
-        and the ``seq`` (ring) axis; only a pipeline axis is rejected
-        (its trunk would silently skip the attention-side mask).
+        padding targets.  Works on every mesh: data/model/fsdp/expert,
+        the ``seq`` (ring) axis, and pipeline meshes (per-microbatch
+        segment slices ride the pipeline).
 
         Multi-process: BOTH ``dataset`` and ``eval_tokens`` are this
         host's shard (e.g. ``rows[process_index::process_count]``), and
@@ -292,12 +295,6 @@ class LMTrainer(CheckpointingBase):
         if tokens.ndim != 2:
             raise ValueError(f"tokens must be [N, seq+1], got {tokens.shape}")
         if segments is not None:
-            if not self._supports_segments:
-                raise ValueError(
-                    "segments (packed sequences) cannot ride a pipeline "
-                    "mesh: the pipelined trunk does not carry the "
-                    "attention-side segment mask; use a "
-                    "data/model/seq/fsdp mesh for packed training")
             if segments.shape != tokens.shape:
                 raise ValueError(
                     f"segments must align with the token rows "
@@ -605,7 +602,7 @@ class LoRATrainer(LMTrainer):
             merged = lora_merge(base, adapters, cfg, self.lora)
             return tfm.lm_nll(
                 merged, t, cfg,
-                segment_ids=seg if self._supports_segments else None,
+                segment_ids=seg,
                 **fwd_kw)
 
         self._nll_fn = nll

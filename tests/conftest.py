@@ -87,7 +87,10 @@ SLOW = MULTIPROCESS | {
     "test_lora::test_finetune_trains_adapters_and_freezes_base",
     "test_packing::test_packed_forward_equals_separate_docs",
     "test_packing::test_packed_forward_ring_mesh_matches_default",
+    "test_packing::test_packed_forward_pipeline_matches_default",
     "test_packing::test_lm_trainer_packed_ring_mesh",
+    "test_packing::test_lm_trainer_packed_pipeline_mesh",
+    "test_packing::test_remat_composes_with_segments",
     "test_packing::test_pallas_interpret_segments_fwd_bwd",
     "test_packing::test_lm_trainer_packed_tp_fsdp_mesh",
     "test_packing::test_packed_loss_equals_weighted_separate_losses",

@@ -321,18 +321,68 @@ def test_lm_trainer_packed_ring_mesh(devices, rng):
     assert tr.history[-1] < tr.history[0]
 
 
-def test_lm_trainer_segments_rejected_on_pipeline_mesh(devices, rng):
+@pytest.mark.parametrize("with_seq", [False, True], ids=["pp", "ppxsp"])
+def test_packed_forward_pipeline_matches_default(devices, rng, with_seq):
+    """apply_pipelined with segments == the default segmented apply —
+    per-microbatch segment slices ride the pipeline (and shard over
+    seq under PP x SP)."""
     from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
 
-    docs = [rng.integers(1, 64, (10,)).tolist() for _ in range(8)]
+    spec = (MeshSpec(data=2, pipeline=2, seq=2) if with_seq
+            else MeshSpec(data=4, pipeline=2))
+    mesh = make_mesh(spec, devices=devices)
+    cfg = dataclasses.replace(CFG, max_len=33)
+    params = tfm.init_params(jax.random.key(3), cfg)
+    rows = rng.integers(1, 64, (4, 32)).astype(np.int32)
+    seg = np.asarray(_segs(4, 32, splits=(9, 23)))
+    ref, _ = tfm.apply(params, jnp.asarray(rows), cfg,
+                       segment_ids=jnp.asarray(seg))
+    out, _ = jax.jit(lambda p, t, s: tfm.apply_pipelined(
+        p, t, cfg, mesh, microbatches=2,
+        seq_axis="seq" if with_seq else None, segment_ids=s))(
+        params, jnp.asarray(rows), jnp.asarray(seg))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_lm_trainer_packed_pipeline_mesh(devices, rng):
+    """Packed training end to end on a PP x SP mesh."""
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    docs = [rng.integers(1, 64, (int(n),)).tolist()
+            for n in rng.integers(5, 28, 48)]
     rows, segs = pack_documents(docs, seq_len=16)
     cfg = dataclasses.replace(CFG, max_len=17)
+    n = (len(rows) // 8) * 8
     mesh = make_mesh(MeshSpec(data=2, pipeline=2, seq=2),
                      devices=devices)
-    tr = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=8,
+    tr = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=8, num_epoch=2,
                       mesh=mesh)
-    with pytest.raises(ValueError, match="pipeline"):
-        tr.train(rows[:8], segments=segs[:8])
+    tr.train(rows[:n], segments=segs[:n])
+    assert tr.history[-1] < tr.history[0]
+
+
+def test_remat_composes_with_segments(rng):
+    """remat=True with segment_ids: the attention lambda closes over
+    the traced segments and still goes through jax.checkpoint's static
+    attention_fn slot — loss and grads must match the no-remat run."""
+    cfg = dataclasses.replace(CFG, max_len=33, remat=True)
+    plain = dataclasses.replace(cfg, remat=False)
+    params = tfm.init_params(jax.random.key(4), cfg)
+    rows = jnp.asarray(rng.integers(1, 64, (2, 20)), jnp.int32)
+    seg = jnp.asarray(np.asarray(_segs(2, 20, splits=(7, 13))))
+    ref = float(tfm.lm_nll(params, rows, plain, segment_ids=seg))
+    out = float(jax.jit(lambda p, t, s: tfm.lm_nll(p, t, cfg,
+                                                   segment_ids=s))(
+        params, rows, seg))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    g = jax.jit(jax.grad(lambda p: tfm.lm_nll(p, rows, cfg,
+                                              segment_ids=seg)))(params)
+    gr = jax.grad(lambda p: tfm.lm_nll(p, rows, plain,
+                                       segment_ids=seg))(params)
+    for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
 
 
 def test_lm_trainer_packed_tp_fsdp_mesh(devices, rng):
