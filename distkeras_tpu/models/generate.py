@@ -432,11 +432,17 @@ def _check_decode_budget(p: int, max_new_tokens: int,
                 " (rolling decode past max_len needs rope=True, an "
                 "attention_window <= max_len, and a uniform-length "
                 "generate() call)"))
+    _check_eos(eos_token, cfg)
+    return total
+
+
+def _check_eos(eos_token, cfg: TransformerConfig) -> None:
+    """ONE eos_token range check — generate, beam_search, and
+    speculative_generate share it (duplicates drift)."""
     if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
         raise ValueError(
             f"eos_token must be in [0, vocab_size={cfg.vocab_size}), "
             f"got {eos_token}")
-    return total
 
 
 def _resolve_prefill(params, cfg: TransformerConfig, p: int,

@@ -48,7 +48,10 @@ from distkeras_tpu.models.transformer import TransformerConfig
 
 
 def _validate(params, draft_params, cfg, draft_cfg, p, max_new_tokens,
-              n_draft, temperature, key):
+              n_draft, temperature, key, eos_token=None):
+    from distkeras_tpu.models.generate import _check_eos
+
+    _check_eos(eos_token, cfg)
     if draft_cfg.vocab_size != cfg.vocab_size:
         raise ValueError(
             f"draft vocab_size {draft_cfg.vocab_size} != target "
@@ -109,7 +112,7 @@ def _warm_cache(model_params, model_cfg, buf, p):
 def speculative_generate(params, draft_params, prompt, cfg: TransformerConfig,
                          draft_cfg: TransformerConfig, max_new_tokens: int,
                          n_draft: int = 4, temperature: float = 0.0,
-                         key=None):
+                         key=None, eos_token: int | None = None):
     """Decode ``max_new_tokens`` past ``prompt [B, P]`` with draft
     assistance; returns ``(tokens [B, P+N], stats)``.
 
@@ -118,11 +121,16 @@ def speculative_generate(params, draft_params, prompt, cfg: TransformerConfig,
     by unfinished rows (the serving speedup knob: each target pass
     advances 1 + acceptance_rate * n_draft positions on average).
 
-    Uniform-length prompts; no eos/top-k/top-p composition in this
-    entry (use :func:`~distkeras_tpu.models.generate.generate` when
-    those matter more than latency).  Quantized (int8) target or draft
-    trees work — the chunk path dequantizes per read, and the prompt
-    falls back to sequential warm for a quantized tree.
+    ``eos_token`` is sticky like :func:`generate`'s: once a row's
+    ACCEPTED stream emits it, the row's remaining generated slots fill
+    with ``eos_token`` and the row stops consuming target passes
+    (static shapes; trim on the host).
+
+    Uniform-length prompts; no top-k/top-p composition in this entry
+    (use :func:`~distkeras_tpu.models.generate.generate` when filtered
+    sampling matters more than latency).  Quantized (int8) target or
+    draft trees work — the chunk path dequantizes per read, and the
+    prompt falls back to sequential warm for a quantized tree.
     """
     from distkeras_tpu.models.generate import _device_tree
 
@@ -130,7 +138,8 @@ def speculative_generate(params, draft_params, prompt, cfg: TransformerConfig,
     draft_params = _device_tree(draft_params)
     b, p = prompt.shape
     total = _validate(params, draft_params, cfg, draft_cfg, p,
-                      max_new_tokens, n_draft, temperature, key)
+                      max_new_tokens, n_draft, temperature, key,
+                      eos_token)
     key = key if key is not None else jax.random.key(0)
     k = n_draft
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -239,13 +248,30 @@ def speculative_generate(params, draft_params, prompt, cfg: TransformerConfig,
         d_ext = jnp.concatenate([d, d[:, -1:]], axis=1)          # [B, k+1]
         win = jnp.where(idx[None, :] < n[:, None], d_ext,
                         corrective[:, None]).astype(jnp.int32)
+        if eos_token is not None:
+            # Sticky EOS: truncate the row's advance at its first
+            # accepted eos; the tail fill below pads the rest and the
+            # cur jump stops the row from consuming further passes.
+            is_eos = (win == eos_token) & (idx[None, :] < advance[:, None])
+            hit = is_eos.any(axis=1)
+            first = jnp.argmax(is_eos, axis=1).astype(jnp.int32)
+            advance = jnp.where(hit, first + 1, advance)
         buf = jax.vmap(lambda row, w, s: jax.lax.dynamic_update_slice(
             row, w, (s,)))(buf, win, cur + 1)
+        if eos_token is not None:
+            span = jnp.arange(buf.shape[1])
+            fill = (hit[:, None]
+                    & (span[None, :] > (cur + advance)[:, None])
+                    & (span[None, :] < total))
+            buf = jnp.where(fill, eos_token, buf)
+            cur_next = jnp.where(hit, total - 1, cur + advance)
+        else:
+            cur_next = cur + advance
 
         live = (~done).astype(jnp.int32)
         acc = acc + (n * live).sum()
         props = props + k * live.sum()
-        return (buf, tcache, dcache, cur + advance, it + 1, acc, props)
+        return (buf, tcache, dcache, cur_next, it + 1, acc, props)
 
     def cond(state):
         cur = state[3]

@@ -76,6 +76,7 @@ MULTIPROCESS = {
     "test_deploy::test_two_process_downpour_matches_single_process",
     "test_deploy::test_two_process_lm_trainer_matches_single_process",
     "test_deploy::test_two_process_model_axis_crosses_boundary",
+    "test_deploy::test_two_process_packed_training_matches_single",
     "test_zoo_and_entry::test_graft_entry_multichip",
 }
 
@@ -98,6 +99,8 @@ SLOW = MULTIPROCESS | {
     "test_packing::test_flash_fallback_segments_grads_match_naive",
     "test_sharded_decode::test_speculative_tp_sharded_matches_single",
     "test_speculative::test_decode_chunk_matches_decode_step",
+    "test_speculative::test_eos_matches_generate",
+    "test_speculative::test_eos_stops_rows_early",
     "test_speculative::test_decode_chunk_per_row_offsets",
     "test_speculative::test_greedy_matches_generate",
     "test_speculative::test_greedy_rope_gqa_matches_generate",

@@ -521,3 +521,64 @@ def test_four_process_smoke(tmp_path, devices):
     got = np.load(out)
     np.testing.assert_allclose(got["losses"], np.asarray(tr.history),
                                rtol=1e-4, atol=1e-5)
+
+
+MULTIHOST_PACKED_CHILD = """{preamble}
+
+import numpy as np
+import distkeras_tpu as dk
+from distkeras_tpu.models.transformer import TransformerConfig
+
+assert jax.process_count() == 2
+host = int(os.environ["DKT_HOST_ID"])
+
+rng = np.random.default_rng(3)
+docs = [rng.integers(1, 64, (int(n),)).tolist()
+        for n in rng.integers(5, 28, 96)]
+rows, segs = dk.pack_documents(docs, seq_len=16)
+n = (len(rows) // 16) * 16
+rows, segs = rows[:n], segs[:n]
+cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                        d_ff=64, max_len=17, rope=True)
+tr = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=16, num_epoch=1)
+params = tr.train(rows[host::2], segments=segs[host::2])
+if host == 0:
+    flat = {{"/".join(map(str, p)): np.asarray(v)
+            for p, v in jax.tree_util.tree_flatten_with_path(params)[0]}}
+    np.savez({out!r}, losses=np.asarray(tr.history), **flat)
+print("HOST", host, "OK", flush=True)
+"""
+
+
+def test_two_process_packed_training_matches_single(tmp_path, devices):
+    """Packed-sequence training on the real multi-process runtime: each
+    host feeds its strided shard of rows AND segments; losses and the
+    trained params must match the single-process run (same global row
+    sets, permutation-invariant mean loss)."""
+    import jax as jx
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.transformer import TransformerConfig
+
+    out = str(tmp_path / "host0.npz")
+    _spawn_hosts(MULTIHOST_PACKED_CHILD, num_hosts=2, devs_per_host=4,
+                 out=out)
+
+    rng = np.random.default_rng(3)
+    docs = [rng.integers(1, 64, (int(n),)).tolist()
+            for n in rng.integers(5, 28, 96)]
+    rows, segs = dk.pack_documents(docs, seq_len=16)
+    n = (len(rows) // 16) * 16
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_len=17, rope=True)
+    tr = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=16, num_epoch=1)
+    params = tr.train(rows[:n], segments=segs[:n])
+
+    got = np.load(out)
+    np.testing.assert_allclose(got["losses"], np.asarray(tr.history),
+                               rtol=1e-4, atol=1e-5)
+    ref = {"/".join(map(str, p)): np.asarray(v)
+           for p, v in jx.tree_util.tree_flatten_with_path(params)[0]}
+    for k, v in ref.items():
+        np.testing.assert_allclose(got[k], v, rtol=1e-4, atol=1e-5,
+                                   err_msg=k)

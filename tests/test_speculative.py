@@ -229,3 +229,46 @@ def test_validation_errors(rng):
     with pytest.raises(ValueError, match="n_draft"):
         speculative_generate(params, draft, prompt, CFG, DRAFT, 4,
                              n_draft=0)
+
+
+def test_eos_matches_generate(rng):
+    """Sticky EOS parity: pick an eos token the model actually emits,
+    then speculative greedy must equal generate's sticky-eos output,
+    including the filled tail."""
+    params, draft = _models()
+    prompt = jnp.asarray(rng.integers(1, 64, (4, 5)), jnp.int32)
+    plain = np.asarray(generate(params, prompt, CFG, 12))
+    # A token emitted mid-generation on row 0 becomes the eos —
+    # guaranteed to trigger for at least one row.
+    eos = int(plain[0, 5 + 3])
+    ref = np.asarray(generate(params, prompt, CFG, 12, eos_token=eos))
+    out, stats = speculative_generate(params, draft, prompt, CFG, DRAFT,
+                                      12, n_draft=3, eos_token=eos)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert int(stats["iterations"]) >= 1
+
+
+def test_eos_stops_rows_early(rng):
+    """EOS actually saves target passes: IDENTICAL prompt rows all emit
+    the chosen eos as their first generated token, so the whole batch
+    must finish in ONE pass (without early exit, 16 tokens at
+    n_draft=4 need ceil(16/5) = 4)."""
+    params, _ = _models()
+    one = rng.integers(1, 64, (1, 4))
+    prompt = jnp.asarray(np.repeat(one, 3, axis=0), jnp.int32)
+    plain = np.asarray(generate(params, prompt, CFG, 16))
+    eos = int(plain[0, 4])  # every row's first generated token
+    assert (plain[:, 4] == eos).all()
+    out, stats = speculative_generate(params, params, prompt, CFG, CFG,
+                                      16, n_draft=4, eos_token=eos)
+    ref = np.asarray(generate(params, prompt, CFG, 16, eos_token=eos))
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert int(stats["iterations"]) == 1
+
+
+def test_eos_validation(rng):
+    params, draft = _models()
+    prompt = jnp.asarray(rng.integers(1, 64, (2, 4)), jnp.int32)
+    with pytest.raises(ValueError, match="eos_token"):
+        speculative_generate(params, draft, prompt, CFG, DRAFT, 4,
+                             eos_token=64)
