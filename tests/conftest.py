@@ -82,6 +82,8 @@ MULTIPROCESS = {
 
 SLOW = MULTIPROCESS | {
     "test_lora::test_lora_checkpoint_resume_matches_straight",
+    "test_lora::test_lora_merged_serves_speculatively",
+    "test_lora::test_lora_grad_accum_matches_large_batch",
     "test_lora::test_merged_model_serves",
     "test_lora::test_zero_init_merge_is_identity",
     "test_lora::test_lora_composes_with_tp_mesh_and_segments",

@@ -189,3 +189,44 @@ def test_train_rejects_params_argument(rng):
         tr.train(_rows(rng), params=base)
     with pytest.raises(ValueError, match="base_params"):
         dk.LoRATrainer(CFG, None)
+
+
+def test_lora_merged_serves_speculatively(rng):
+    """The full adapt-and-deploy composition: LoRA-finetuned merged
+    tree serves via speculative decoding with its own int8 copy as the
+    draft, matching generate's greedy rollout exactly."""
+    from distkeras_tpu.models.generate import generate
+    from distkeras_tpu.models.quant import quantize_params
+    from distkeras_tpu.models.speculative import speculative_generate
+
+    base = tfm.init_params(jax.random.key(0), CFG)
+    rows = _rows(rng)
+    tr = dk.LoRATrainer(CFG, base, lora_rank=4, learning_rate=3e-2,
+                        batch_size=16, num_epoch=2)
+    merged = tr.train(rows)
+    draft = quantize_params(merged)
+    prompt = jnp.asarray(rows[:4, :4])
+    ref = np.asarray(generate(merged, prompt, CFG, 9))
+    out, stats = speculative_generate(merged, draft, prompt, CFG, CFG,
+                                      9, n_draft=3)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert float(stats["acceptance_rate"]) > 0.3  # int8 self-draft
+
+
+def test_lora_grad_accum_matches_large_batch(rng):
+    """grad_accum under the LoRA loss hook: accumulating microbatch
+    gradients of the adapters equals one large-batch step (the
+    masked-optimizer path composes with make_train_step's accum loop).
+    """
+    base = tfm.init_params(jax.random.key(0), CFG)
+    rows = _rows(rng, 32)
+    big = dk.LoRATrainer(CFG, base, lora_rank=4, learning_rate=1e-2,
+                         batch_size=32, num_epoch=1)
+    accum = dk.LoRATrainer(CFG, base, lora_rank=4, learning_rate=1e-2,
+                           batch_size=16, grad_accum=2, num_epoch=1)
+    want = big.train(rows)
+    got = accum.train(rows)
+    assert len(big.history) == len(accum.history) == 1
+    for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
