@@ -357,8 +357,7 @@ def _flash_pallas(q, k, v, causal, scale, block_q, block_k, interpret=False,
     inference skips the lse buffer's HBM writes entirely."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
-    block_q = min(block_q, lq)
-    block_k = min(block_k, lk)
+    block_q, block_k = _require_fit(block_q, lq), _require_fit(block_k, lk)
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
@@ -568,8 +567,7 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     """Pallas dQ/dK/dV from the saved (out, lse) residuals."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
-    block_q = min(block_q, lq)
-    block_k = min(block_k, lk)
+    block_q, block_k = _require_fit(block_q, lq), _require_fit(block_k, lk)
     flat = lambda a, L: a.transpose(0, 2, 1, 3).reshape(b * h, L, d)
     qf, kf, vf = flat(q, lq), flat(k, lk), flat(v, lk)
     dof, of = flat(g, lq), flat(out, lq)
@@ -669,18 +667,83 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     return unflat(dq, lq), unflat(dk, lk), unflat(dv, lk)
 
 
-def _use_pallas(q, k, block_q, block_k) -> bool:
+def _fit_block(requested: int, length: int) -> int | None:
+    """Kernel block size <= ``requested`` that tiles ``length`` exactly.
+
+    The min-clamp alone covers short rows (one block == the row) and
+    explicit blocks that already divide the row; otherwise pick the
+    largest lane-aligned (x128) divisor of ``length``, so raising the
+    tuned defaults never pushes a length that used to tile off the
+    Pallas path (e.g. seq 1536 under the (1024, 1024) defaults fits
+    768).  None = nothing tiles; the caller falls back to blockwise.
+    """
+    b = min(requested, length)
+    if length % b == 0:
+        return b
+    return max((c for c in range(128, b + 1, 128) if length % c == 0),
+               default=None)
+
+
+def _require_fit(requested: int, length: int) -> int:
+    """_fit_block for the kernel launchers: a grid whose block does not
+    divide the length would silently leave tail rows unwritten, so an
+    unfittable request is an error, never a clamp."""
+    b = _fit_block(requested, length)
+    if b is None:
+        raise ValueError(
+            f"no kernel block <= {requested} tiles sequence length "
+            f"{length}; pick a length divisible by 128 or a block that "
+            "divides it (flash_attention's fallback handles any length)")
+    return b
+
+
+# Measured optimum of the hardware sweep (docs/perf_transformer.md).
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+
+
+def _pallas_blocks(lq, lk, d, block_q, block_k, gate_small_bk=False):
+    """Pure tiling/quality decision (backend-independent, unit-tested):
+    the fitted (bq, bk) the kernel would launch with, or None for the
+    blockwise fallback."""
+    # Tiling constraints: last dim 128-aligned, seq divisible into blocks.
+    if d % 128 != 0 or min(lq, lk) < 8:
+        return None
+    bq, bk = _fit_block(block_q, lq), _fit_block(block_k, lk)
+    if bq is None or bk is None:
+        return None
+    # Defaulted callers only (``gate_small_bk``): tiny fitted KV tiles
+    # usually lose to the XLA blockwise fallback end-to-end (sweep,
+    # docs/perf_transformer.md: at block_k=128 the kernel is slower
+    # than the fallback for every block_q except 1024, which edges it
+    # out by ~4%), so keep bk=128 only when bq fitted to >=1024.  An
+    # EXPLICIT small block_k is always honored — the sweep itself must
+    # be able to time the kernel at any point of its grid.
+    if gate_small_bk and bk < 256 and bk != lk and bq < 1024:
+        return None
+    return bq, bk
+
+
+def _use_pallas(q, k, block_q, block_k, gate_small_bk=False) -> bool:
     if not _HAVE_PALLAS or jax.default_backend() != "tpu":
         return False
-    lq, lk, d = q.shape[1], k.shape[1], q.shape[-1]
-    # Tiling constraints: last dim 128-aligned, seq divisible into blocks.
-    return (d % 128 == 0 and lq % min(block_q, lq) == 0
-            and lk % min(block_k, lk) == 0 and min(lq, lk) >= 8)
+    return _pallas_blocks(q.shape[1], k.shape[1], q.shape[-1],
+                          block_q, block_k, gate_small_bk) is not None
+
+
+def _resolve_blocks(block_q, block_k):
+    """None -> tuned default; the gate applies only to a defaulted
+    block_k.  The ONE definition shared by flash_attention and its
+    custom_vjp fwd/bwd so primal and vjp can never disagree."""
+    gate = block_k is None
+    bq = DEFAULT_BLOCK_Q if block_q is None else block_q
+    bk = DEFAULT_BLOCK_K if block_k is None else block_k
+    return bq, bk, gate
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
-                    block_q: int = 256, block_k: int = 512,
+                    block_q: int | None = None, block_k: int | None = None,
                     window: int | None = None, segment_ids=None):
     """Fused attention: Pallas kernel on TPU, blockwise jnp elsewhere.
 
@@ -700,15 +763,29 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     masked to within-segment pairs on every tier, forward and backward
     — the packed-document training primitive.  An integer input: its
     cotangent is None.
+
+    ``block_q``/``block_k`` default (None) to the measured optimum of
+    the (block_q, block_k) hardware sweep on the long-context benchmark
+    config (seq 4096, d1024 L8, TPU v5e —
+    `scripts/sweep_attention_blocks.py`, results in
+    docs/perf_transformer.md): (1024, 1024) beat the untuned (256, 512)
+    by 35% on the full train step.  Blocks are fitted per call
+    (``_fit_block``): shorter sequences clamp to one block, and lengths
+    the default doesn't divide (e.g. 1536) drop to their largest
+    lane-aligned divisor instead of leaving the Pallas path — except
+    that a *defaulted* call never fits below a 256 KV tile (measured
+    slower than the fallback); pass block_k explicitly to force a
+    small-tile kernel.
     """
     _check_window(window, causal)
     s = _scale_for(q, scale)
-    if _use_pallas(q, k, block_q, block_k):
-        return _flash_pallas(q, k, v, causal, s, block_q, block_k,
+    bq, bk, gate = _resolve_blocks(block_q, block_k)
+    if _use_pallas(q, k, bq, bk, gate_small_bk=gate):
+        return _flash_pallas(q, k, v, causal, s, bq, bk,
                              with_lse=False, window=window,
                              segment_ids=segment_ids)[0]
     return blockwise_attention(q, k, v, causal=causal, scale=s,
-                               block_k=block_k, window=window,
+                               block_k=bk, window=window,
                                segment_ids=segment_ids)
 
 
@@ -716,12 +793,13 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None,
                segment_ids=None):
     _check_window(window, causal)
     s = _scale_for(q, scale)
-    if _use_pallas(q, k, block_q, block_k):
-        out, lse = _flash_pallas(q, k, v, causal, s, block_q, block_k,
+    bq, bk, gate = _resolve_blocks(block_q, block_k)
+    if _use_pallas(q, k, bq, bk, gate_small_bk=gate):
+        out, lse = _flash_pallas(q, k, v, causal, s, bq, bk,
                                  window=window, segment_ids=segment_ids)
         return out, (q, k, v, out, lse, segment_ids)
     out = blockwise_attention(q, k, v, causal=causal, scale=s,
-                              block_k=block_k, window=window,
+                              block_k=bk, window=window,
                               segment_ids=segment_ids)
     return out, (q, k, v, None, None, segment_ids)
 
@@ -729,14 +807,15 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None,
 def _flash_bwd(causal, scale, block_q, block_k, window, res, g):
     q, k, v, out, lse, segment_ids = res
     s = _scale_for(q, scale)
+    bq, bk, _ = _resolve_blocks(block_q, block_k)
     if lse is not None:
         dq, dk, dv = _flash_pallas_bwd(q, k, v, out, lse, g, causal, s,
-                                       block_q, block_k, window=window,
+                                       bq, bk, window=window,
                                        segment_ids=segment_ids)
         return dq, dk, dv, None
     _, vjp = jax.vjp(
         lambda q, k, v: blockwise_attention(
-            q, k, v, causal=causal, scale=s, block_k=block_k,
+            q, k, v, causal=causal, scale=s, block_k=bk,
             window=window, segment_ids=segment_ids),
         q, k, v)
     return (*vjp(g), None)

@@ -260,3 +260,56 @@ def test_pallas_window_banded_grid_asymmetric_blocks(rng, bq, bk, window):
     np.testing.assert_allclose(dq, rdq, atol=2e-3, rtol=2e-3)
     np.testing.assert_allclose(dk, rdk, atol=2e-3, rtol=2e-3)
     np.testing.assert_allclose(dv, rdv, atol=2e-3, rtol=2e-3)
+
+
+def test_fit_block_divisor_logic():
+    """Oversized defaults fit down to the largest lane-aligned divisor
+    instead of pushing the length off the Pallas path (code-review
+    regression: (1024, 1024) defaults must not exile seq 1536)."""
+    from distkeras_tpu.ops.attention import _fit_block
+
+    assert _fit_block(1024, 4096) == 1024     # divides exactly
+    assert _fit_block(1024, 1536) == 768      # largest x128 divisor
+    assert _fit_block(1024, 1280) == 640
+    assert _fit_block(1024, 512) == 512       # short row: one block
+    assert _fit_block(1024, 200) == 200       # short unaligned row
+    assert _fit_block(8, 16) == 8             # explicit test blocks keep
+    assert _fit_block(1024, 1288) is None     # nothing lane-aligned tiles
+
+
+def test_pallas_fitted_blocks_interpret(rng):
+    """A length the tuned defaults don't divide (1536) still runs the
+    kernel — with fitted 768-blocks — and matches the naive reference."""
+    q, k, v = qkv(rng, b=1, l=1536, h=1, d=128)
+    ref = naive_attention(q, k, v, causal=True)
+    out, _ = _flash_pallas(q, k, v, True, 1.0 / np.sqrt(128),
+                           block_q=1024, block_k=1024, interpret=True)
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_explicit_small_block_k_honored_and_unfittable_raises(rng):
+    """Explicit small blocks reach the kernel (the sweep must be able to
+    time any grid point); unfittable direct launches raise instead of
+    silently leaving tail rows unwritten (code-review regressions).
+    ``_pallas_blocks`` is the backend-independent decision, so this
+    runs fully on the CPU suite."""
+    from distkeras_tpu.ops.attention import _pallas_blocks, _require_fit
+
+    # Explicit block_k=128 tiles lk=4096 — accepted when the caller
+    # asked for it (gate off), rejected on the defaulted path (gate on)
+    # unless block_q fitted to >=1024 (sweep: (1024, 128) alone beats
+    # the fallback).
+    assert _pallas_blocks(4096, 4096, 128, 512, 128) == (512, 128)
+    assert _pallas_blocks(4096, 4096, 128, 512, 128,
+                          gate_small_bk=True) is None
+    assert _pallas_blocks(4096, 4096, 128, 1024, 128,
+                          gate_small_bk=True) == (1024, 128)
+    # Defaulted seq 2176 = 17x128: both blocks fit only to 128 -> the
+    # (128, 128)-class kernel is pathological, fallback wins.
+    assert _pallas_blocks(2176, 2176, 128, 1024, 1024,
+                          gate_small_bk=True) is None
+    # Unaligned head_dim or sub-8 rows never tile.
+    assert _pallas_blocks(4096, 4096, 64, 1024, 1024) is None
+    assert _require_fit(8, 16) == 8
+    with pytest.raises(ValueError, match="tiles sequence length"):
+        _require_fit(1024, 1288)
