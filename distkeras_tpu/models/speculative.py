@@ -104,7 +104,7 @@ def _warm_cache(model_params, model_cfg, buf, p):
         _, cache = _decode_chunk(model_params, cache,
                                  buf[:, start:start + width],
                                  jnp.full((b,), start, jnp.int32),
-                                 model_cfg)
+                                 model_cfg, uniform_pos=True)
         start += width
     return cache
 
