@@ -117,7 +117,7 @@ def prefill(params, prompt, cfg: TransformerConfig,
 
 
 def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
-                 pad_lens=None):
+                 pad_lens=None, beam_anc=None):
     """One position: tokens [B] at position ``pos`` -> (logits [B, V], cache).
 
     Attention reads the cache up to ``pos`` with a position mask (static
@@ -139,8 +139,11 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
     if cfg.attention_window is None and pad_lens is None:
         out, cache = _decode_chunk(params, cache, tokens[:, None],
                                    jnp.full((b,), pos, jnp.int32), cfg,
-                                   uniform_pos=True)
+                                   uniform_pos=True, beam_anc=beam_anc)
         return out[:, 0], cache
+    if beam_anc is not None:
+        raise ValueError("beam ancestry attention is full-cache only "
+                         "(no window, no pad_lens)")
     x = embed_rows(params["tok_emb"], tokens, dtype)  # [B, D]
     if pad_lens is None:
         pos_ids = jnp.full((b,), pos)
@@ -281,7 +284,7 @@ def _layer_slab_update(cache_all, i, rows, pos):
 
 
 def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
-                  uniform_pos: bool = False):
+                  uniform_pos: bool = False, beam_anc=None):
     """Process T new tokens per row against the cache in ONE pass:
     ``tokens [B, T]`` at global positions ``pos0[b] + (0..T-1)`` ->
     ``(logits [B, T, V] f32, cache)``.
@@ -304,6 +307,18 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
     per-row scatter (see _layer_slab_update).  The plain decode loop
     and prefix warm-up qualify; speculative decoding (per-row accept
     divergence) does not.
+
+    ``beam_anc = (anc [B/W, W, S] int32, W)``: beam-search ancestry
+    attention (requires T == 1, uniform_pos, no window).  Rows are
+    beam lanes (batch-major tiling b*W + w); each lane writes its own
+    cache lane in place, and attention resolves lane ``w``'s history
+    through ``anc`` — position ``s`` is read from lane ``anc[b, w,
+    s]`` — by computing every (query-lane, source-lane) score and
+    folding a one-hot of ``anc`` into the softmax/PV einsums.  The
+    cache is read ONCE per step with no beam-reorder rewrite; the
+    W-times-larger score tensor is kilobytes.  This replaced the
+    physical parent-gather of the cache, which cost more than the
+    whole attention read (docs/perf_serving.md finding 4).
     """
     dtype = jnp.dtype(cfg.dtype)
     b, t_len = tokens.shape
@@ -321,6 +336,13 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
     span = jnp.arange(cfg.max_len)
     mask = (span[None, None, :] <= pos_ids[:, :, None]
             )[:, :, None, None, :]                # [B, T, 1, 1, S]
+    if beam_anc is not None:
+        anc, w_beams = beam_anc
+        if t_len != 1 or not uniform_pos or cfg.attention_window:
+            raise ValueError("beam ancestry attention requires T == 1, "
+                             "uniform positions, and no window")
+        # One-hot over source lanes, f32 for the einsum contractions.
+        anc_oh = jax.nn.one_hot(anc, w_beams, dtype=jnp.float32)
     for i in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
         h = _rms_norm(x, lp["ln1_scale"])
@@ -342,14 +364,36 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
         groups = cfg.n_heads // cfg.kv_heads
         qg = q.astype(jnp.float32).reshape(
             b, t_len, cfg.kv_heads, groups, cfg.head_dim)
-        logits = jnp.einsum("btcgk,bsck->btcgs", qg,
-                            ck.astype(jnp.float32))
-        logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
-        logits = jnp.where(mask, logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("btcgs,bsck->btcgk", probs,
-                          cv.astype(jnp.float32)).reshape(
-            b, t_len, cfg.n_heads, cfg.head_dim)
+        if beam_anc is not None:
+            # Ancestry attention: score every (query-lane w, source-lane
+            # v) pair — the cache is read once, W x the (tiny) decode
+            # attention FLOPs — then select each position's true
+            # ancestor lane with the one-hot.
+            bt = b // w_beams
+            qb = qg[:, 0].reshape(bt, w_beams, cfg.kv_heads, groups,
+                                  cfg.head_dim)
+            kb = ck.astype(jnp.float32).reshape(
+                bt, w_beams, cfg.max_len, cfg.kv_heads, cfg.head_dim)
+            vb = cv.astype(jnp.float32).reshape(
+                bt, w_beams, cfg.max_len, cfg.kv_heads, cfg.head_dim)
+            la = jnp.einsum("bwcgk,bvsck->bwcgvs", qb, kb)
+            logits = jnp.einsum("bwcgvs,bwsv->bwcgs", la, anc_oh)
+            logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
+            bmask = mask.reshape(bt, w_beams, 1, 1, cfg.max_len)
+            logits = jnp.where(bmask, logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            pm = jnp.einsum("bwcgs,bwsv->bwcgvs", probs, anc_oh)
+            attn = jnp.einsum("bwcgvs,bvsck->bwcgk", pm, vb).reshape(
+                b, t_len, cfg.n_heads, cfg.head_dim)
+        else:
+            logits = jnp.einsum("btcgk,bsck->btcgs", qg,
+                                ck.astype(jnp.float32))
+            logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
+            logits = jnp.where(mask, logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            attn = jnp.einsum("btcgs,bsck->btcgk", probs,
+                              cv.astype(jnp.float32)).reshape(
+                b, t_len, cfg.n_heads, cfg.head_dim)
         x = x + jnp.einsum("bthk,hkd->btd", attn.astype(dtype),
                            deq(lp["attn"]["wo"]))
 
@@ -648,7 +692,8 @@ def beam_search(params, prompt, cfg: TransformerConfig,
                 max_new_tokens: int, beam_width: int = 4,
                 eos_token: int | None = None,
                 use_prefill: bool | None = None,
-                length_penalty: float = 0.0):
+                length_penalty: float = 0.0,
+                _force_physical: bool = False):
     """Beam search decode: ``prompt [B, P]`` -> ``(sequences, scores)``
     with ``sequences [B, W, P+N]`` and ``scores [B, W]`` (sum of token
     log-probabilities of the generated part), best beam first.
@@ -728,12 +773,29 @@ def beam_search(params, prompt, cfg: TransformerConfig,
         lambda a: jnp.repeat(a, w, axis=1), cache)  # [L, B*W, S, ...]
 
     neg_inf = jnp.float32(-1e30)
+    # Ancestry mode (full-cache configs): the tiled cache is never
+    # reordered — each lane writes itself in place, and attention
+    # resolves lane w's history through ``anc[b, w, s]`` = the lane
+    # that wrote position s of beam w's hypothesis (see _decode_chunk's
+    # beam_anc).  The physical parent-gather it replaces rewrote the
+    # whole [L, B*W, S, kv, hd] cache every step and cost more than the
+    # attention itself (docs/perf_serving.md finding 4).  The windowed
+    # ring-buffer path keeps the gather (its slot arithmetic reuses
+    # slots, which ancestry cannot represent).
+    # ``_force_physical`` exists for the equivalence test only.
+    use_anc = cfg.attention_window is None and not _force_physical
+    anc0 = jnp.broadcast_to(
+        jnp.arange(w, dtype=jnp.int32)[None, :, None],
+        (b, w, cfg.max_len))  # prompt + first token: every lane is its
+    #                           own ancestor (the tiled copies agree)
 
     def body(carry, q):
-        buf, cache, scores, done, lengths = carry
+        buf, cache, anc, scores, done, lengths = carry
         tok = jax.lax.dynamic_index_in_dim(
             buf.reshape(b * w, total), q, axis=1, keepdims=False)
-        logits, cache = _decode_step(params, cache, tok, q, cfg)
+        logits, cache = _decode_step(
+            params, cache, tok, q, cfg,
+            beam_anc=(anc, w) if use_anc else None)
         logp = jax.nn.log_softmax(logits, axis=-1).reshape(b, w, -1)
         v = logp.shape[-1]
         cand = scores[:, :, None] + logp           # [B, W, V]
@@ -747,7 +809,8 @@ def beam_search(params, prompt, cfg: TransformerConfig,
         scores, idx = jax.lax.top_k(cand.reshape(b, w * v), w)
         parent = (idx // v).astype(jnp.int32)      # [B, W]
         token = (idx % v).astype(jnp.int32)
-        # Reorder beams by parent: buf rows, cache rows, done flags.
+        # Reorder beams by parent: buf rows, done flags — and either
+        # the ancestry map (cheap) or the cache rows (windowed path).
         buf = jnp.take_along_axis(buf, parent[:, :, None], axis=1)
         buf = buf.at[:, :, q + 1].set(token)
         done = jnp.take_along_axis(done, parent, axis=1)
@@ -755,15 +818,23 @@ def beam_search(params, prompt, cfg: TransformerConfig,
         lengths = jnp.where(done, lengths, lengths + 1)
         if eos_token is not None:
             done = done | (token == eos_token)
-        flat_parent = (parent
-                       + jnp.arange(b, dtype=jnp.int32)[:, None] * w
-                       ).reshape(b * w)
-        cache = jax.tree.map(lambda a: a[:, flat_parent], cache)
-        return (buf, cache, scores, done, lengths), None
+        if use_anc:
+            # Kept beam w inherits parent's ancestry for s <= q (the
+            # parent's lane wrote position q this step); next step's
+            # write position is its own lane.
+            anc = jnp.take_along_axis(anc, parent[:, :, None], axis=1)
+            anc = anc.at[:, :, q + 1].set(
+                jnp.arange(w, dtype=jnp.int32)[None, :])
+        else:
+            flat_parent = (parent
+                           + jnp.arange(b, dtype=jnp.int32)[:, None] * w
+                           ).reshape(b * w)
+            cache = jax.tree.map(lambda a: a[:, flat_parent], cache)
+        return (buf, cache, anc, scores, done, lengths), None
 
     if max_new_tokens > 1:
-        (buf, _, scores, _, lengths), _ = jax.lax.scan(
-            body, (buf, cache, scores, done, lengths),
+        (buf, _, _, scores, _, lengths), _ = jax.lax.scan(
+            body, (buf, cache, anc0, scores, done, lengths),
             jnp.arange(p, total - 1))
     if length_penalty > 0:
         norm = scores / jnp.power((5.0 + lengths) / 6.0, length_penalty)

@@ -784,3 +784,27 @@ def test_generate_min_p_sampling(rng):
     np.testing.assert_array_equal(np.asarray(strict), np.asarray(greedy))
     with pytest.raises(ValueError, match="temperature"):
         generate(params, prompt, CFG, 6, min_p=0.1)
+
+
+def test_beam_ancestry_equals_physical_reorder(rng):
+    """The ancestry-attention beam path (cache never reordered; history
+    resolved through the one-hot ancestor map) returns the same
+    hypotheses and scores as the physical parent-gather it replaced —
+    including under GQA grouping and an eos freeze."""
+    import dataclasses
+
+    from distkeras_tpu.models.generate import beam_search
+
+    gqa_cfg = dataclasses.replace(CFG, n_heads=4, n_kv_heads=2, rope=True)
+    params = tfm.init_params(jax.random.key(3), gqa_cfg)
+    prompt = jnp.asarray(rng.integers(0, 64, (3, 5)), jnp.int32)
+    for kw in [dict(), dict(eos_token=7), dict(length_penalty=0.8)]:
+        seqs_a, sc_a = beam_search(params, prompt, gqa_cfg, 10,
+                                   beam_width=3, **kw)
+        seqs_p, sc_p = beam_search(params, prompt, gqa_cfg, 10,
+                                   beam_width=3, _force_physical=True,
+                                   **kw)
+        np.testing.assert_array_equal(np.asarray(seqs_a),
+                                      np.asarray(seqs_p))
+        np.testing.assert_allclose(np.asarray(sc_a), np.asarray(sc_p),
+                                   atol=1e-5, rtol=1e-5)
