@@ -316,8 +316,11 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
     s]`` — by computing every (query-lane, source-lane) score and
     folding a one-hot of ``anc`` into the softmax/PV einsums.  The
     cache is read ONCE per step with no beam-reorder rewrite; the
-    W-times-larger score tensor is kilobytes.  This replaced the
-    physical parent-gather of the cache, which cost more than the
+    price is score intermediates of ``B/W x W^2 x n_heads x S`` f32
+    per layer — ~4 MB at the benched config (b8 W4 S1025 H8), but
+    quadratic in beam width (b64 W8 S2048 H16 would be ~1 GB/layer;
+    at that scale revisit before trusting this path).  This replaced
+    the physical parent-gather of the cache, which cost more than the
     whole attention read (docs/perf_serving.md finding 4).
     """
     dtype = jnp.dtype(cfg.dtype)
@@ -434,15 +437,30 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
     return out.astype(jnp.float32), {"k": ck_all, "v": cv_all}
 
 
-def top_k_mask(logits, k: int):
+def top_k_mask(logits, k: int, exact: bool = False):
     """Keep the k highest logits per row; the rest go to -inf.
 
     Static ``k`` (a Python int): the mask is a compare against the k-th
-    value from ``lax.top_k`` — no dynamic shapes, scan/jit friendly.
+    value from a top-k reduction — no dynamic shapes, scan/jit
+    friendly.
+
+    By default the k-th value comes from ``lax.approx_max_k`` (recall
+    0.99): on TPU the exact ``lax.top_k`` over a [B, 32k] vocab costs
+    more than the whole rest of a decode step (~7.8 ms vs 0.7 ms at
+    batch 64 on v5e — measured, docs/perf_serving.md finding 5), while
+    the approximate threshold misidentifies only logits in a ~1% band
+    around the k-th value — sampling-support noise far below the
+    sampling noise itself.  Pass ``exact=True`` (or
+    ``generate(..., exact_top_k=True)``) to restore the exact
+    semantics of releases before round 3.
     """
     if k < 1:
         raise ValueError(f"top_k must be >= 1, got {k}")
-    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    if exact or k > logits.shape[-1] // 2:
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    else:
+        kth = jax.lax.approx_max_k(logits, k, recall_target=0.99,
+                                   aggregate_to_topk=True)[0][..., -1:]
     return jnp.where(logits < kth, -jnp.inf, logits)
 
 
@@ -550,7 +568,8 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
              top_k: int | None = None, top_p: float | None = None,
              min_p: float | None = None,
              prompt_lengths=None, eos_token: int | None = None,
-             use_prefill: bool | None = None):
+             use_prefill: bool | None = None,
+             exact_top_k: bool = False):
     """Decode ``max_new_tokens`` past ``prompt [B, P]``; returns [B, P+N].
 
     Prefill/decode split: uniform-length prompts run through
@@ -564,7 +583,11 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     > 0, ``top_k``, ``top_p`` (nucleus) and/or ``min_p`` restrict the
     sampling support — all applied to the temperature-scaled logits in
     that order (top-k, then nucleus, then the min-p relative-
-    probability floor), the standard composition.
+    probability floor), the standard composition.  ``top_k`` uses the
+    approximate-threshold mask by default (round-3 change — see
+    top_k_mask: exact lax.top_k costs more than the rest of the decode
+    step at large vocab); ``exact_top_k=True`` restores the exact
+    support.
 
     PRNG stream contract (changed in round 2): the key for position
     ``pos`` is ``jax.random.fold_in(key, pos)`` — a pure function of
@@ -659,7 +682,7 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
         if temperature > 0:
             scaled = logits / temperature
             if top_k is not None:
-                scaled = top_k_mask(scaled, top_k)
+                scaled = top_k_mask(scaled, top_k, exact=exact_top_k)
             if top_p is not None:
                 scaled = top_p_mask(scaled, top_p)
             if min_p is not None:

@@ -808,3 +808,35 @@ def test_beam_ancestry_equals_physical_reorder(rng):
                                       np.asarray(seqs_p))
         np.testing.assert_allclose(np.asarray(sc_a), np.asarray(sc_p),
                                    atol=1e-5, rtol=1e-5)
+
+
+def test_top_k_mask_approx_path():
+    """The approximate-threshold top-k (vocab large enough to engage
+    approx_max_k) keeps ~k entries around the true threshold, and
+    exact=True reproduces the pre-round-3 exact mask bit-for-bit."""
+    from distkeras_tpu.models.generate import top_k_mask
+
+    rng_l = np.random.default_rng(0)
+    logits = jnp.asarray(rng_l.normal(size=(4, 4096)).astype(np.float32))
+    k = 50
+    approx = np.asarray(top_k_mask(logits, k))
+    exact = np.asarray(top_k_mask(logits, k, exact=True))
+    kept_a = np.isfinite(approx).sum(axis=-1)
+    kept_e = np.isfinite(exact).sum(axis=-1)
+    np.testing.assert_array_equal(kept_e, k)
+    # NOTE: on CPU (this suite) approx_max_k lowers to an exact top-k,
+    # so kept_a == k trivially and the band assertions below only
+    # genuinely bite on TPU — they pin the CONTRACT the approx path is
+    # allowed to exploit, not the TPU kernel's recall itself.
+    # Approximate support sits in a small band around k, and every kept
+    # logit is a genuinely large one (>= the exact threshold minus a
+    # small slack).
+    assert (np.abs(kept_a - k) <= max(5, k // 5)).all(), kept_a
+    thresh = np.sort(np.asarray(logits), axis=-1)[:, -k]
+    assert (approx[np.isfinite(approx)].min()
+            >= thresh.min() - 0.5)
+    # Small vocab (k > V/2) silently takes the exact path.
+    small = jnp.asarray(rng_l.normal(size=(2, 64)).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(top_k_mask(small, 40)),
+        np.asarray(top_k_mask(small, 40, exact=True)))
