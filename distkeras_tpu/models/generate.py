@@ -36,25 +36,38 @@ from distkeras_tpu.models.quant import (
     deq,
     embed_rows,
     is_quantized,
+    quantize_kv,
     unembed_logits,
 )
 from distkeras_tpu.ops.attention import flash_attention
 
 
-def init_cache(cfg: TransformerConfig, batch: int, dtype=None):
+def init_cache(cfg: TransformerConfig, batch: int, dtype=None,
+               kv_int8: bool = False):
     """Per-layer KV buffers [L, B, max_len, kv_heads, head_dim].
 
     Under GQA (cfg.n_kv_heads < n_heads) the cache carries only the
     shared K/V heads — the n_heads/kv_heads memory and HBM-bandwidth
     saving that is the point of GQA at decode time.
+
+    ``kv_int8``: store K/V as int8 with per-token per-kv-head f32
+    scales (``k_scale``/``v_scale`` [L, B, max_len, kv_heads] —
+    head_dim x smaller than the data; see quant.quantize_kv).  Halves
+    the cache-byte term that dominates batched decode at the HBM
+    roofline.  The presence of the scale leaves is what switches the
+    decode attention onto the dequantizing einsums.
     """
-    dtype = dtype or jnp.dtype(cfg.dtype)
+    dtype = jnp.int8 if kv_int8 else (dtype or jnp.dtype(cfg.dtype))
     shape = (cfg.n_layers, batch, cfg.max_len, cfg.kv_heads, cfg.head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if kv_int8:
+        cache["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        cache["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+    return cache
 
 
 def prefill(params, prompt, cfg: TransformerConfig,
-            last_logits: bool = True):
+            last_logits: bool = True, kv_int8: bool = False):
     """Fill the KV cache for all prompt positions in ONE parallel pass.
 
     The sequential decode loop costs one ``_decode_step`` per prompt
@@ -93,7 +106,7 @@ def prefill(params, prompt, cfg: TransformerConfig,
 
     attention_fn = lambda q, k, v: flash_attention(
         q, k, v, True, window=cfg.attention_window)
-    cache = init_cache(cfg, b)
+    cache = init_cache(cfg, b, kv_int8=kv_int8)
     ks, vs = [], []
     for i in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
@@ -103,13 +116,27 @@ def prefill(params, prompt, cfg: TransformerConfig,
         x, _, (k, v) = block_apply(lp, x, cfg, attention_fn, rope_ang,
                                    return_kv=True,
                                    moe_dense_routing=True)
-        ks.append(k.astype(cache["k"].dtype))
-        vs.append(v.astype(cache["v"].dtype))
+        if kv_int8:  # quantized after the fact, not cast
+            ks.append(k)
+            vs.append(v)
+        else:
+            ks.append(k.astype(cache["k"].dtype))
+            vs.append(v.astype(cache["v"].dtype))
 
-    cache = {
-        "k": cache["k"].at[:, :, :p_len].set(jnp.stack(ks)),
-        "v": cache["v"].at[:, :, :p_len].set(jnp.stack(vs)),
-    }
+    if kv_int8:
+        kq, k_s = quantize_kv(jnp.stack(ks))  # [L, B, P, C, D]
+        vq, v_s = quantize_kv(jnp.stack(vs))
+        cache = {
+            "k": cache["k"].at[:, :, :p_len].set(kq),
+            "v": cache["v"].at[:, :, :p_len].set(vq),
+            "k_scale": cache["k_scale"].at[:, :, :p_len].set(k_s),
+            "v_scale": cache["v_scale"].at[:, :, :p_len].set(v_s),
+        }
+    else:
+        cache = {
+            "k": cache["k"].at[:, :, :p_len].set(jnp.stack(ks)),
+            "v": cache["v"].at[:, :, :p_len].set(jnp.stack(vs)),
+        }
     if not last_logits:
         return cache, None
     x = _rms_norm(x, params["ln_f_scale"])
@@ -144,6 +171,11 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
     if beam_anc is not None:
         raise ValueError("beam ancestry attention is full-cache only "
                          "(no window, no pad_lens)")
+    if "k_scale" in cache:
+        raise ValueError("kv_int8 decode supports full-cache configs "
+                         "only (no attention_window, no ragged "
+                         "prompt_lengths) — those paths keep the "
+                         "compute-dtype cache")
     x = embed_rows(params["tok_emb"], tokens, dtype)  # [B, D]
     if pad_lens is None:
         pos_ids = jnp.full((b,), pos)
@@ -254,7 +286,8 @@ def _rows_update(cache_layer, rows, pos0):
     dynamic_update_slice would silently shift an out-of-range write."""
     return jax.vmap(
         lambda c, r, p: jax.lax.dynamic_update_slice(
-            c, r.astype(c.dtype), (p, 0, 0)))(cache_layer, rows, pos0)
+            c, r.astype(c.dtype),
+            (p,) + (0,) * (c.ndim - 1)))(cache_layer, rows, pos0)
 
 
 def _layer_slab_update(cache_all, i, rows, pos):
@@ -278,9 +311,9 @@ def _layer_slab_update(cache_all, i, rows, pos):
     batch-axis vmap over the stacked cache).
     """
     zero = jnp.int32(0)
+    starts = (jnp.int32(i), zero, pos) + (zero,) * (cache_all.ndim - 3)
     return jax.lax.dynamic_update_slice(
-        cache_all, rows.astype(cache_all.dtype)[None],
-        (jnp.int32(i), zero, pos, zero, zero))
+        cache_all, rows.astype(cache_all.dtype)[None], starts)
 
 
 def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
@@ -334,11 +367,17 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
     else:
         x = x + params["pos_emb"][pos_ids].astype(dtype)
 
+    kv_q = "k_scale" in cache                   # int8 KV cache
     ck_all, cv_all = cache["k"], cache["v"]     # [L, B, S, kv, hd]
+    if kv_q:
+        cks_all, cvs_all = cache["k_scale"], cache["v_scale"]
+        new_ks, new_vs = [], []
     new_k, new_v = [], []                       # per-row path accumulates
     span = jnp.arange(cfg.max_len)
     mask = (span[None, None, :] <= pos_ids[:, :, None]
             )[:, :, None, None, :]                # [B, T, 1, 1, S]
+    # [B, S, C] scale -> broadcast over the [B, T, C, G, S] logits.
+    sc_b = lambda s: s.transpose(0, 2, 1)[:, None, :, None, :]
     if beam_anc is not None:
         anc, w_beams = beam_anc
         if t_len != 1 or not uniform_pos or cfg.attention_window:
@@ -354,15 +393,27 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
         v = jnp.einsum("btd,dhk->bthk", h, deq(lp["attn"]["wv"]))
         if rope_ang is not None:
             q, k = rope_rotate(q, rope_ang), rope_rotate(k, rope_ang)
+        if kv_q:  # post-rotation, like the bf16 cache
+            k, k_s = quantize_kv(k)
+            v, v_s = quantize_kv(v)
         if uniform_pos:
             ck_all = _layer_slab_update(ck_all, i, k, pos0[0])
             cv_all = _layer_slab_update(cv_all, i, v, pos0[0])
             ck, cv = ck_all[i], cv_all[i]
+            if kv_q:
+                cks_all = _layer_slab_update(cks_all, i, k_s, pos0[0])
+                cvs_all = _layer_slab_update(cvs_all, i, v_s, pos0[0])
+                cks, cvs = cks_all[i], cvs_all[i]
         else:
             ck = _rows_update(ck_all[i], k, pos0)
             cv = _rows_update(cv_all[i], v, pos0)
             new_k.append(ck)
             new_v.append(cv)
+            if kv_q:
+                cks = _rows_update(cks_all[i], k_s, pos0)
+                cvs = _rows_update(cvs_all[i], v_s, pos0)
+                new_ks.append(cks)
+                new_vs.append(cvs)
 
         groups = cfg.n_heads // cfg.kv_heads
         qg = q.astype(jnp.float32).reshape(
@@ -380,21 +431,32 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
             vb = cv.astype(jnp.float32).reshape(
                 bt, w_beams, cfg.max_len, cfg.kv_heads, cfg.head_dim)
             la = jnp.einsum("bwcgk,bvsck->bwcgvs", qb, kb)
+            if kv_q:
+                # [bt, v, S, C] -> [bt, 1, C, 1, v, S] over la's dims.
+                bsc = lambda s: s.reshape(
+                    bt, w_beams, cfg.max_len, cfg.kv_heads).transpose(
+                    0, 3, 1, 2)[:, None, :, None, :, :]
+                la = la * bsc(cks)
             logits = jnp.einsum("bwcgvs,bwsv->bwcgs", la, anc_oh)
             logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
             bmask = mask.reshape(bt, w_beams, 1, 1, cfg.max_len)
             logits = jnp.where(bmask, logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
             pm = jnp.einsum("bwcgs,bwsv->bwcgvs", probs, anc_oh)
+            if kv_q:
+                pm = pm * bsc(cvs)
             attn = jnp.einsum("bwcgvs,bvsck->bwcgk", pm, vb).reshape(
                 b, t_len, cfg.n_heads, cfg.head_dim)
         else:
             logits = jnp.einsum("btcgk,bsck->btcgs", qg,
                                 ck.astype(jnp.float32))
+            if kv_q:
+                logits = logits * sc_b(cks)
             logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
             logits = jnp.where(mask, logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
-            attn = jnp.einsum("btcgs,bsck->btcgk", probs,
+            attn = jnp.einsum("btcgs,bsck->btcgk",
+                              probs * sc_b(cvs) if kv_q else probs,
                               cv.astype(jnp.float32)).reshape(
                 b, t_len, cfg.n_heads, cfg.head_dim)
         x = x + jnp.einsum("bthk,hkd->btd", attn.astype(dtype),
@@ -434,7 +496,12 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
     out = unembed_logits(x, params["tok_emb"], dtype)
     if not uniform_pos:
         ck_all, cv_all = jnp.stack(new_k), jnp.stack(new_v)
-    return out.astype(jnp.float32), {"k": ck_all, "v": cv_all}
+        if kv_q:
+            cks_all, cvs_all = jnp.stack(new_ks), jnp.stack(new_vs)
+    cache = {"k": ck_all, "v": cv_all}
+    if kv_q:
+        cache["k_scale"], cache["v_scale"] = cks_all, cvs_all
+    return out.astype(jnp.float32), cache
 
 
 def top_k_mask(logits, k: int, exact: bool = False):
@@ -447,7 +514,7 @@ def top_k_mask(logits, k: int, exact: bool = False):
     By default the k-th value comes from ``lax.approx_max_k`` (recall
     0.99): on TPU the exact ``lax.top_k`` over a [B, 32k] vocab costs
     more than the whole rest of a decode step (~7.8 ms vs 0.7 ms at
-    batch 64 on v5e — measured, docs/perf_serving.md finding 5), while
+    batch 64 on v5e — measured, docs/perf_serving.md finding 6), while
     the approximate threshold misidentifies only logits in a ~1% band
     around the k-th value — sampling-support noise far below the
     sampling noise itself.  Pass ``exact=True`` (or
@@ -569,7 +636,7 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
              min_p: float | None = None,
              prompt_lengths=None, eos_token: int | None = None,
              use_prefill: bool | None = None,
-             exact_top_k: bool = False):
+             exact_top_k: bool = False, kv_int8: bool = False):
     """Decode ``max_new_tokens`` past ``prompt [B, P]``; returns [B, P+N].
 
     Prefill/decode split: uniform-length prompts run through
@@ -635,6 +702,11 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
             f"top_k must be in [1, vocab_size={cfg.vocab_size}], got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if kv_int8 and (cfg.attention_window is not None
+                    or prompt_lengths is not None):
+        raise ValueError(
+            "kv_int8 decoding supports full-cache uniform-prompt "
+            "configs only (no attention_window, no prompt_lengths)")
     if min_p is not None and not 0.0 < min_p <= 1.0:
         raise ValueError(f"min_p must be in (0, 1], got {min_p}")
     key = key if key is not None else jax.random.key(0)
@@ -663,10 +735,11 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
         # Cache holds K/V for [0, p); the scan starts at the last
         # prompt position (its step recomputes identical K/V in place
         # and yields the logits that sample token p).
-        cache, _ = prefill(params, prompt, cfg, last_logits=False)
+        cache, _ = prefill(params, prompt, cfg, last_logits=False,
+                           kv_int8=kv_int8)
         start = p - 1
     else:
-        cache = init_cache(cfg, b)
+        cache = init_cache(cfg, b, kv_int8=kv_int8)
         start = 0
     done = jnp.zeros((b,), bool)
 
@@ -716,6 +789,7 @@ def beam_search(params, prompt, cfg: TransformerConfig,
                 eos_token: int | None = None,
                 use_prefill: bool | None = None,
                 length_penalty: float = 0.0,
+                kv_int8: bool = False,
                 _force_physical: bool = False):
     """Beam search decode: ``prompt [B, P]`` -> ``(sequences, scores)``
     with ``sequences [B, W, P+N]`` and ``scores [B, W]`` (sum of token
@@ -753,6 +827,9 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     if length_penalty < 0:
         raise ValueError(
             f"length_penalty must be >= 0, got {length_penalty}")
+    if kv_int8 and cfg.attention_window is not None:
+        raise ValueError("kv_int8 beam search requires a full cache "
+                         "(no attention_window)")
     total = _check_decode_budget(p, max_new_tokens, cfg, eos_token)
     prompt = jnp.asarray(prompt, jnp.int32)
     use_prefill = _resolve_prefill(params, cfg, p, use_prefill,
@@ -760,7 +837,8 @@ def beam_search(params, prompt, cfg: TransformerConfig,
 
     # ---- prompt pass on the un-tiled [B] batch -----------------------
     if use_prefill:
-        cache, _ = prefill(params, prompt, cfg, last_logits=False)
+        cache, _ = prefill(params, prompt, cfg, last_logits=False,
+                           kv_int8=kv_int8)
     elif p > 1:
         # One compiled scan, like generate()'s sequential path — an
         # unrolled eager loop would pay per-op dispatch for every
@@ -771,10 +849,10 @@ def beam_search(params, prompt, cfg: TransformerConfig,
             _, cache = _decode_step(params, cache, tok, q, cfg)
             return cache, None
 
-        cache, _ = jax.lax.scan(warm, init_cache(cfg, b),
+        cache, _ = jax.lax.scan(warm, init_cache(cfg, b, kv_int8=kv_int8),
                                 jnp.arange(p - 1))
     else:
-        cache = init_cache(cfg, b)
+        cache = init_cache(cfg, b, kv_int8=kv_int8)
     # Logits for the first generated position (recomputes p-1 in place
     # on the prefill path, same as generate()).
     logits, cache = _decode_step(params, cache, prompt[:, p - 1], p - 1,

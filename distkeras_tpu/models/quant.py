@@ -157,3 +157,19 @@ def embed_rows(tok_emb, tokens, dtype):
         rows = tok_emb.q[tokens].astype(jnp.float32)
         return (rows * tok_emb.s[tokens]).astype(dtype)
     return tok_emb[tokens].astype(dtype)
+
+
+def quantize_kv(x):
+    """Per-token, per-kv-head symmetric int8 quantization of decode-time
+    K/V rows ``[..., kv_heads, head_dim]`` -> ``(int8, scale)`` with
+    ``scale [..., kv_heads]`` = absmax / 127 over head_dim.
+
+    The int8 KV cache halves the cache-byte term that dominates batched
+    decode once the loop is at the HBM roofline (docs/perf_serving.md
+    finding 1 — only byte reduction goes faster).  Scales stay f32:
+    they are head_dim x smaller than the data.
+    """
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    return q.astype(jnp.int8), scale

@@ -83,7 +83,8 @@ def cache_bytes_per_row(cfg, filled, bytes_per_el=2):
 
 
 def _measure_decode(cfg, params, batch, new, p_len=64, iters=3,
-                    w_bytes=None, seq_steps=None, **gen_kw):
+                    w_bytes=None, seq_steps=None, c_bytes=None,
+                    **gen_kw):
     """``seq_steps``: actual decode-step count of the compiled scan.
     Defaults to ``new`` (the prefill path); the quantized tree forces
     the sequential path, which teacher-forces p_len - 1 extra steps —
@@ -105,7 +106,9 @@ def _measure_decode(cfg, params, batch, new, p_len=64, iters=3,
 
     step_s = dt / (seq_steps if seq_steps is not None else new)
     w_bytes = w_bytes if w_bytes is not None else weight_bytes(cfg)
-    step_bytes = w_bytes + batch * cache_bytes_per_row(cfg, p_len + new)
+    c_bytes = (c_bytes if c_bytes is not None
+               else cache_bytes_per_row(cfg, p_len + new))
+    step_bytes = w_bytes + batch * c_bytes
     extras = {"batch": batch, "prompt_len": p_len, "new_tokens": new,
               "step_bytes_mb": round(step_bytes / 1e6, 1)}
     import jax as _j
@@ -116,13 +119,41 @@ def _measure_decode(cfg, params, batch, new, p_len=64, iters=3,
     return batch * new / dt, step_s, 0.0, extras
 
 
-def _params(quant=False):
+def _params(quant=False, cfg=None):
     import jax
     from distkeras_tpu.models import transformer as tfm
     from distkeras_tpu.models.quant import quantize_params
 
-    p = tfm.init_params(jax.random.key(0), _cfg())
+    p = tfm.init_params(jax.random.key(0), cfg or _cfg())
     return quantize_params(p) if quant else p
+
+
+def bench_kv_int8(batch):
+    # int8 KV cache (quant.quantize_kv): cache data bytes halve; the
+    # per-token per-head f32 scales add head_dim/4 x less. The modeled
+    # cache term counts both.
+    def run():
+        cfg = _cfg()
+        c_bytes = (cache_bytes_per_row(cfg, None, bytes_per_el=1)
+                   + 2 * cfg.n_layers * cfg.max_len * cfg.kv_heads * 4)
+        return _measure_decode(cfg, _params(), batch, new=512,
+                               kv_int8=True, c_bytes=c_bytes)
+    return run
+
+
+def bench_gqa4(batch):
+    # GQA 4:1 (kv_heads 2 of 8): the cache-byte term drops 4x by
+    # architecture. wk/wv shrink too (project to kv_heads only).
+    def run():
+        import dataclasses
+
+        cfg = dataclasses.replace(_cfg(), n_kv_heads=2)
+        d = cfg.d_model
+        w_b = weight_bytes(cfg) - 2 * cfg.n_layers * d * (
+            d - cfg.kv_heads * cfg.head_dim) * 2
+        return _measure_decode(cfg, _params(cfg=cfg), batch, new=512,
+                               w_bytes=w_b)
+    return run
 
 
 def bench_greedy(batch):
@@ -253,6 +284,9 @@ BENCHES = {
     "decode_int8_b1": (bench_int8(1), "tokens/sec/chip"),
     "decode_int8_b8": (bench_int8(8), "tokens/sec/chip"),
     "decode_int8_b64": (bench_int8(64), "tokens/sec/chip"),
+    "decode_kv_int8_b8": (bench_kv_int8(8), "tokens/sec/chip"),
+    "decode_kv_int8_b64": (bench_kv_int8(64), "tokens/sec/chip"),
+    "decode_gqa4_b64": (bench_gqa4(64), "tokens/sec/chip"),
     "decode_rolling_window": (bench_rolling_window(), "tokens/sec/chip"),
     "beam4": (bench_beam4(), "tokens/sec/chip"),
     "decode_speculative_int8draft": (bench_speculative_int8draft(),

@@ -840,3 +840,63 @@ def test_top_k_mask_approx_path():
     np.testing.assert_array_equal(
         np.asarray(top_k_mask(small, 40)),
         np.asarray(top_k_mask(small, 40, exact=True)))
+
+
+# ---------------------------------------------------------- int8 KV cache
+
+def test_kv_int8_decode_close_to_fp(rng):
+    """int8 KV cache: teacher-forced logits track the full-precision
+    decode within quantization noise, and greedy generation on a
+    near-deterministic model is unchanged."""
+    from distkeras_tpu.models.generate import _decode_step
+
+    cfg = ROPE_CFG
+    params = tfm.init_params(jax.random.key(0), cfg)
+    toks = jnp.asarray(rng.integers(0, 64, (2, 12)).astype(np.int32))
+    full_logits, _ = tfm.apply(params, toks, cfg)
+
+    cache = init_cache(cfg, 2, kv_int8=True)
+    for pos in range(12):
+        logits, cache = _decode_step(params, cache, toks[:, pos], pos, cfg)
+        base = np.abs(np.asarray(full_logits[:, pos])).max()
+        np.testing.assert_allclose(logits, full_logits[:, pos],
+                                   atol=0.05 * base, rtol=0.1)
+
+
+def test_kv_int8_generate_prefill_matches_sequential(rng):
+    """Prefill-quantized and step-quantized caches see the same K/V
+    values, so the two prompt paths agree under kv_int8 like they do in
+    the compute dtype."""
+    params = tfm.init_params(jax.random.key(1), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 6)).astype(np.int32))
+    a = generate(params, prompt, CFG, 6, kv_int8=True, use_prefill=True)
+    b = generate(params, prompt, CFG, 6, kv_int8=True, use_prefill=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_kv_int8_beam_and_validation(rng):
+    """Beam search runs on the int8 cache through BOTH the ancestry and
+    physical paths with identical results; windowed/ragged configs
+    reject kv_int8 loudly."""
+    import dataclasses
+
+    from distkeras_tpu.models.generate import beam_search
+
+    params = tfm.init_params(jax.random.key(2), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)).astype(np.int32))
+    sa, sca = beam_search(params, prompt, CFG, 6, beam_width=3,
+                          kv_int8=True)
+    sp, scp = beam_search(params, prompt, CFG, 6, beam_width=3,
+                          kv_int8=True, _force_physical=True)
+    np.testing.assert_array_equal(np.asarray(sa), np.asarray(sp))
+    np.testing.assert_allclose(np.asarray(sca), np.asarray(scp),
+                               atol=1e-5, rtol=1e-5)
+    win_cfg = dataclasses.replace(CFG, attention_window=4)
+    with pytest.raises(ValueError, match="kv_int8"):
+        generate(params, prompt, win_cfg, 4, kv_int8=True)
+    with pytest.raises(ValueError, match="kv_int8"):
+        generate(params, prompt, CFG, 4, kv_int8=True,
+                 prompt_lengths=[2, 4])
+    with pytest.raises(ValueError, match="kv_int8"):
+        beam_search(params, prompt, win_cfg, 4, beam_width=2,
+                    kv_int8=True)
