@@ -636,7 +636,8 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
              min_p: float | None = None,
              prompt_lengths=None, eos_token: int | None = None,
              use_prefill: bool | None = None,
-             exact_top_k: bool = False, kv_int8: bool = False):
+             exact_top_k: bool = False, kv_int8: bool = False,
+             prompt_cache=None):
     """Decode ``max_new_tokens`` past ``prompt [B, P]``; returns [B, P+N].
 
     Prefill/decode split: uniform-length prompts run through
@@ -655,6 +656,16 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     top_k_mask: exact lax.top_k costs more than the rest of the decode
     step at large vocab); ``exact_top_k=True`` restores the exact
     support.
+
+    ``prompt_cache=(cache, cached_len)`` reuses a prefilled prefix —
+    the system-prompt pattern: ``prefill`` the shared prefix once (at
+    the request batch or batch 1, which fans out), then pass each
+    request's remaining prompt here.  The suffix is processed in ONE
+    chunked pass against the existing cache, and emitted tokens match
+    the concatenated-prompt run exactly (sampling is position-keyed,
+    so even sampled streams agree).  Full-cache configs only; the
+    cache's quantization must match ``kv_int8``.  Returns [B, p + N]
+    (the prefix tokens are the caller's already).
 
     PRNG stream contract (changed in round 2): the key for position
     ``pos`` is ``jax.random.fold_in(key, pos)`` — a pure function of
@@ -709,6 +720,38 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
             "configs only (no attention_window, no prompt_lengths)")
     if min_p is not None and not 0.0 < min_p <= 1.0:
         raise ValueError(f"min_p must be in (0, 1], got {min_p}")
+    cached_len = 0
+    if prompt_cache is not None:
+        pc_cache, cached_len = prompt_cache
+        if cfg.attention_window is not None or prompt_lengths is not None:
+            raise ValueError(
+                "prompt_cache requires a full-cache uniform-prompt "
+                "config (no attention_window, no prompt_lengths)")
+        if cached_len < 1:
+            raise ValueError(
+                f"cached prefix length must be >= 1, got {cached_len} "
+                "(an empty prefix is just a plain generate call)")
+        if cached_len > cfg.max_len - p - max_new_tokens:
+            raise ValueError(
+                f"cached prefix length {cached_len} + prompt {p} + "
+                f"{max_new_tokens} new tokens must fit max_len="
+                f"{cfg.max_len}")
+        if ("k_scale" in pc_cache) != kv_int8:
+            raise ValueError(
+                "prompt_cache quantization must match kv_int8= (build "
+                "the prefix cache with prefill(..., kv_int8=...))")
+        pcb = pc_cache["k"].shape[1]
+        if pcb == b:
+            cache = pc_cache
+        elif pcb == 1:
+            # Shared prefix (e.g. a system prompt) prefilled once at
+            # batch 1, fanned out per request.
+            cache = jax.tree.map(
+                lambda a: jnp.repeat(a, b, axis=1), pc_cache)
+        else:
+            raise ValueError(
+                f"prompt_cache batch {pcb} incompatible with prompt "
+                f"batch {b} (must match or be 1)")
     key = key if key is not None else jax.random.key(0)
 
     pad_lens = None
@@ -726,12 +769,34 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
         # Right-align each row: [tok..., pad...] -> [pad..., tok...].
         prompt = jax.vmap(jnp.roll)(prompt, pad_lens)
 
-    use_prefill = _resolve_prefill(params, cfg, p, use_prefill,
-                                   ragged=pad_lens is not None)
+    # prompt_cache takes its own suffix-chunk path: prefill
+    # eligibility is moot there (and its >= 2-token / full-precision
+    # preconditions do not apply to _decode_chunk).
+    if prompt_cache is None:
+        use_prefill = _resolve_prefill(params, cfg, p, use_prefill,
+                                       ragged=pad_lens is not None)
+    elif use_prefill is not None:
+        raise ValueError(
+            "use_prefill has no effect with prompt_cache (the suffix "
+            "always runs as one chunked pass); drop the argument")
 
-    # Buffer of emitted tokens; prompt occupies [0, p).
-    buf = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
-    if use_prefill:
+    # Buffer of emitted tokens; absolute positions — the prompt
+    # occupies [cached_len, cached_len + p).
+    total = cached_len + total
+    buf = jnp.zeros((b, total), jnp.int32
+                    ).at[:, cached_len:cached_len + p].set(prompt)
+    if prompt_cache is not None:
+        # Suffix prefill against the existing prefix cache: ONE chunked
+        # pass writes the prompt's K/V at [cached_len, cached_len + p)
+        # and attends prefix + in-chunk-causal prompt (the same
+        # _decode_chunk speculative decoding trusts).  The scan then
+        # starts at the last prompt position, recomputing it in place —
+        # the same convention as the prefill path below.
+        _, cache = _decode_chunk(params, cache, prompt,
+                                 jnp.full((b,), cached_len, jnp.int32),
+                                 cfg, uniform_pos=True)
+        start = cached_len + p - 1
+    elif use_prefill:
         # Cache holds K/V for [0, p); the scan starts at the last
         # prompt position (its step recomputes identical K/V in place
         # and yields the logits that sample token p).
@@ -766,7 +831,7 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
         nxt = nxt.astype(jnp.int32)
         # Only write past the prompt (prompt positions are forced).
         write_pos = jnp.minimum(pos + 1, total - 1)
-        gen = write_pos >= p
+        gen = write_pos >= cached_len + p
         if eos_token is not None:
             nxt = jnp.where(done & gen, eos_token, nxt)  # sticky fill
             done = done | (gen & (nxt == eos_token))
@@ -781,7 +846,9 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     if pad_lens is not None:
         # Back to the input layout: prompt, generation, then padding.
         buf = jax.vmap(jnp.roll)(buf, -pad_lens)
-    return buf
+    # prompt_cache callers get [B, p + new] — the prefix tokens are
+    # theirs already; positions stay absolute internally.
+    return buf[:, cached_len:] if cached_len else buf
 
 
 def beam_search(params, prompt, cfg: TransformerConfig,

@@ -274,6 +274,50 @@ def bench_speculative_int8draft():
     return run
 
 
+def bench_prefix_ttft():
+    # Time-to-first-token with a reused 512-token prefix vs prefilling
+    # prefix+tail from scratch: the system-prompt serving pattern.
+    # Reported value = scratch_ttft / cached_ttft (the reuse speedup);
+    # extras carry both absolute latencies.
+    def run():
+        import jax
+        import numpy as np
+        from distkeras_tpu.models.generate import generate, prefill
+
+        cfg = _cfg()
+        params = _params()
+        rng = np.random.default_rng(0)
+        prefix = jax.device_put(rng.integers(
+            0, cfg.vocab_size, (8, 512)).astype(np.int32))
+        tail = jax.device_put(rng.integers(
+            0, cfg.vocab_size, (8, 32)).astype(np.int32))
+        full = jax.numpy.concatenate([prefix, tail], axis=1)
+        cache, _ = jax.jit(
+            lambda pp, pr: prefill(pp, pr, cfg, last_logits=False)
+        )(params, prefix)
+        g_scratch = jax.jit(lambda pp, pr: generate(pp, pr, cfg, 1))
+        g_cached = jax.jit(lambda pp, pr, c: generate(
+            pp, pr, cfg, 1, prompt_cache=(c, 512)))
+        int(np.asarray(g_scratch(params, full))[0, -1])
+        int(np.asarray(g_cached(params, tail, cache))[0, -1])
+        iters = 10
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = g_scratch(params, full)
+        int(np.asarray(out)[0, -1])
+        scratch = (time.perf_counter() - t0) / iters
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = g_cached(params, tail, cache)
+        int(np.asarray(out)[0, -1])
+        cached = (time.perf_counter() - t0) / iters
+        return scratch / cached, cached, 0.0, {
+            "scratch_ttft_ms": round(scratch * 1e3, 2),
+            "cached_ttft_ms": round(cached * 1e3, 2),
+            "prefix_len": 512, "tail_len": 32}
+    return run
+
+
 BENCHES = {
     "decode_greedy_b1": (bench_greedy(1), "tokens/sec/chip"),
     "decode_greedy_b8": (bench_greedy(8), "tokens/sec/chip"),
@@ -284,6 +328,7 @@ BENCHES = {
     "decode_int8_b1": (bench_int8(1), "tokens/sec/chip"),
     "decode_int8_b8": (bench_int8(8), "tokens/sec/chip"),
     "decode_int8_b64": (bench_int8(64), "tokens/sec/chip"),
+    "prefix_cache_ttft": (bench_prefix_ttft(), "x speedup"),
     "decode_kv_int8_b8": (bench_kv_int8(8), "tokens/sec/chip"),
     "decode_kv_int8_b64": (bench_kv_int8(64), "tokens/sec/chip"),
     "decode_gqa4_b64": (bench_gqa4(64), "tokens/sec/chip"),

@@ -900,3 +900,99 @@ def test_kv_int8_beam_and_validation(rng):
     with pytest.raises(ValueError, match="kv_int8"):
         beam_search(params, prompt, win_cfg, 4, beam_width=2,
                     kv_int8=True)
+
+
+# ------------------------------------------------------- prompt/prefix cache
+
+def test_prompt_cache_matches_full_prompt(rng):
+    """Reusing a prefilled prefix cache (system-prompt pattern) emits
+    EXACTLY the tokens of running the concatenated prompt from scratch
+    — greedy and sampled (the position-keyed PRNG stream makes the
+    sampled comparison exact), batch-matched and batch-1-broadcast."""
+    from distkeras_tpu.models.generate import prefill
+
+    params = tfm.init_params(jax.random.key(0), ROPE_CFG)
+    prefix = jnp.asarray(rng.integers(0, 64, (2, 5)).astype(np.int32))
+    tail = jnp.asarray(rng.integers(0, 64, (2, 3)).astype(np.int32))
+    full = jnp.concatenate([prefix, tail], axis=1)
+
+    ref = generate(params, full, ROPE_CFG, 6)
+    cache, _ = prefill(params, prefix, ROPE_CFG, last_logits=False)
+    out = generate(params, tail, ROPE_CFG, 6, prompt_cache=(cache, 5))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref[:, 5:]))
+
+    k = jax.random.key(9)
+    ref_s = generate(params, full, ROPE_CFG, 6, temperature=0.9, top_k=8,
+                     key=k)
+    out_s = generate(params, tail, ROPE_CFG, 6, temperature=0.9, top_k=8,
+                     key=k, prompt_cache=(cache, 5))
+    np.testing.assert_array_equal(np.asarray(out_s),
+                                  np.asarray(ref_s[:, 5:]))
+
+    # Batch-1 shared prefix fans out to the request batch.
+    cache1, _ = prefill(params, prefix[:1], ROPE_CFG, last_logits=False)
+    prefix_b = jnp.broadcast_to(prefix[:1], prefix.shape)
+    ref_b = generate(params, jnp.concatenate([prefix_b, tail], axis=1),
+                     ROPE_CFG, 6)
+    out_b = generate(params, tail, ROPE_CFG, 6, prompt_cache=(cache1, 5))
+    np.testing.assert_array_equal(np.asarray(out_b),
+                                  np.asarray(ref_b[:, 5:]))
+
+
+def test_prompt_cache_kv_int8_and_validation(rng):
+    from distkeras_tpu.models.generate import prefill
+
+    params = tfm.init_params(jax.random.key(1), CFG)
+    prefix = jnp.asarray(rng.integers(0, 64, (2, 4)).astype(np.int32))
+    tail = jnp.asarray(rng.integers(0, 64, (2, 2)).astype(np.int32))
+    qcache, _ = prefill(params, prefix, CFG, last_logits=False,
+                        kv_int8=True)
+    full = jnp.concatenate([prefix, tail], axis=1)
+    ref = generate(params, full, CFG, 4, kv_int8=True)
+    out = generate(params, tail, CFG, 4, kv_int8=True,
+                   prompt_cache=(qcache, 4))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref[:, 4:]))
+
+    with pytest.raises(ValueError, match="quantization must match"):
+        generate(params, tail, CFG, 4, prompt_cache=(qcache, 4))
+    with pytest.raises(ValueError, match="fit max_len"):
+        generate(params, tail, CFG, 12, prompt_cache=(qcache, 4))
+    bad = jax.tree.map(lambda a: jnp.repeat(a, 3, axis=1), qcache)
+    with pytest.raises(ValueError, match="batch"):
+        generate(params, tail, CFG, 4, kv_int8=True,
+                 prompt_cache=(bad, 4))
+
+
+def test_prompt_cache_single_token_tail_and_quantized(rng):
+    """Code-review regressions: a 1-token tail and a quantized tree both
+    work with prompt_cache (no _resolve_prefill interference), and the
+    error messages distinguish empty prefixes from budget overflow."""
+    from distkeras_tpu.models.generate import prefill
+    from distkeras_tpu.models.quant import quantize_params
+
+    params = tfm.init_params(jax.random.key(1), CFG)
+    prefix = jnp.asarray(rng.integers(0, 64, (2, 4)).astype(np.int32))
+    tail = jnp.asarray(rng.integers(0, 64, (2, 1)).astype(np.int32))
+    cache, _ = prefill(params, prefix, CFG, last_logits=False)
+    full = jnp.concatenate([prefix, tail], axis=1)
+    ref = generate(params, full, CFG, 4)
+    out = generate(params, tail, CFG, 4, prompt_cache=(cache, 4))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref[:, 4:]))
+
+    # Quantized tree + prompt_cache: the regression is that
+    # _resolve_prefill's full-precision precondition no longer blocks
+    # the call.  (The cache here holds full-precision prefix K/V while
+    # the tail decodes through int8 weights — a legitimate mixed
+    # deployment, but not bit-comparable to any single-precision
+    # reference, so this is a smoke + shape check, not an equality.)
+    qp = quantize_params(params)
+    qout = generate(qp, tail, CFG, 4, prompt_cache=(cache, 4))
+    assert qout.shape == (2, 5)
+    np.testing.assert_array_equal(np.asarray(qout[:, :1]),
+                                  np.asarray(tail))
+
+    with pytest.raises(ValueError, match=">= 1"):
+        generate(params, tail, CFG, 4, prompt_cache=(cache, 0))
+    with pytest.raises(ValueError, match="no effect with prompt_cache"):
+        generate(params, tail, CFG, 4, prompt_cache=(cache, 4),
+                 use_prefill=True)
