@@ -142,3 +142,28 @@ def test_speculative_tp_sharded_matches_single(devices, rng):
     out = fn(jax.device_put(params, psh), jax.device_put(draft, dsh),
              jax.device_put(prompt, tsh))
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_prompt_cache_decode_under_tp(rng):
+    """Prefix-cache reuse composes with TP-sharded params: the prefix
+    cache built by sharded prefill + the suffix chunked pass emit
+    exactly the single-device concatenated-prompt tokens."""
+    from distkeras_tpu.models.generate import prefill
+
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prefix = _prompt(rng, b=8, p=4)
+    tail = _prompt(rng, b=8, p=3)
+    full = jnp.concatenate([prefix, tail], axis=1)
+    ref = np.asarray(generate(params, full, CFG, 8))[:, 4:]
+
+    mesh, psh = _tp_layout(jax.devices()[:8], params)
+    params_sh = jax.device_put(params, psh)
+    dsh = NamedSharding(mesh, P("data", None))
+    cache = jax.jit(
+        lambda pr, t: prefill(pr, t, CFG, last_logits=False)[0],
+        in_shardings=(psh, dsh))(params_sh, jax.device_put(prefix, dsh))
+    out = jax.jit(
+        lambda pr, t, c: generate(pr, t, CFG, 8, prompt_cache=(c, 4)),
+        in_shardings=(psh, dsh, None))(
+        params_sh, jax.device_put(tail, dsh), cache)
+    np.testing.assert_array_equal(np.asarray(out), ref)
