@@ -83,7 +83,7 @@ def _validate(params, draft_params, cfg, draft_cfg, p, max_new_tokens,
     return total
 
 
-def _warm_cache(model_params, model_cfg, buf, p):
+def _warm_cache(model_params, model_cfg, buf, p, kv_int8=False):
     """Fill a cache for prompt positions 0..p-2 (position p-1 is
     re-processed by the first verify/draft chunk, like generate()'s
     prefill path).  Prefill when eligible; otherwise (quantized tree or
@@ -95,9 +95,9 @@ def _warm_cache(model_params, model_cfg, buf, p):
     b = buf.shape[0]
     if p > 1 and not is_quantized(model_params):
         cache, _ = prefill(model_params, buf[:, :p], model_cfg,
-                           last_logits=False)
+                           last_logits=False, kv_int8=kv_int8)
         return cache
-    cache = init_cache(model_cfg, b)
+    cache = init_cache(model_cfg, b, kv_int8=kv_int8)
     start = 0
     while start < p - 1:  # static python loop: p is a trace constant
         width = min(128, p - 1 - start)
@@ -112,7 +112,8 @@ def _warm_cache(model_params, model_cfg, buf, p):
 def speculative_generate(params, draft_params, prompt, cfg: TransformerConfig,
                          draft_cfg: TransformerConfig, max_new_tokens: int,
                          n_draft: int = 4, temperature: float = 0.0,
-                         key=None, eos_token: int | None = None):
+                         key=None, eos_token: int | None = None,
+                         kv_int8: bool = False):
     """Decode ``max_new_tokens`` past ``prompt [B, P]`` with draft
     assistance; returns ``(tokens [B, P+N], stats)``.
 
@@ -131,6 +132,9 @@ def speculative_generate(params, draft_params, prompt, cfg: TransformerConfig,
     sampling matters more than latency).  Quantized (int8) target or
     draft trees work — the chunk path dequantizes per read, and the
     prompt falls back to sequential warm for a quantized tree.
+    ``kv_int8=True`` stores BOTH models' caches int8 (generate's
+    cache-byte lever; the per-row accept-divergence writes carry the
+    scale leaves through the same row-update path).
     """
     from distkeras_tpu.models.generate import _device_tree
 
@@ -155,8 +159,8 @@ def speculative_generate(params, draft_params, prompt, cfg: TransformerConfig,
     # onto the row's final token and corrupts it (caught by
     # test_nonuniform_acceptance_rows_finish_cleanly).
     buf = jnp.zeros((b, total + k + 1), jnp.int32).at[:, :p].set(prompt)
-    tcache = _warm_cache(params, cfg, buf, p)
-    dcache = _warm_cache(draft_params, draft_cfg, buf, p)
+    tcache = _warm_cache(params, cfg, buf, p, kv_int8=kv_int8)
+    dcache = _warm_cache(draft_params, draft_cfg, buf, p, kv_int8=kv_int8)
 
     cur0 = jnp.full((b,), p - 1, jnp.int32)  # last FINAL position per row
     idx = jnp.arange(k + 1)

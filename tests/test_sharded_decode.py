@@ -144,7 +144,7 @@ def test_speculative_tp_sharded_matches_single(devices, rng):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
-def test_prompt_cache_decode_under_tp(rng):
+def test_prompt_cache_decode_under_tp(devices, rng):
     """Prefix-cache reuse composes with TP-sharded params: the prefix
     cache built by sharded prefill + the suffix chunked pass emit
     exactly the single-device concatenated-prompt tokens."""
@@ -156,7 +156,7 @@ def test_prompt_cache_decode_under_tp(rng):
     full = jnp.concatenate([prefix, tail], axis=1)
     ref = np.asarray(generate(params, full, CFG, 8))[:, 4:]
 
-    mesh, psh = _tp_layout(jax.devices()[:8], params)
+    mesh, psh = _tp_layout(devices, params)
     params_sh = jax.device_put(params, psh)
     dsh = NamedSharding(mesh, P("data", None))
     cache = jax.jit(

@@ -272,3 +272,17 @@ def test_eos_validation(rng):
     with pytest.raises(ValueError, match="eos_token"):
         speculative_generate(params, draft, prompt, CFG, DRAFT, 4,
                              eos_token=64)
+
+
+def test_speculative_kv_int8_greedy_matches_generate_kv_int8(rng):
+    """Speculative decoding with int8 caches on both models emits the
+    same tokens as plain kv_int8 generate: quantization is per-token
+    deterministic, so the verify-chunk cache and the slab-update cache
+    hold identical int8 values."""
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (3, 4)).astype(np.int32))
+    ref = np.asarray(generate(params, prompt, CFG, 8, kv_int8=True))
+    out, stats = speculative_generate(params, params, prompt, CFG, CFG,
+                                      8, n_draft=3, kv_int8=True)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert float(stats["acceptance_rate"]) > 0.9  # self-draft
