@@ -44,6 +44,31 @@ _OPTS = {
 }
 
 
+def _with_ema(opt, decay: float):
+    """Wrap an optax transform so its state carries a Polyak/EMA shadow
+    of the parameters: ``state = (inner_state, ema_params)``.
+
+    The shadow updates with the POST-step parameters each optimizer
+    step (``apply_updates`` on the incoming params — the same value the
+    train step is about to adopt).  Living inside the optimizer state
+    means checkpoint/resume and the params-like positional sharding
+    rule (_state_shardings) cover it for free; LMTrainer exposes it as
+    ``.ema_params`` after training.
+    """
+    def init(params):
+        return opt.init(params), jax.tree.map(jnp.asarray, params)
+
+    def update(grads, state, params=None, **kw):
+        inner, shadow = state
+        updates, inner = opt.update(grads, inner, params, **kw)
+        stepped = optax.apply_updates(params, updates)
+        shadow = jax.tree.map(
+            lambda s, q: decay * s + (1.0 - decay) * q, shadow, stepped)
+        return updates, (inner, shadow)
+
+    return optax.GradientTransformation(init, update)
+
+
 class LMTrainer(CheckpointingBase):
     """Train a causal transformer LM over a device mesh.
 
@@ -54,7 +79,24 @@ class LMTrainer(CheckpointingBase):
     :class:`~distkeras_tpu.trainers.base.Trainer` (reference keeps one
     uniform contract across its family, distkeras/trainers.py).
     A checkpoint round is one optimizer step.
+
+    ``ema_decay``: maintain a Polyak/EMA average of the weights inside
+    the optimizer state (decay per optimizer step); after ``train``,
+    ``self.ema_params`` holds the servable averaged tree.  Composes
+    with the mesh/checkpoint/accum features because the shadow is just
+    more optimizer state.  Not offered on LoRATrainer (its optax.masked
+    re-wrap would shadow a MaskedNode-laden packed tree; the servable
+    artifact there is the merged tree ``train`` already returns).
     """
+
+    @property
+    def ema_params(self):
+        """EMA weight tree from the last ``train`` call (requires
+        ``ema_decay``); None before training."""
+        if not self._ema:
+            raise ValueError("ema_params requires ema_decay= on the "
+                             "constructor")
+        return self._ema_params
 
     def __init__(self, cfg: tfm.TransformerConfig, optimizer="adamw",
                  learning_rate: float = 3e-4, weight_decay: float | None = None,
@@ -66,7 +108,8 @@ class LMTrainer(CheckpointingBase):
                  shuffle: bool = False, eval_every: int = 0,
                  profile_dir: str | None = None, profile_steps: int = 3,
                  checkpoint_dir: str | None = None, checkpoint_every: int = 0,
-                 max_checkpoints: int = 3, resume: bool = False):
+                 max_checkpoints: int = 3, resume: bool = False,
+                 ema_decay: float | None = None):
         self.cfg = cfg
         if not callable(learning_rate) and learning_rate <= 0:
             raise ValueError(
@@ -106,6 +149,16 @@ class LMTrainer(CheckpointingBase):
                     f"grad_clip_norm must be positive, got {grad_clip_norm}")
             self.optimizer = optax.chain(
                 optax.clip_by_global_norm(grad_clip_norm), self.optimizer)
+        if ema_decay is not None:
+            if not 0.0 < ema_decay < 1.0:
+                raise ValueError(
+                    f"ema_decay must be in (0, 1), got {ema_decay}")
+            # The shadow rides INSIDE the optimizer state, so
+            # checkpointing, resume, and the params-like sharding rule
+            # all cover it with zero extra machinery.
+            self.optimizer = _with_ema(self.optimizer, ema_decay)
+        self._ema = ema_decay is not None
+        self._ema_params = None
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.grad_accum = grad_accum
@@ -543,7 +596,9 @@ class LMTrainer(CheckpointingBase):
                 except Exception:
                     pass
             self._close_checkpoints()
-        params, _ = carry
+        params, opt_state = carry
+        if self._ema:
+            self._ema_params = opt_state[1]
         jax.block_until_ready(jax.tree.leaves(params)[0])
         self.history = [float(l) for l in losses]
         self.training_time = time.perf_counter() - t0
@@ -585,6 +640,12 @@ class LoRATrainer(LMTrainer):
         self.lora = LoRAConfig(rank=lora_rank, alpha=lora_alpha,
                                targets=tuple(lora_targets))
         _validate(cfg, self.lora)
+        if kw.get("ema_decay") is not None:
+            raise ValueError(
+                "ema_decay is not supported on LoRATrainer: the "
+                "adapter-masked optimizer state cannot shadow the "
+                "frozen base; serve the merged tree train() returns "
+                "(or EMA-average adapters outside the trainer)")
         super().__init__(cfg, **kw)
         self.optimizer = optax.masked(self.optimizer, lora_mask)
         self._base_host = base_params
