@@ -630,6 +630,46 @@ def _resolve_prefill(params, cfg: TransformerConfig, p: int,
     return use_prefill
 
 
+def _resolve_prompt_cache(prompt_cache, cfg, b, p, max_new_tokens,
+                          kv_int8, use_prefill):
+    """ONE definition of the prompt_cache contract (generate and
+    beam_search must not drift): validates the config/budget/
+    quantization/batch constraints and returns ``(cache, cached_len)``
+    with a batch-1 prefix fanned out to ``b`` rows."""
+    pc_cache, cached_len = prompt_cache
+    if cfg.attention_window is not None:
+        raise ValueError("prompt_cache requires a full-cache config "
+                         "(no attention_window)")
+    if use_prefill is not None:
+        raise ValueError(
+            "use_prefill has no effect with prompt_cache (the suffix "
+            "always runs as one chunked pass); drop the argument")
+    if cached_len < 1:
+        raise ValueError(
+            f"cached prefix length must be >= 1, got {cached_len} "
+            "(an empty prefix is just a plain call)")
+    if cached_len > cfg.max_len - p - max_new_tokens:
+        raise ValueError(
+            f"cached prefix length {cached_len} + prompt {p} + "
+            f"{max_new_tokens} new tokens must fit max_len="
+            f"{cfg.max_len}")
+    if ("k_scale" in pc_cache) != kv_int8:
+        raise ValueError(
+            "prompt_cache quantization must match kv_int8= (build "
+            "the prefix cache with prefill(..., kv_int8=...))")
+    pcb = pc_cache["k"].shape[1]
+    if pcb == b:
+        return pc_cache, cached_len
+    if pcb == 1:
+        # Shared prefix (e.g. a system prompt) prefilled once at
+        # batch 1, fanned out per request.
+        return jax.tree.map(
+            lambda a: jnp.repeat(a, b, axis=1), pc_cache), cached_len
+    raise ValueError(
+        f"prompt_cache batch {pcb} incompatible with prompt "
+        f"batch {b} (must match or be 1)")
+
+
 def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
              temperature: float = 0.0, key=None,
              top_k: int | None = None, top_p: float | None = None,
@@ -722,36 +762,13 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
         raise ValueError(f"min_p must be in (0, 1], got {min_p}")
     cached_len = 0
     if prompt_cache is not None:
-        pc_cache, cached_len = prompt_cache
-        if cfg.attention_window is not None or prompt_lengths is not None:
+        if prompt_lengths is not None:
             raise ValueError(
-                "prompt_cache requires a full-cache uniform-prompt "
-                "config (no attention_window, no prompt_lengths)")
-        if cached_len < 1:
-            raise ValueError(
-                f"cached prefix length must be >= 1, got {cached_len} "
-                "(an empty prefix is just a plain generate call)")
-        if cached_len > cfg.max_len - p - max_new_tokens:
-            raise ValueError(
-                f"cached prefix length {cached_len} + prompt {p} + "
-                f"{max_new_tokens} new tokens must fit max_len="
-                f"{cfg.max_len}")
-        if ("k_scale" in pc_cache) != kv_int8:
-            raise ValueError(
-                "prompt_cache quantization must match kv_int8= (build "
-                "the prefix cache with prefill(..., kv_int8=...))")
-        pcb = pc_cache["k"].shape[1]
-        if pcb == b:
-            cache = pc_cache
-        elif pcb == 1:
-            # Shared prefix (e.g. a system prompt) prefilled once at
-            # batch 1, fanned out per request.
-            cache = jax.tree.map(
-                lambda a: jnp.repeat(a, b, axis=1), pc_cache)
-        else:
-            raise ValueError(
-                f"prompt_cache batch {pcb} incompatible with prompt "
-                f"batch {b} (must match or be 1)")
+                "prompt_cache requires uniform prompts "
+                "(no prompt_lengths)")
+        cache, cached_len = _resolve_prompt_cache(
+            prompt_cache, cfg, b, p, max_new_tokens, kv_int8,
+            use_prefill)
     key = key if key is not None else jax.random.key(0)
 
     pad_lens = None
@@ -771,14 +788,11 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
 
     # prompt_cache takes its own suffix-chunk path: prefill
     # eligibility is moot there (and its >= 2-token / full-precision
-    # preconditions do not apply to _decode_chunk).
+    # preconditions do not apply to _decode_chunk; the helper already
+    # rejected an explicit use_prefill).
     if prompt_cache is None:
         use_prefill = _resolve_prefill(params, cfg, p, use_prefill,
                                        ragged=pad_lens is not None)
-    elif use_prefill is not None:
-        raise ValueError(
-            "use_prefill has no effect with prompt_cache (the suffix "
-            "always runs as one chunked pass); drop the argument")
 
     # Buffer of emitted tokens; absolute positions — the prompt
     # occupies [cached_len, cached_len + p).
@@ -856,7 +870,7 @@ def beam_search(params, prompt, cfg: TransformerConfig,
                 eos_token: int | None = None,
                 use_prefill: bool | None = None,
                 length_penalty: float = 0.0,
-                kv_int8: bool = False,
+                kv_int8: bool = False, prompt_cache=None,
                 _force_physical: bool = False):
     """Beam search decode: ``prompt [B, P]`` -> ``(sequences, scores)``
     with ``sequences [B, W, P+N]`` and ``scores [B, W]`` (sum of token
@@ -880,6 +894,11 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     top-W.  Uniform-length prompts only (use :func:`generate` for
     ragged batches); quantized trees decode like everywhere else, but
     force the sequential prompt path.
+
+    ``prompt_cache=(cache, cached_len)``: reuse a prefilled shared
+    prefix exactly as in :func:`generate` — the suffix runs as one
+    chunked pass, hypotheses match beaming the concatenated prompt,
+    and the returned sequences cover [prompt, generation] only.
     """
     params = _device_tree(params)
     b, p = prompt.shape
@@ -899,11 +918,26 @@ def beam_search(params, prompt, cfg: TransformerConfig,
                          "(no attention_window)")
     total = _check_decode_budget(p, max_new_tokens, cfg, eos_token)
     prompt = jnp.asarray(prompt, jnp.int32)
-    use_prefill = _resolve_prefill(params, cfg, p, use_prefill,
-                                   ragged=False)
+    off = 0
+    if prompt_cache is not None:
+        # Shared-prefix reuse, same contract as generate()'s: the
+        # suffix runs as ONE chunked pass against the prefix cache, the
+        # search continues at absolute positions, and the returned
+        # sequences cover [prompt, generation] only.
+        cache, off = _resolve_prompt_cache(
+            prompt_cache, cfg, b, p, max_new_tokens, kv_int8,
+            use_prefill)
+        _, cache = _decode_chunk(params, cache, prompt,
+                                 jnp.full((b,), off, jnp.int32), cfg,
+                                 uniform_pos=True)
+    else:
+        use_prefill = _resolve_prefill(params, cfg, p, use_prefill,
+                                       ragged=False)
 
     # ---- prompt pass on the un-tiled [B] batch -----------------------
-    if use_prefill:
+    if prompt_cache is not None:
+        pass  # suffix chunk above already filled [off, off + p)
+    elif use_prefill:
         cache, _ = prefill(params, prompt, cfg, last_logits=False,
                            kv_int8=kv_int8)
     elif p > 1:
@@ -920,10 +954,10 @@ def beam_search(params, prompt, cfg: TransformerConfig,
                                 jnp.arange(p - 1))
     else:
         cache = init_cache(cfg, b, kv_int8=kv_int8)
-    # Logits for the first generated position (recomputes p-1 in place
-    # on the prefill path, same as generate()).
-    logits, cache = _decode_step(params, cache, prompt[:, p - 1], p - 1,
-                                 cfg)
+    # Logits for the first generated position (recomputes the last
+    # prompt position in place, same as generate()'s prefill path).
+    logits, cache = _decode_step(params, cache, prompt[:, p - 1],
+                                 off + p - 1, cfg)
     logp0 = jax.nn.log_softmax(logits, axis=-1)  # [B, V]
 
     # ---- first expansion: top-W distinct first tokens ----------------
@@ -934,9 +968,12 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     lengths = jnp.ones((b, w), jnp.int32)  # generated tokens per beam
 
     # Tile prompt/cache per beam: row b's beams are b*W .. b*W+W-1.
+    # Positions are absolute (prefix offset ``off``); the prefix region
+    # of buf stays zero and is never read — the scan starts past it.
+    total = off + total
     buf = jnp.zeros((b, w, total), jnp.int32)
-    buf = buf.at[:, :, :p].set(prompt[:, None, :])
-    buf = buf.at[:, :, p].set(first)
+    buf = buf.at[:, :, off:off + p].set(prompt[:, None, :])
+    buf = buf.at[:, :, off + p].set(first)
     cache = jax.tree.map(
         lambda a: jnp.repeat(a, w, axis=1), cache)  # [L, B*W, S, ...]
 
@@ -1003,10 +1040,10 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     if max_new_tokens > 1:
         (buf, _, _, scores, _, lengths), _ = jax.lax.scan(
             body, (buf, cache, anc0, scores, done, lengths),
-            jnp.arange(p, total - 1))
+            jnp.arange(off + p, total - 1))
     if length_penalty > 0:
         norm = scores / jnp.power((5.0 + lengths) / 6.0, length_penalty)
         order = jnp.argsort(-norm, axis=1)
         buf = jnp.take_along_axis(buf, order[:, :, None], axis=1)
         scores = jnp.take_along_axis(norm, order, axis=1)
-    return buf, scores
+    return (buf[:, :, off:] if off else buf), scores

@@ -996,3 +996,37 @@ def test_prompt_cache_single_token_tail_and_quantized(rng):
     with pytest.raises(ValueError, match="no effect with prompt_cache"):
         generate(params, tail, CFG, 4, prompt_cache=(cache, 4),
                  use_prefill=True)
+
+
+def test_beam_prompt_cache_matches_full_prompt(rng):
+    """Beam search over a reused prefix cache returns exactly the
+    hypotheses and scores of beaming the concatenated prompt — on both
+    the ancestry and physical paths, and under kv_int8."""
+    from distkeras_tpu.models.generate import beam_search, prefill
+
+    params = tfm.init_params(jax.random.key(0), ROPE_CFG)
+    prefix = jnp.asarray(rng.integers(0, 64, (2, 4)).astype(np.int32))
+    tail = jnp.asarray(rng.integers(0, 64, (2, 3)).astype(np.int32))
+    full = jnp.concatenate([prefix, tail], axis=1)
+    for kw in [dict(), dict(kv_int8=True),
+               dict(_force_physical=True), dict(eos_token=5)]:
+        cache, _ = prefill(params, prefix, ROPE_CFG, last_logits=False,
+                           kv_int8=kw.get("kv_int8", False))
+        ref_s, ref_sc = beam_search(params, full, ROPE_CFG, 6,
+                                    beam_width=3, **kw)
+        out_s, out_sc = beam_search(params, tail, ROPE_CFG, 6,
+                                    beam_width=3,
+                                    prompt_cache=(cache, 4), **kw)
+        np.testing.assert_array_equal(np.asarray(out_s),
+                                      np.asarray(ref_s[:, :, 4:]))
+        # Scores are sums of token log-probs; the two prompt passes
+        # (full prefill vs prefix-prefill + suffix chunk) reduce
+        # attention in different orders, so logits differ ~1e-4/pos in
+        # f32 — the HYPOTHESES must match exactly, the score sums to a
+        # few 1e-3.
+        np.testing.assert_allclose(np.asarray(out_sc),
+                                   np.asarray(ref_sc), atol=1e-2,
+                                   rtol=1e-4)
+    with pytest.raises(ValueError, match="no effect with prompt_cache"):
+        beam_search(params, tail, ROPE_CFG, 4, beam_width=2,
+                    prompt_cache=(cache, 4), use_prefill=True)
