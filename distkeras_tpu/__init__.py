@@ -76,6 +76,7 @@ from distkeras_tpu.data.transformers import (
     DenseTransformer,
 )
 from distkeras_tpu.checkpoint import CheckpointManager
+from distkeras_tpu.serving import ContinuousBatcher
 from distkeras_tpu.evaluators import (Evaluator, AccuracyEvaluator,
                                        PerplexityEvaluator)
 from distkeras_tpu.predictors import Predictor, ModelPredictor
@@ -134,5 +135,6 @@ __all__ = [
     "AveragingTrainer",
     "EnsembleTrainer",
     "LMTrainer",
+    "ContinuousBatcher",
     "LoRATrainer",
 ]

@@ -1,0 +1,266 @@
+"""Continuous batching: a lane-based serving engine over the decode step.
+
+Static-shape serving loop for interactive workloads: requests arrive at
+different times, but the chip wants one fixed-shape program.  The
+engine holds ``lanes`` decode rows in ONE KV cache and ONE jitted
+per-row-position decode step (``generate._decode_chunk``'s non-uniform
+path — the same machinery speculative decoding uses for accept
+divergence); a new request is admitted into any free lane mid-flight
+with a bucket-padded chunked prefill of just that lane, while the other
+lanes keep decoding.  No compiled shape ever depends on arrival times.
+
+Contract: every request's emitted tokens are EXACTLY what
+``generate(params, prompt, cfg, max_new_tokens, ...)`` would emit for
+it alone — the per-lane PRNG stream is position-keyed like generate's
+(``fold_in(request_key, pos)``), lane-local positions start at 0 per
+request, and stale cache slots from the lane's previous occupant are
+masked until overwritten (the ``_decode_chunk`` staleness argument).
+Pinned by tests/test_serving.py against solo ``generate`` runs,
+including staggered admission and lane reuse.
+
+The reference has no serving story at all (its ModelPredictor runs the
+training forward over a static batch — reference:
+distkeras/predictors.py); this module is TPU-first surplus on the
+serving axis, alongside speculative decoding and the prefix cache.
+
+Design notes:
+
+- ``step()`` decodes ALL lanes every call (free lanes burn a row of
+  compute — that is the price of one static program; at the measured
+  decode roofline a wasted row costs ~1/lanes of a step).
+- Admission prefills ``prompt[:-1]`` (bucket-padded) into the lane and
+  sets the lane position to ``len(prompt) - 1``; the next ``step()``
+  processes the final prompt token and samples the first new one —
+  exactly generate()'s sequential convention, so no special logits
+  plumbing exists for the first token.
+- Compiles one decode step + one admission program per prompt-length
+  bucket, each once, lazily.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.models.generate import (
+    _decode_chunk,
+    _device_tree,
+    init_cache,
+    min_p_mask,
+    top_k_mask,
+    top_p_mask,
+)
+from distkeras_tpu.models.transformer import TransformerConfig
+
+
+@dataclasses.dataclass
+class _Lane:
+    request_id: int
+    prompt_len: int
+    max_new: int
+    key: object          # per-request PRNG key (None for greedy)
+    tokens: list         # host-side transcript, prompt included
+    done: bool = False
+
+
+class ContinuousBatcher:
+    """Lane-based continuous batching over one jitted decode step.
+
+    Args mirror ``generate``'s sampling surface: ``temperature``,
+    ``top_k`` / ``top_p`` / ``min_p``, ``eos_token``, ``exact_top_k``
+    — fixed per engine (they are compiled into the step).  Per-request
+    PRNG keys arrive with ``submit``.
+
+    ``lanes``: decode rows held by the engine; ``prompt_buckets``:
+    admission pad widths (a prompt of length P uses the smallest
+    bucket >= P - 1; one admission program compiles per bucket).
+
+    Full-cache configs only (no attention_window, no quantized-tree
+    restriction — int8 weights decode on the same chunk path).
+    """
+
+    def __init__(self, params, cfg: TransformerConfig, lanes: int = 8,
+                 temperature: float = 0.0, top_k=None, top_p=None,
+                 min_p=None, eos_token=None, exact_top_k: bool = False,
+                 prompt_buckets=(8, 32, 128, 512)):
+        if cfg.attention_window is not None:
+            raise ValueError(
+                "continuous batching supports full-cache configs only "
+                "(no attention_window)")
+        if lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes}")
+        if temperature <= 0 and (top_k or top_p or min_p):
+            raise ValueError(
+                "top_k/top_p/min_p need temperature > 0 (greedy always "
+                "takes the argmax)")
+        if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
+            raise ValueError(
+                f"eos_token {eos_token} outside vocab [0, "
+                f"{cfg.vocab_size})")
+        self.params = _device_tree(params)
+        self.cfg = cfg
+        self.lanes = lanes
+        self.eos_token = eos_token
+        self.temperature = temperature
+        # Buckets clamp to the cache and always include max_len, so any
+        # prompt that fits the budget has an admission program.
+        self._buckets = tuple(sorted(
+            {min(int(w), cfg.max_len) for w in prompt_buckets}
+            | {cfg.max_len}))
+        self._lane_state: list[_Lane | None] = [None] * lanes
+        self._next_id = 0
+
+        # Device state: one cache, per-lane next-position, per-lane
+        # current token (the one the next step processes), per-lane key.
+        self.cache = init_cache(cfg, lanes)
+        self.pos = jnp.zeros((lanes,), jnp.int32)
+        self.cur = jnp.zeros((lanes,), jnp.int32)
+        self.keys = jnp.stack(
+            [jax.random.key(0)] * lanes) if temperature > 0 else None
+
+        def step_fn(cache, cur, pos, keys):
+            logits, cache = _decode_chunk(
+                self.params, cache, cur[:, None], pos, cfg)
+            logits = logits[:, 0]                      # [lanes, V]
+            if temperature > 0:
+                scaled = logits / temperature
+                if top_k is not None:
+                    scaled = top_k_mask(scaled, top_k, exact=exact_top_k)
+                if top_p is not None:
+                    scaled = top_p_mask(scaled, top_p)
+                if min_p is not None:
+                    scaled = min_p_mask(scaled, min_p)
+
+                def pick(k, row, q):
+                    return jax.random.categorical(
+                        jax.random.fold_in(k, q), row)
+
+                nxt = jax.vmap(pick)(keys, scaled, pos)
+            else:
+                nxt = logits.argmax(axis=-1)
+            return cache, nxt.astype(jnp.int32), pos + 1
+
+        self._step = jax.jit(step_fn, donate_argnums=0)
+
+        # Admission: prefill `width` positions of ONE lane from scratch
+        # (lane-sliced cache write; padded tail slots stay masked until
+        # the decode loop overwrites them).  One program per bucket.
+        def make_admit(width):
+            def admit(cache, rows, lane):
+                lane_cache = jax.tree.map(
+                    lambda a: jax.lax.dynamic_slice_in_dim(a, lane, 1,
+                                                           axis=1),
+                    cache)
+                # A fresh occupant must not see the previous request's
+                # K/V beyond its own positions; zeroing the lane is one
+                # tiny write and makes staleness reasoning trivial.
+                lane_cache = jax.tree.map(jnp.zeros_like, lane_cache)
+                _, lane_cache = _decode_chunk(
+                    self.params, lane_cache, rows,
+                    jnp.zeros((1,), jnp.int32), self.cfg,
+                    uniform_pos=True)
+                return jax.tree.map(
+                    lambda a, u: jax.lax.dynamic_update_slice_in_dim(
+                        a, u, lane, axis=1), cache, lane_cache)
+            return jax.jit(admit, donate_argnums=0)
+
+        self._admit = {w: make_admit(w) for w in self._buckets}
+
+    # ------------------------------------------------------------ API
+
+    def free_lanes(self):
+        return [i for i, s in enumerate(self._lane_state) if s is None]
+
+    def submit(self, prompt, max_new_tokens: int, key=None):
+        """Admit one request; returns its lane id, or None if the
+        engine is full.  ``prompt``: 1-D int tokens; ``key``: per-
+        request PRNG key (required iff the engine samples)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = prompt.size
+        if p < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if p + max_new_tokens > self.cfg.max_len:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_len={self.cfg.max_len}")
+        if (key is None) == (self.temperature > 0):
+            raise ValueError(
+                "pass a per-request key iff the engine samples "
+                f"(temperature={self.temperature})")
+        free = self.free_lanes()
+        if not free:
+            return None
+        lane = free[0]
+
+        warm = p - 1
+        if warm:
+            width = next((w for w in self._buckets if w >= warm), None)
+            if width is None:
+                raise ValueError(
+                    f"prompt length {p} exceeds the largest admission "
+                    f"bucket ({self._buckets[-1]} + 1); raise "
+                    "prompt_buckets")
+            rows = np.zeros((1, width), np.int32)
+            rows[0, :warm] = prompt[:-1]
+            self.cache = self._admit[width](
+                self.cache, jnp.asarray(rows), jnp.int32(lane))
+        else:
+            # 1-token prompt: nothing to warm; the zero-fill happens on
+            # the first step's write (stale slots stay masked).
+            pass
+        self.pos = self.pos.at[lane].set(warm)
+        self.cur = self.cur.at[lane].set(int(prompt[-1]))
+        if self.keys is not None:
+            self.keys = self.keys.at[lane].set(key)
+
+        self._lane_state[lane] = _Lane(
+            request_id=self._next_id, prompt_len=p,
+            max_new=max_new_tokens, key=key, tokens=list(prompt))
+        self._next_id += 1
+        return lane
+
+    def step(self):
+        """Advance every lane one token; returns ``{lane: token}`` for
+        lanes that emitted this step and retires finished requests into
+        ``.finished`` (see ``drain``)."""
+        if all(s is None for s in self._lane_state):
+            return {}
+        self.cache, nxt, self.pos = self._step(
+            self.cache, self.cur, self.pos,
+            self.keys if self.keys is not None else jnp.zeros(
+                (self.lanes,), jnp.int32))
+        toks = np.asarray(nxt)
+        self.cur = nxt
+        out = {}
+        for lane, st in enumerate(self._lane_state):
+            if st is None or st.done:
+                continue
+            tok = int(toks[lane])
+            st.tokens.append(tok)
+            out[lane] = tok
+            emitted = len(st.tokens) - st.prompt_len
+            if emitted >= st.max_new or (
+                    self.eos_token is not None and tok == self.eos_token):
+                st.done = True
+        return out
+
+    def drain(self, lane):
+        """Return the finished lane's [prompt + generation] tokens and
+        free the lane; raises if the lane is still running."""
+        st = self._lane_state[lane]
+        if st is None:
+            raise ValueError(f"lane {lane} is empty")
+        if not st.done:
+            raise ValueError(f"lane {lane} is still decoding")
+        self._lane_state[lane] = None
+        return np.asarray(st.tokens, np.int32)
+
+    def running(self):
+        return [i for i, s in enumerate(self._lane_state)
+                if s is not None and not s.done]
