@@ -99,3 +99,16 @@ def test_capacity_and_validation(params, rng):
         eng.submit(p, 4, key=jax.random.key(0))
     with pytest.raises(ValueError, match="temperature > 0"):
         ContinuousBatcher(params, CFG, top_k=5)
+
+
+def test_quantized_weights_match_quantized_generate(params, rng):
+    """int8 weight trees serve through the engine (the chunk path
+    dequantizes per read) and match their solo quantized run."""
+    from distkeras_tpu.models.quant import quantize_params
+
+    qp = quantize_params(params)
+    eng = ContinuousBatcher(qp, CFG, lanes=2)
+    prompt = rng.integers(0, 64, (5,)).astype(np.int32)
+    lane = eng.submit(prompt, 6)
+    out = run_to_done(eng, lane)
+    np.testing.assert_array_equal(out, solo(qp, prompt, 6))
