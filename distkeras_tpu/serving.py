@@ -48,6 +48,7 @@ import numpy as np
 from distkeras_tpu.models.generate import (
     _decode_chunk,
     _device_tree,
+    _resolve_prompt_cache,
     init_cache,
     min_p_mask,
     top_k_mask,
@@ -85,13 +86,17 @@ class ContinuousBatcher:
     def __init__(self, params, cfg: TransformerConfig, lanes: int = 8,
                  temperature: float = 0.0, top_k=None, top_p=None,
                  min_p=None, eos_token=None, exact_top_k: bool = False,
-                 prompt_buckets=(8, 32, 128, 512)):
+                 prompt_buckets=(8, 32, 128, 512), prompt_cache=None):
         if cfg.attention_window is not None:
             raise ValueError(
                 "continuous batching supports full-cache configs only "
                 "(no attention_window)")
         if lanes < 1:
             raise ValueError(f"lanes must be >= 1, got {lanes}")
+        if prompt_cache is not None and prompt_cache[1] >= cfg.max_len:
+            raise ValueError(
+                f"shared prefix length {prompt_cache[1]} must leave "
+                f"room under max_len={cfg.max_len}")
         if temperature <= 0 and (top_k or top_p or min_p):
             raise ValueError(
                 "top_k/top_p/min_p need temperature > 0 (greedy always "
@@ -103,13 +108,30 @@ class ContinuousBatcher:
         self.params = _device_tree(params)
         self.cfg = cfg
         self.lanes = lanes
+        # Shared prefix (system prompt): every lane's request decodes
+        # past a common prefilled prefix — same contract as
+        # generate(prompt_cache=...); admission seeds the lane from the
+        # prefix instead of zeros and all positions shift by its length.
+        self._off = 0
+        self._prefix_lane = None
+        if prompt_cache is not None:
+            # The ONE prompt_cache contract (generate's helper): batch
+            # must be 1 here (b=1), the prefix must be full-precision
+            # (the engine cache is too — kv_int8=False), and the
+            # loosest budget (p=1, one new token) must fit; per-request
+            # budgets are re-checked at submit.
+            pc, self._off = _resolve_prompt_cache(
+                prompt_cache, cfg, b=1, p=1, max_new_tokens=1,
+                kv_int8=False, use_prefill=None)
+            self._prefix_lane = jax.tree.map(jnp.asarray, pc)
         self.eos_token = eos_token
         self.temperature = temperature
-        # Buckets clamp to the cache and always include max_len, so any
-        # prompt that fits the budget has an admission program.
+        # Buckets clamp to the cache slots past the shared prefix and
+        # always include the largest legal width, so any prompt that
+        # fits the budget has an admission program.
+        cap = cfg.max_len - self._off
         self._buckets = tuple(sorted(
-            {min(int(w), cfg.max_len) for w in prompt_buckets}
-            | {cfg.max_len}))
+            {min(int(w), cap) for w in prompt_buckets} | {cap}))
         self._lane_state: list[_Lane | None] = [None] * lanes
         self._next_id = 0
 
@@ -155,12 +177,21 @@ class ContinuousBatcher:
                                                            axis=1),
                     cache)
                 # A fresh occupant must not see the previous request's
-                # K/V beyond its own positions; zeroing the lane is one
-                # tiny write and makes staleness reasoning trivial.
-                lane_cache = jax.tree.map(jnp.zeros_like, lane_cache)
+                # K/V beyond its own positions; reseeding the lane
+                # (shared prefix, or zeros) makes staleness reasoning
+                # trivial.
+                if self._prefix_lane is not None:
+                    # prefill() returns a full-max_len cache with the
+                    # prefix slots filled and the rest zero — exactly
+                    # the fresh-lane seed we need.
+                    lane_cache = jax.tree.map(
+                        lambda z, pre: pre.astype(z.dtype),
+                        lane_cache, self._prefix_lane)
+                else:
+                    lane_cache = jax.tree.map(jnp.zeros_like, lane_cache)
                 _, lane_cache = _decode_chunk(
                     self.params, lane_cache, rows,
-                    jnp.zeros((1,), jnp.int32), self.cfg,
+                    jnp.full((1,), self._off, jnp.int32), self.cfg,
                     uniform_pos=True)
                 return jax.tree.map(
                     lambda a, u: jax.lax.dynamic_update_slice_in_dim(
@@ -168,6 +199,16 @@ class ContinuousBatcher:
             return jax.jit(admit, donate_argnums=0)
 
         self._admit = {w: make_admit(w) for w in self._buckets}
+
+        def reseed(cache, lane):
+            """Copy the shared prefix into one lane (1-token prompts
+            skip the admission chunk but still need the prefix K/V)."""
+            return jax.tree.map(
+                lambda a, pre: jax.lax.dynamic_update_slice_in_dim(
+                    a, pre.astype(a.dtype), lane, axis=1),
+                cache, self._prefix_lane)
+
+        self._reseed = jax.jit(reseed, donate_argnums=0)
 
     # ------------------------------------------------------------ API
 
@@ -185,10 +226,11 @@ class ContinuousBatcher:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        if p + max_new_tokens > self.cfg.max_len:
+        if self._off + p + max_new_tokens > self.cfg.max_len:
             raise ValueError(
-                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds max_len={self.cfg.max_len}")
+                f"prefix ({self._off}) + prompt ({p}) + "
+                f"max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_len={self.cfg.max_len}")
         if (key is None) == (self.temperature > 0):
             raise ValueError(
                 "pass a per-request key iff the engine samples "
@@ -210,11 +252,15 @@ class ContinuousBatcher:
             rows[0, :warm] = prompt[:-1]
             self.cache = self._admit[width](
                 self.cache, jnp.asarray(rows), jnp.int32(lane))
-        else:
-            # 1-token prompt: nothing to warm; the zero-fill happens on
-            # the first step's write (stale slots stay masked).
-            pass
-        self.pos = self.pos.at[lane].set(warm)
+        elif self._prefix_lane is not None:
+            # 1-token prompt: no admission chunk runs, but the lane
+            # still needs the shared prefix's K/V (code-review
+            # regression: skipping this read zeros where the prefix
+            # belongs).
+            self.cache = self._reseed(self.cache, jnp.int32(lane))
+        # else: 1-token prompt, no prefix — stale slots stay masked
+        # until the decode loop overwrites them.
+        self.pos = self.pos.at[lane].set(self._off + warm)
         self.cur = self.cur.at[lane].set(int(prompt[-1]))
         if self.keys is not None:
             self.keys = self.keys.at[lane].set(key)

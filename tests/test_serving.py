@@ -112,3 +112,29 @@ def test_quantized_weights_match_quantized_generate(params, rng):
     lane = eng.submit(prompt, 6)
     out = run_to_done(eng, lane)
     np.testing.assert_array_equal(out, solo(qp, prompt, 6))
+
+
+def test_engine_shared_prefix_matches_generate_prompt_cache(params, rng):
+    """An engine built over a shared prefilled prefix emits exactly
+    what generate(prompt_cache=...) emits per request — including for a
+    lane's SECOND occupant (the admission reseed from the prefix)."""
+    from distkeras_tpu.models.generate import prefill
+
+    prefix = rng.integers(0, 64, (6,)).astype(np.int32)
+    cache, _ = prefill(params, prefix[None], CFG, last_logits=False)
+    eng = ContinuousBatcher(params, CFG, lanes=1,
+                            prompt_cache=(cache, 6))
+    for tail_len in (3, 1):    # second pass reuses lane 0; tail_len 1
+        #                          pins the no-admission reseed path
+        tail = rng.integers(0, 64, (tail_len,)).astype(np.int32)
+        lane = eng.submit(tail, 5)
+        out = run_to_done(eng, lane)
+        ref = np.asarray(generate(params, tail[None], CFG, 5,
+                                  prompt_cache=(cache, 6)))[0]
+        np.testing.assert_array_equal(out, ref)
+    with pytest.raises(ValueError, match="exceeds"):
+        eng.submit(rng.integers(0, 64, (20,)).astype(np.int32), 10)
+    with pytest.raises(ValueError, match="batch 1"):
+        big = {k: np.repeat(np.asarray(v), 2, axis=1)
+               for k, v in cache.items()}
+        ContinuousBatcher(params, CFG, prompt_cache=(big, 6))
