@@ -33,8 +33,11 @@ Design notes:
   processes the final prompt token and samples the first new one —
   exactly generate()'s sequential convention, so no special logits
   plumbing exists for the first token.
-- Compiles one decode step + one admission program per prompt-length
-  bucket, each once, lazily.
+- Compiles one admission program per prompt-length bucket and one
+  n-step decode scan per DISTINCT ``step(n)`` window, each lazily and
+  cached for the engine's lifetime — drive the loop with a small fixed
+  set of window sizes (e.g. always ``step(8)``), not a per-call-varying
+  ``n``, or each new value pays a fresh compile.
 """
 
 from __future__ import annotations
@@ -143,7 +146,7 @@ class ContinuousBatcher:
         self.keys = jnp.stack(
             [jax.random.key(0)] * lanes) if temperature > 0 else None
 
-        def step_fn(cache, cur, pos, keys):
+        def one_step(cache, cur, pos, keys):
             logits, cache = _decode_chunk(
                 self.params, cache, cur[:, None], pos, cfg)
             logits = logits[:, 0]                      # [lanes, V]
@@ -165,7 +168,18 @@ class ContinuousBatcher:
                 nxt = logits.argmax(axis=-1)
             return cache, nxt.astype(jnp.int32), pos + 1
 
-        self._step = jax.jit(step_fn, donate_argnums=0)
+        def make_step(n):
+            def step_n(cache, cur, pos, keys):
+                def body(carry, _):
+                    cache, cur, pos = carry
+                    cache, cur, pos = one_step(cache, cur, pos, keys)
+                    return (cache, cur, pos), cur
+                (cache, cur, pos), toks = jax.lax.scan(
+                    body, (cache, cur, pos), None, length=n)
+                return cache, cur, pos, toks.T        # [lanes, n]
+            return jax.jit(step_n, donate_argnums=0)
+
+        self._make_step, self._steps = make_step, {}
 
         # Admission: prefill `width` positions of ONE lane from scratch
         # (lane-sliced cache write; padded tail slots stay masked until
@@ -271,29 +285,43 @@ class ContinuousBatcher:
         self._next_id += 1
         return lane
 
-    def step(self):
-        """Advance every lane one token; returns ``{lane: token}`` for
-        lanes that emitted this step and retires finished requests into
-        ``.finished`` (see ``drain``)."""
+    def step(self, n: int = 1):
+        """Advance every lane ``n`` tokens in ONE device round-trip;
+        returns ``{lane: [tokens...]}`` for lanes that emitted.
+
+        ``n > 1`` amortizes the per-dispatch host/relay latency (the
+        measured floor is ~1.6 ms — comparable to a whole decode step
+        at batch 8) at the cost of admission granularity: new requests
+        wait for the window to finish, and a lane that hits its
+        eos/budget mid-window keeps decoding privately — the surplus
+        tokens are discarded here, identical to truncating generate()'s
+        sticky-fill output.  Emitted tokens are EXACTLY step(1)'s.
+        """
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
         if all(s is None for s in self._lane_state):
             return {}
-        self.cache, nxt, self.pos = self._step(
+        if n not in self._steps:
+            self._steps[n] = self._make_step(n)
+        self.cache, self.cur, self.pos, toks = self._steps[n](
             self.cache, self.cur, self.pos,
             self.keys if self.keys is not None else jnp.zeros(
                 (self.lanes,), jnp.int32))
-        toks = np.asarray(nxt)
-        self.cur = nxt
+        toks = np.asarray(toks)
         out = {}
         for lane, st in enumerate(self._lane_state):
             if st is None or st.done:
                 continue
-            tok = int(toks[lane])
-            st.tokens.append(tok)
-            out[lane] = tok
-            emitted = len(st.tokens) - st.prompt_len
-            if emitted >= st.max_new or (
-                    self.eos_token is not None and tok == self.eos_token):
-                st.done = True
+            emitted = []
+            for tok in toks[lane].tolist():
+                st.tokens.append(int(tok))
+                emitted.append(int(tok))
+                budget = len(st.tokens) - st.prompt_len >= st.max_new
+                if budget or (self.eos_token is not None
+                              and tok == self.eos_token):
+                    st.done = True
+                    break
+            out[lane] = emitted
         return out
 
     def drain(self, lane):

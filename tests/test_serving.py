@@ -138,3 +138,23 @@ def test_engine_shared_prefix_matches_generate_prompt_cache(params, rng):
         big = {k: np.repeat(np.asarray(v), 2, axis=1)
                for k, v in cache.items()}
         ContinuousBatcher(params, CFG, prompt_cache=(big, 6))
+
+
+def test_multi_token_step_matches_single_steps(params, rng):
+    """step(n) emits exactly the tokens of n step(1) calls — greedy and
+    sampled — including mid-window retirement truncation."""
+    for kw in [{}, dict(temperature=0.8, top_k=8)]:
+        key = jax.random.key(5) if kw else None
+        prompts = [rng.integers(0, 64, (4,)).astype(np.int32)
+                   for _ in range(2)]
+        outs = {}
+        for n in (1, 4):
+            eng = ContinuousBatcher(params, CFG, lanes=2,
+                                    eos_token=3, **kw)
+            lanes = [eng.submit(p, 9, key=key) if kw else
+                     eng.submit(p, 9) for p in prompts]
+            while eng.running():
+                eng.step(n)
+            outs[n] = [eng.drain(l) for l in lanes]
+        for a, b in zip(outs[1], outs[4]):
+            np.testing.assert_array_equal(a, b)
