@@ -18,6 +18,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from _common import setup_devices  # noqa: E402
+
+setup_devices()  # DKT_EXAMPLE_DEVICES=N forces the CPU mesh
+
 import distkeras_tpu as dk  # noqa: E402  (forces KERAS_BACKEND=jax)
 
 
