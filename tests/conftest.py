@@ -81,6 +81,12 @@ MULTIPROCESS = {
 }
 
 SLOW = MULTIPROCESS | {
+    "test_serving::test_engine_fuzz_schedule_matches_solo",
+    "test_serving::test_staggered_admission_and_lane_reuse",
+    "test_generate::test_beam_prompt_cache_matches_full_prompt",
+    "test_generate::test_beam_ancestry_equals_physical_reorder",
+    "test_generate::test_prompt_cache_matches_full_prompt",
+    "test_lm_trainer::test_ema_resume_matches_straight_run",
     "test_lora::test_lora_checkpoint_resume_matches_straight",
     "test_lora::test_lora_merged_serves_speculatively",
     "test_lora::test_lora_grad_accum_matches_large_batch",

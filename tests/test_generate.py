@@ -1030,3 +1030,23 @@ def test_beam_prompt_cache_matches_full_prompt(rng):
     with pytest.raises(ValueError, match="no effect with prompt_cache"):
         beam_search(params, tail, ROPE_CFG, 4, beam_width=2,
                     prompt_cache=(cache, 4), use_prefill=True)
+
+
+def test_kv_int8_gqa_decode_close_to_fp(rng):
+    """int8 KV scales are per-kv-head: the GQA cache (fewer kv heads
+    than query heads) quantizes and dequantizes consistently."""
+    import dataclasses
+
+    from distkeras_tpu.models.generate import _decode_step
+
+    cfg = dataclasses.replace(ROPE_CFG, n_heads=4, n_kv_heads=2)
+    params = tfm.init_params(jax.random.key(2), cfg)
+    toks = jnp.asarray(rng.integers(0, 64, (2, 10)).astype(np.int32))
+    full_logits, _ = tfm.apply(params, toks, cfg)
+    cache = init_cache(cfg, 2, kv_int8=True)
+    for pos in range(10):
+        logits, cache = _decode_step(params, cache, toks[:, pos], pos,
+                                     cfg)
+        base = np.abs(np.asarray(full_logits[:, pos])).max()
+        np.testing.assert_allclose(logits, full_logits[:, pos],
+                                   atol=0.05 * base, rtol=0.1)
