@@ -188,3 +188,29 @@ def test_continuous_batcher_under_tp(devices, rng):
         eng.step(2)
     for lane, ref in zip(lanes, refs):
         np.testing.assert_array_equal(eng.drain(lane), ref)
+
+
+def test_beam_prompt_cache_under_tp(devices, rng):
+    """Beam search over a reused prefix with TP-sharded params matches
+    the single-device concatenated-prompt beam run."""
+    from distkeras_tpu.models.generate import prefill
+
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prefix = _prompt(rng, b=4, p=4)
+    tail = _prompt(rng, b=4, p=3)
+    full = jnp.concatenate([prefix, tail], axis=1)
+    ref_s, _ = beam_search(params, full, CFG, 6, beam_width=2)
+    ref_s = np.asarray(ref_s)[:, :, 4:]
+
+    mesh, psh = _tp_layout(devices, params)
+    params_sh = jax.device_put(params, psh)
+    dsh = NamedSharding(mesh, P("data", None))
+    cache = jax.jit(
+        lambda pr, t: prefill(pr, t, CFG, last_logits=False)[0],
+        in_shardings=(psh, dsh))(params_sh, jax.device_put(prefix, dsh))
+    out, _ = jax.jit(
+        lambda pr, t, c: beam_search(pr, t, CFG, 6, beam_width=2,
+                                     prompt_cache=(c, 4)),
+        in_shardings=(psh, dsh, None))(
+        params_sh, jax.device_put(tail, dsh), cache)
+    np.testing.assert_array_equal(np.asarray(out), ref_s)
