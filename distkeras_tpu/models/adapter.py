@@ -277,6 +277,28 @@ class ModelAdapter:
 
         return window
 
+    def make_indexed_accum_train_step(self, window: int) -> Callable:
+        """``make_accum_train_step`` over a device-resident dataset:
+        ``step(state, X, Y, idx)`` with ``idx: [window, GB]`` gathers
+        each microbatch from the staged ``X``/``Y`` on device, then
+        accumulates exactly like the streaming accum step.  The
+        distributed trainers' device_data path (per round, only the
+        index block crosses the link; the mesh gathers its own rows)."""
+        accum = self.make_accum_train_step(window)
+
+        def step(state: TrainState, X, Y, idx):
+            if idx.shape[0] != window:
+                raise ValueError(
+                    f"index block carries {idx.shape[0]} microbatches "
+                    f"but this program accumulates window={window}")
+            xs = jnp.take(X, idx.reshape(-1), axis=0).reshape(
+                (*idx.shape, *X.shape[1:]))
+            ys = jnp.take(Y, idx.reshape(-1), axis=0).reshape(
+                (*idx.shape, *Y.shape[1:]))
+            return accum(state, xs, ys)
+
+        return step
+
     def make_eval_fn(self) -> Callable:
         """Pure ``f(tv, ntv, x, y) -> {"loss": ..., metric...}``.
 

@@ -37,6 +37,10 @@ from distkeras_tpu.trainers.base import Trainer
 class DistributedTrainer(Trainer):
     """Base for mesh trainers: builds the mesh and sharding plumbing.
 
+    Subclasses that implement the device-resident data plane set
+    ``_supports_device_data = True``; everyone else rejects the knob at
+    construction.
+
     ``num_workers`` (reference kwarg) = number of data-parallel replicas
     = size of the mesh's ``data`` axis.  Defaults to all visible
     devices.  A :class:`ShardingPlan` may add tensor parallelism on the
@@ -47,15 +51,25 @@ class DistributedTrainer(Trainer):
     parameter memory per device.
     """
 
+    _supports_device_data = False
+
     def __init__(self, keras_model, loss="categorical_crossentropy",
                  worker_optimizer="sgd", learning_rate: float | None = None,
                  batch_size: int = 32, num_epoch: int = 1,
                  num_workers: int | None = None, mesh=None,
-                 plan: ShardingPlan | None = None, fsdp: bool = False, **kw):
+                 plan: ShardingPlan | None = None, fsdp: bool = False,
+                 device_data: bool = False, **kw):
         super().__init__(keras_model, loss=loss,
                          worker_optimizer=worker_optimizer,
                          learning_rate=learning_rate, batch_size=batch_size,
                          num_epoch=num_epoch, **kw)
+        if device_data and not self._supports_device_data:
+            raise ValueError(
+                f"device_data=True is not supported by "
+                f"{type(self).__name__}: it is implemented for the "
+                "gradient trainers (ADAG/DynSGD); the replica-stacked "
+                "family streams its per-replica batches")
+        self.device_data = device_data
         if fsdp and plan is not None:
             raise ValueError("pass either plan= or fsdp=True, not both")
         self.plan = plan or (fsdp_plan() if fsdp else dp_plan())
@@ -91,16 +105,23 @@ class DistributedTrainer(Trainer):
 class ADAG(DistributedTrainer):
     """Asynchronous Distributed Adaptive Gradients, synchronously.
 
+    ``device_data=True`` stages the dataset in HBM (see
+    _fit_device_data).
+
     Reference parity: distkeras/trainers.py::ADAG (the reference's own
     flagship algorithm, SURVEY.md §3.2).  ``communication_window`` maps
     to gradient-accumulation depth per global step.
     """
+
+    _supports_device_data = True
 
     def __init__(self, keras_model, communication_window: int = 12, **kw):
         super().__init__(keras_model, **kw)
         self.communication_window = communication_window
 
     def _fit(self, dataset: Dataset):
+        if self.device_data:
+            return self._fit_device_data(dataset)
         w = self.communication_window
         state = self.adapter.init_state()
         state, state_sh = self._shard_state(state)
@@ -140,27 +161,86 @@ class ADAG(DistributedTrainer):
                     "every host's Dataset.shard must yield the same number "
                     f"of window batches ({feed_bs * w} rows each); pad or "
                     "trim the dataset to a multiple")
+        def stream():
+            for _ in range(self.num_epoch):
+                for xs, ys in dataset.batches(
+                        feed_bs, features_col=self.features_col,
+                        label_col=self.label_col, window=w):
+                    yield (self._global_batch(xs, batch_sh),
+                           self._global_batch(ys, batch_sh))
+
+        return self._run_rounds(state, step, stream(), feed_bs * w,
+                                dataset)
+
+    def _run_rounds(self, state, step, rounds, rows_per_round, dataset):
+        """ONE round-loop driver for the streaming and device-resident
+        paths: resume skipping, loss/checkpoint/eval bookkeeping, and
+        the end-of-run guards must not drift between them."""
         losses, rnd = [], 0
         state, start = self._restore_or(state)
-        for _ in range(self.num_epoch):
-            for xs, ys in dataset.batches(
-                    feed_bs, features_col=self.features_col,
-                    label_col=self.label_col, window=w):
-                rnd += 1
-                if rnd <= start:
-                    continue
-                xs = self._global_batch(xs, batch_sh)
-                ys = self._global_batch(ys, batch_sh)
-                state, loss = step(state, xs, ys)
-                losses.append(loss)
-                self._checkpoint(state, rnd)
-                self._eval_hook(state, rnd)
+        for args in rounds:
+            rnd += 1
+            if rnd <= start:
+                continue
+            state, loss = step(state, *args)
+            losses.append(loss)
+            self._checkpoint(state, rnd)
+            self._eval_hook(state, rnd)
         if start and not losses:
             return state
-        self._require_steps(losses, feed_bs * w, len(dataset))
+        self._require_steps(losses, rows_per_round, len(dataset))
         self._record(losses)
         self._checkpoint(state, rnd, final=True)
         return state
+
+
+    def _fit_device_data(self, dataset: Dataset):
+        """Device-resident data plane for the distributed flagship.
+
+        The dataset columns are staged in HBM ONCE, replicated on the
+        mesh; each round ships only a [window, global_batch] int32
+        index block, sharded over the ``data`` axis, and every replica
+        gathers its own rows on device — the distributed form of
+        SingleTrainer's ``device_data`` (docs/perf_input_pipeline.md:
+        the streaming path is capped by the host link, 320k vs ~10k
+        samples/s on this relay).  Training math is EXACTLY the
+        streaming path's (same accum step fed the same rows in the same
+        order — exactness-tested).  Single-process meshes: multi-host
+        staging would need per-host shard-local indexing; raise rather
+        than silently duplicate rows.
+        """
+        if jax.process_count() > 1:
+            raise ValueError(
+                "device_data=True supports single-process meshes (the "
+                "multi-host data plane streams per-host shards; see "
+                "docs/multihost.md)")
+        w = self.communication_window
+        state = self.adapter.init_state()
+        state, state_sh = self._shard_state(state)
+        repl = NamedSharding(self.mesh, P())
+        idx_sh = NamedSharding(self.mesh, P(None, "data"))
+
+        step = jax.jit(
+            self.adapter.make_indexed_accum_train_step(w),
+            in_shardings=(state_sh, repl, repl, idx_sh),
+            out_shardings=(state_sh, repl),
+            donate_argnums=0,
+        )
+        X = jax.device_put(dataset[self.features_col], repl)
+        Y = jax.device_put(dataset[self.label_col], repl)
+        global_bs = self.batch_size * self.num_workers
+        rows = global_bs * w
+        n = len(dataset)
+
+        def index_blocks():
+            for _ in range(self.num_epoch):
+                for i in range(0, n - (n % rows), rows):
+                    idx = np.arange(i, i + rows, dtype=np.int32).reshape(
+                        w, global_bs)
+                    yield (X, Y, jax.device_put(idx, idx_sh))
+
+        return self._run_rounds(state, step, index_blocks(), rows,
+                                dataset)
 
 
 class DynSGD(ADAG):

@@ -138,3 +138,42 @@ def test_device_feed_order_and_depth(blobs):
 def test_device_feed_rejects_bad_depth():
     with pytest.raises(ValueError, match="depth"):
         DeviceFeed([], depth=0)
+
+
+def test_adag_device_data_matches_streaming(devices, rng):
+    """ADAG(device_data=True): rows gathered on device from the staged
+    dataset produce EXACTLY the streaming path's weights and losses
+    (same rows, same order, same accum step)."""
+    import distkeras_tpu as dk
+
+    X = rng.normal(0, 1, (256, 12)).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 256)]
+    ds = dk.Dataset({"features": X, "label": Y})
+
+    def build():
+        import keras
+
+        m = keras.Sequential([keras.Input((12,)),
+                              keras.layers.Dense(16, activation="relu"),
+                              keras.layers.Dense(4)])
+        return m
+
+    kw = dict(loss="categorical_crossentropy", worker_optimizer="adam",
+              learning_rate=1e-2, batch_size=8, num_epoch=2,
+              communication_window=4, num_workers=8)
+    m_ref, m_dev = build(), build()
+    m_dev.set_weights(m_ref.get_weights())   # identical inits
+    ref = dk.ADAG(m_ref, **kw)
+    wref = ref.train(ds).get_weights()
+    dev = dk.ADAG(m_dev, device_data=True, **kw)
+    wdev = dev.train(ds).get_weights()
+    assert len(ref.history) == len(dev.history) > 0
+    np.testing.assert_allclose(ref.history, dev.history, rtol=1e-5,
+                               atol=1e-6)
+    for a, b in zip(wref, wdev):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="replica-stacked"):
+        dk.AEASGD(build(), device_data=True, num_workers=8,
+                  loss="categorical_crossentropy",
+                  worker_optimizer="sgd", learning_rate=1e-2)
