@@ -69,13 +69,24 @@ def main():
     err = _probe_with_retries()
     if err is not None:
         # Keep the documented one-line key set; null value signals "no
-        # measurement" to contract-parsing consumers.
-        print(json.dumps({"metric": "cifar_cnn_train_throughput",
-                          "value": None, "unit": "samples/sec/chip",
-                          "vs_baseline": None, "error": err}))
+        # measurement" to contract-parsing consumers.  ``last_green``
+        # carries the most recent PRIOR green measurement (clearly
+        # labeled; ``value`` stays null) so the artifact holds evidence
+        # through a tunnel outage instead of only "null" while the real
+        # numbers live in BASELINE.md prose.
+        line = {"metric": "cifar_cnn_train_throughput",
+                "value": None, "unit": "samples/sec/chip",
+                "vs_baseline": None, "error": err}
+        from bench_suite import read_last_green
+
+        prior = read_last_green("cifar_cnn_train_throughput")
+        if prior is not None:
+            line["last_green"] = {
+                "note": "prior green measurement, NOT this run", **prior}
+        print(json.dumps(line))
         sys.exit(1)
 
-    from bench_suite import bench_cifar_cnn, peak_flops
+    from bench_suite import bench_cifar_cnn, peak_flops, update_last_green
 
     sps, step_s, step_flops = bench_cifar_cnn()[:3]
     line = {
@@ -88,6 +99,10 @@ def main():
     if peak and step_flops:
         line["mfu"] = round(step_flops / step_s / peak, 4)
     print(json.dumps(line))
+    import jax
+
+    if jax.default_backend() == "tpu":
+        update_last_green(line, device=jax.devices()[0].device_kind)
 
 
 if __name__ == "__main__":

@@ -18,6 +18,8 @@ axis like every other batch op.
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -865,12 +867,28 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     return buf[:, cached_len:] if cached_len else buf
 
 
+# Ancestry attention materializes per-layer score tensors of
+# B x W^2 x n_heads x S f32 (x2: scores + the post-softmax select) —
+# quadratic in beam width.  Above this ceiling the physical
+# parent-gather, though slower per step, is the path that fits.
+ANCESTRY_SCORE_LIMIT_BYTES = 1 << 28  # 256 MiB per layer
+
+
+def _ancestry_score_bytes(b: int, w: int, cfg: TransformerConfig) -> int:
+    """Estimated per-layer peak of the ancestry attention intermediates:
+    the [B, W, kv_heads, groups, W, S] f32 score tensor (``b`` is the
+    UNtiled batch; both beam-width dims appear — quadratic in W) and
+    its post-softmax one-hot select (same shape) — see _decode_chunk."""
+    return 2 * b * w * w * cfg.n_heads * cfg.max_len * 4
+
+
 def beam_search(params, prompt, cfg: TransformerConfig,
                 max_new_tokens: int, beam_width: int = 4,
                 eos_token: int | None = None,
                 use_prefill: bool | None = None,
                 length_penalty: float = 0.0,
                 kv_int8: bool = False, prompt_cache=None,
+                beam_impl: str = "auto",
                 _force_physical: bool = False):
     """Beam search decode: ``prompt [B, P]`` -> ``(sequences, scores)``
     with ``sequences [B, W, P+N]`` and ``scores [B, W]`` (sum of token
@@ -899,6 +917,21 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     prefix exactly as in :func:`generate` — the suffix runs as one
     chunked pass, hypotheses match beaming the concatenated prompt,
     and the returned sequences cover [prompt, generation] only.
+
+    ``beam_impl`` selects how beams read their divergent histories:
+
+    - ``"auto"`` (default): ancestry attention for full-cache configs —
+      unless its per-layer score intermediate (quadratic in beam
+      width; see :data:`ANCESTRY_SCORE_LIMIT_BYTES`) would exceed the
+      limit, in which case it falls back to the physical parent-gather
+      with a warning.  Windowed configs always take the physical path
+      (ring-buffer slots are reused; ancestry cannot represent them).
+    - ``"ancestry"``: force ancestry attention; raises above the
+      intermediate-size limit or on windowed configs instead of
+      silently changing cost class.
+    - ``"physical"``: force the parent-gather cache reorder (the
+      pre-round-3 construction; exact same hypotheses, more HBM
+      traffic per step at moderate beam widths).
     """
     params = _device_tree(params)
     b, p = prompt.shape
@@ -916,6 +949,41 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     if kv_int8 and cfg.attention_window is not None:
         raise ValueError("kv_int8 beam search requires a full cache "
                          "(no attention_window)")
+    # ``_force_physical`` is the deprecated private spelling of
+    # beam_impl="physical" (kept for back-compat).  Resolved HERE, with
+    # the other argument checks: an invalid beam_impl or an over-limit
+    # ancestry config must raise before any prompt-pass device work
+    # (the checks need only b, w, cfg).
+    if beam_impl not in ("auto", "ancestry", "physical"):
+        raise ValueError(
+            f"beam_impl must be 'auto', 'ancestry', or 'physical', "
+            f"got {beam_impl!r}")
+    if _force_physical:
+        beam_impl = "physical"
+    use_anc = cfg.attention_window is None and beam_impl != "physical"
+    if use_anc:
+        est = _ancestry_score_bytes(b, w, cfg)
+        if est > ANCESTRY_SCORE_LIMIT_BYTES:
+            msg = (
+                f"ancestry attention's per-layer score intermediate "
+                f"would be ~{est / 2**20:.0f} MiB "
+                f"(batch {b} x width {w}^2 x {cfg.n_heads} heads x "
+                f"max_len {cfg.max_len}, f32 x2) — over the "
+                f"{ANCESTRY_SCORE_LIMIT_BYTES / 2**20:.0f} MiB limit")
+            if beam_impl == "ancestry":
+                raise ValueError(
+                    msg + "; use beam_impl='physical' (exact same "
+                    "hypotheses via cache reorder) or shrink "
+                    "batch/beam_width/max_len")
+            warnings.warn(msg + "; falling back to the physical "
+                          "parent-gather (same hypotheses, more HBM "
+                          "traffic per step)", stacklevel=2)
+            use_anc = False
+    elif beam_impl == "ancestry":
+        raise ValueError(
+            "beam_impl='ancestry' requires a full cache: the windowed "
+            "ring buffer reuses slots, which the ancestry map cannot "
+            "represent (use beam_impl='auto' or 'physical')")
     total = _check_decode_budget(p, max_new_tokens, cfg, eos_token)
     prompt = jnp.asarray(prompt, jnp.int32)
     off = 0
@@ -987,8 +1055,8 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     # attention itself (docs/perf_serving.md finding 4).  The windowed
     # ring-buffer path keeps the gather (its slot arithmetic reuses
     # slots, which ancestry cannot represent).
-    # ``_force_physical`` exists for the equivalence test only.
-    use_anc = cfg.attention_window is None and not _force_physical
+    # (use_anc resolved with the other argument checks at the top —
+    # beam_impl errors must fire before any prompt-pass device work.)
     anc0 = jnp.broadcast_to(
         jnp.arange(w, dtype=jnp.int32)[None, :, None],
         (b, w, cfg.max_len))  # prompt + first token: every lane is its

@@ -667,7 +667,8 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     return unflat(dq, lq), unflat(dk, lk), unflat(dv, lk)
 
 
-def _fit_block(requested: int, length: int) -> int | None:
+def _fit_block(requested: int, length: int,
+               strict: bool = False) -> int | None:
     """Kernel block size <= ``requested`` that tiles ``length`` exactly.
 
     The min-clamp alone covers short rows (one block == the row) and
@@ -676,10 +677,19 @@ def _fit_block(requested: int, length: int) -> int | None:
     tuned defaults never pushes a length that used to tile off the
     Pallas path (e.g. seq 1536 under the (1024, 1024) defaults fits
     768).  None = nothing tiles; the caller falls back to blockwise.
+
+    ``strict`` (explicitly requested blocks): never substitute a
+    different divisor — a sweep/benchmark caller asking for block 512
+    at length 768 must not silently time a 384-block kernel.  The
+    min-clamp still applies (one block == the whole row is the same
+    grid point); anything else returns None so the caller takes the
+    blockwise fallback, the pre-fitting behavior for such shapes.
     """
     b = min(requested, length)
     if length % b == 0:
         return b
+    if strict:
+        return None
     return max((c for c in range(128, b + 1, 128) if length % c == 0),
                default=None)
 
@@ -702,14 +712,17 @@ DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
-def _pallas_blocks(lq, lk, d, block_q, block_k, gate_small_bk=False):
+def _pallas_blocks(lq, lk, d, block_q, block_k, gate_small_bk=False,
+                   strict_q=False, strict_k=False):
     """Pure tiling/quality decision (backend-independent, unit-tested):
     the fitted (bq, bk) the kernel would launch with, or None for the
-    blockwise fallback."""
+    blockwise fallback.  ``strict_*`` marks explicitly requested blocks
+    (see _fit_block): honored exactly or not at all."""
     # Tiling constraints: last dim 128-aligned, seq divisible into blocks.
     if d % 128 != 0 or min(lq, lk) < 8:
         return None
-    bq, bk = _fit_block(block_q, lq), _fit_block(block_k, lk)
+    bq = _fit_block(block_q, lq, strict=strict_q)
+    bk = _fit_block(block_k, lk, strict=strict_k)
     if bq is None or bk is None:
         return None
     # Defaulted callers only (``gate_small_bk``): tiny fitted KV tiles
@@ -724,21 +737,26 @@ def _pallas_blocks(lq, lk, d, block_q, block_k, gate_small_bk=False):
     return bq, bk
 
 
-def _use_pallas(q, k, block_q, block_k, gate_small_bk=False) -> bool:
+def _use_pallas(q, k, block_q, block_k, gate_small_bk=False,
+                strict_q=False, strict_k=False) -> bool:
     if not _HAVE_PALLAS or jax.default_backend() != "tpu":
         return False
     return _pallas_blocks(q.shape[1], k.shape[1], q.shape[-1],
-                          block_q, block_k, gate_small_bk) is not None
+                          block_q, block_k, gate_small_bk,
+                          strict_q=strict_q, strict_k=strict_k) is not None
 
 
 def _resolve_blocks(block_q, block_k):
-    """None -> tuned default; the gate applies only to a defaulted
-    block_k.  The ONE definition shared by flash_attention and its
-    custom_vjp fwd/bwd so primal and vjp can never disagree."""
-    gate = block_k is None
-    bq = DEFAULT_BLOCK_Q if block_q is None else block_q
-    bk = DEFAULT_BLOCK_K if block_k is None else block_k
-    return bq, bk, gate
+    """None -> tuned default; the small-bk gate and divisor refitting
+    apply only to defaulted blocks — explicit blocks are honored
+    exactly or fall back (strict _fit_block).  The ONE definition
+    shared by flash_attention and its custom_vjp fwd/bwd so primal and
+    vjp can never disagree."""
+    q_explicit, k_explicit = block_q is not None, block_k is not None
+    gate = not k_explicit
+    bq = block_q if q_explicit else DEFAULT_BLOCK_Q
+    bk = block_k if k_explicit else DEFAULT_BLOCK_K
+    return bq, bk, gate, q_explicit, k_explicit
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -769,18 +787,23 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     config (seq 4096, d1024 L8, TPU v5e —
     `scripts/sweep_attention_blocks.py`, results in
     docs/perf_transformer.md): (1024, 1024) beat the untuned (256, 512)
-    by 35% on the full train step.  Blocks are fitted per call
-    (``_fit_block``): shorter sequences clamp to one block, and lengths
-    the default doesn't divide (e.g. 1536) drop to their largest
-    lane-aligned divisor instead of leaving the Pallas path — except
-    that a *defaulted* call never fits below a 256 KV tile (measured
-    slower than the fallback); pass block_k explicitly to force a
-    small-tile kernel.
+    by 35% on the full train step.  Defaulted blocks are fitted per
+    call (``_fit_block``): shorter sequences clamp to one block, and
+    lengths the default doesn't divide (e.g. 1536) drop to their
+    largest lane-aligned divisor instead of leaving the Pallas path —
+    except that a *defaulted* call never fits below a 256 KV tile
+    (measured slower than the fallback); pass block_k explicitly to
+    force a small-tile kernel.  EXPLICIT blocks are honored exactly:
+    a requested block that does not divide the length (beyond the
+    whole-row min-clamp) takes the blockwise fallback rather than
+    silently launching a different grid point — sweep callers measure
+    the block they asked for.
     """
     _check_window(window, causal)
     s = _scale_for(q, scale)
-    bq, bk, gate = _resolve_blocks(block_q, block_k)
-    if _use_pallas(q, k, bq, bk, gate_small_bk=gate):
+    bq, bk, gate, xq, xk = _resolve_blocks(block_q, block_k)
+    if _use_pallas(q, k, bq, bk, gate_small_bk=gate,
+                   strict_q=xq, strict_k=xk):
         return _flash_pallas(q, k, v, causal, s, bq, bk,
                              with_lse=False, window=window,
                              segment_ids=segment_ids)[0]
@@ -793,8 +816,9 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None,
                segment_ids=None):
     _check_window(window, causal)
     s = _scale_for(q, scale)
-    bq, bk, gate = _resolve_blocks(block_q, block_k)
-    if _use_pallas(q, k, bq, bk, gate_small_bk=gate):
+    bq, bk, gate, xq, xk = _resolve_blocks(block_q, block_k)
+    if _use_pallas(q, k, bq, bk, gate_small_bk=gate,
+                   strict_q=xq, strict_k=xk):
         out, lse = _flash_pallas(q, k, v, causal, s, bq, bk,
                                  window=window, segment_ids=segment_ids)
         return out, (q, k, v, out, lse, segment_ids)
@@ -807,7 +831,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, window=None,
 def _flash_bwd(causal, scale, block_q, block_k, window, res, g):
     q, k, v, out, lse, segment_ids = res
     s = _scale_for(q, scale)
-    bq, bk, _ = _resolve_blocks(block_q, block_k)
+    bq, bk, _, _, _ = _resolve_blocks(block_q, block_k)
     if lse is not None:
         dq, dk, dv = _flash_pallas_bwd(q, k, v, out, lse, g, causal, s,
                                        bq, bk, window=window,

@@ -166,7 +166,17 @@ class ContinuousBatcher:
                 nxt = jax.vmap(pick)(keys, scaled, pos)
             else:
                 nxt = logits.argmax(axis=-1)
-            return cache, nxt.astype(jnp.int32), pos + 1
+            # Device-side invariant: pos NEVER exceeds max_len - 1.
+            # Free/done lanes keep decoding (the price of one static
+            # program) and would otherwise advance unboundedly; the
+            # clamp pins them to re-processing the last slot — their
+            # outputs are discarded and admission reseeds the lane, so
+            # correctness no longer leans on dynamic_update_slice's
+            # start-clamping (advisor round-3: make the invariant
+            # explicit, not incidental).  Live lanes are unaffected:
+            # submit() budgets guarantee they finish at pos <= max_len-1.
+            return (cache, nxt.astype(jnp.int32),
+                    jnp.minimum(pos + 1, cfg.max_len - 1))
 
         def make_step(n):
             def step_n(cache, cur, pos, keys):
@@ -299,7 +309,10 @@ class ContinuousBatcher:
         """
         if n < 1:
             raise ValueError(f"n must be >= 1, got {n}")
-        if all(s is None for s in self._lane_state):
+        # Idle engine (every lane empty or finished-but-undrained):
+        # nothing can emit, so skip the device round-trip entirely
+        # instead of burning a full decode window.
+        if all(s is None or s.done for s in self._lane_state):
             return {}
         if n not in self._steps:
             self._steps[n] = self._make_step(n)

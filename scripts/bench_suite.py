@@ -51,6 +51,62 @@ def peak_flops():
     return PEAK_FLOPS.get(jax.devices()[0].device_kind)
 
 
+# Machine-readable record of the most recent GREEN measurement per
+# config, at the repo root next to BENCH_r0N.json.  bench.py embeds it
+# (clearly labeled as a prior measurement) in its error line when the
+# accelerator tunnel is down at the driver's capture time — two rounds
+# running, the headline artifact recorded null while same-day green
+# numbers existed only in BASELINE.md prose.
+LAST_GREEN_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "BENCH_LAST_GREEN.json")
+
+
+def update_last_green(line: dict, path: str = LAST_GREEN_PATH,
+                      device: str | None = None) -> None:
+    """Merge one green result line into BENCH_LAST_GREEN.json.
+
+    Layout: {"entries": {metric: {...line, measured_utc, device}},
+    "updated_utc": ...}.  Best-effort — a read-only checkout or a
+    corrupt file must never fail a measurement run.  NO jax calls in
+    here: this helper must stay callable (and instant) while the
+    accelerator tunnel is down; callers that just measured pass their
+    device kind."""
+    try:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if not isinstance(rec.get("entries"), dict):
+                rec = {"entries": {}}
+        except (OSError, ValueError):
+            rec = {"entries": {}}
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        entry = dict(line)
+        entry["measured_utc"] = stamp
+        if device is not None:
+            entry["device"] = device
+        rec["entries"][str(line.get("metric"))] = entry
+        rec["updated_utc"] = stamp
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def read_last_green(metric: str | None = None,
+                    path: str = LAST_GREEN_PATH):
+    """The recorded last-green entry for ``metric`` (or the whole
+    record), or None if absent/unreadable."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        return rec["entries"].get(metric) if metric else rec
+    except (OSError, ValueError, KeyError, AttributeError):
+        return None
+
+
 def compiled_flops(jitted, *args) -> float:
     """FLOPs of one call, from the compiled executable's cost model."""
     try:
@@ -566,6 +622,9 @@ def main(names):
         if peak and step_flops:
             line["mfu"] = round(step_flops / step_s / peak, 4)
         print(json.dumps(line))
+        if jax.default_backend() == "tpu":
+            update_last_green(line,
+                              device=jax.devices()[0].device_kind)
 
 
 if __name__ == "__main__":

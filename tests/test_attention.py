@@ -276,6 +276,14 @@ def test_fit_block_divisor_logic():
     assert _fit_block(8, 16) == 8             # explicit test blocks keep
     assert _fit_block(1024, 1288) is None     # nothing lane-aligned tiles
 
+    # strict (explicitly requested blocks): honored exactly or None —
+    # never a substituted divisor (advisor round-3: a sweep asking for
+    # block 512 at length 768 must not silently time a 384 block).
+    assert _fit_block(512, 768, strict=True) is None
+    assert _fit_block(1024, 1536, strict=True) is None
+    assert _fit_block(512, 1024, strict=True) == 512   # divides: kept
+    assert _fit_block(1024, 512, strict=True) == 512   # whole-row clamp
+
 
 def test_pallas_fitted_blocks_interpret(rng):
     """A length the tuned defaults don't divide (1536) still runs the
@@ -313,3 +321,10 @@ def test_explicit_small_block_k_honored_and_unfittable_raises(rng):
     assert _require_fit(8, 16) == 8
     with pytest.raises(ValueError, match="tiles sequence length"):
         _require_fit(1024, 1288)
+    # Explicit (strict) blocks that don't divide take the fallback
+    # instead of a refitted grid; defaults at the same shape refit.
+    assert _pallas_blocks(768, 768, 128, 512, 512,
+                          strict_q=True, strict_k=True) is None
+    assert _pallas_blocks(768, 768, 128, 512, 512) == (384, 384)
+    assert _pallas_blocks(1024, 768, 128, 512, 512,
+                          strict_q=True, strict_k=False) == (512, 384)

@@ -1,0 +1,87 @@
+"""bench.py's one-line JSON contract, including the last-green record
+that carries evidence through accelerator-tunnel outages (round-3
+verdict: the driver's BENCH artifact was null two rounds running while
+green same-day measurements existed only in prose)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for p in (ROOT, os.path.join(ROOT, "scripts")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+def test_last_green_roundtrip(tmp_path):
+    from bench_suite import read_last_green, update_last_green
+
+    p = str(tmp_path / "lg.json")
+    assert read_last_green(path=p) is None
+    update_last_green({"metric": "a", "value": 1.5, "unit": "u"},
+                      path=p, device="TPU v5e")
+    update_last_green({"metric": "b", "value": 2.0}, path=p)
+    update_last_green({"metric": "a", "value": 3.0}, path=p)  # overwrite
+    rec = read_last_green(path=p)
+    assert sorted(rec["entries"]) == ["a", "b"]
+    a = read_last_green("a", path=p)
+    assert a["value"] == 3.0 and "measured_utc" in a
+    assert read_last_green("missing", path=p) is None
+    # Corrupt file: helpers degrade to None / fresh record, never raise.
+    (tmp_path / "lg.json").write_text("{not json")
+    assert read_last_green(path=p) is None
+    update_last_green({"metric": "c", "value": 1.0}, path=p)
+    assert read_last_green("c", path=p)["value"] == 1.0
+
+
+def test_repo_seed_record_is_readable():
+    """The committed BENCH_LAST_GREEN.json (seeded from the round-3
+    measured green window) parses and names the headline metric."""
+    from bench_suite import read_last_green
+
+    entry = read_last_green("cifar_cnn_train_throughput")
+    assert entry is not None
+    assert entry["value"] and entry["unit"] == "samples/sec/chip"
+    assert "measured_utc" in entry
+
+
+def test_bench_error_line_embeds_last_green(monkeypatch, capsys):
+    """When the device probe fails, bench.py's error line keeps the
+    documented null-value contract AND carries the prior green
+    measurement, clearly labeled."""
+    import bench
+    import bench_suite
+
+    monkeypatch.setattr(bench, "_probe_with_retries",
+                        lambda *a, **k: "tunnel down (test)")
+    prior = {"metric": "cifar_cnn_train_throughput", "value": 42.0,
+             "measured_utc": "2026-01-01T00:00:00Z"}
+    monkeypatch.setattr(bench_suite, "read_last_green",
+                        lambda *a, **k: dict(prior))
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 1
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["value"] is None and line["vs_baseline"] is None
+    assert line["error"] == "tunnel down (test)"
+    assert line["last_green"]["value"] == 42.0
+    assert "NOT this run" in line["last_green"]["note"]
+
+
+def test_bench_error_line_without_record(monkeypatch, capsys):
+    """No last-green record: the error line is exactly the documented
+    key set (no fabricated evidence)."""
+    import bench
+    import bench_suite
+
+    monkeypatch.setattr(bench, "_probe_with_retries",
+                        lambda *a, **k: "tunnel down (test)")
+    monkeypatch.setattr(bench_suite, "read_last_green",
+                        lambda *a, **k: None)
+    with pytest.raises(SystemExit):
+        bench.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert "last_green" not in line
+    assert line["value"] is None

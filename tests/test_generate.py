@@ -802,12 +802,53 @@ def test_beam_ancestry_equals_physical_reorder(rng):
         seqs_a, sc_a = beam_search(params, prompt, gqa_cfg, 10,
                                    beam_width=3, **kw)
         seqs_p, sc_p = beam_search(params, prompt, gqa_cfg, 10,
-                                   beam_width=3, _force_physical=True,
+                                   beam_width=3, beam_impl="physical",
                                    **kw)
         np.testing.assert_array_equal(np.asarray(seqs_a),
                                       np.asarray(seqs_p))
         np.testing.assert_allclose(np.asarray(sc_a), np.asarray(sc_p),
                                    atol=1e-5, rtol=1e-5)
+
+
+def test_beam_impl_knob_and_ancestry_size_guard(rng, monkeypatch):
+    """The public beam_impl knob: 'physical' matches 'ancestry' (both
+    explicit), 'auto' falls back with a warning when the ancestry score
+    intermediate would exceed the limit, explicit 'ancestry' raises at
+    that size (and on windowed configs), and bad values are rejected."""
+    import dataclasses
+
+    from distkeras_tpu.models import generate as gen
+    from distkeras_tpu.models.generate import beam_search
+
+    params = tfm.init_params(jax.random.key(5), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 4)).astype(np.int32))
+    sa, sca = beam_search(params, prompt, CFG, 5, beam_width=3,
+                          beam_impl="ancestry")
+    sp, scp = beam_search(params, prompt, CFG, 5, beam_width=3,
+                          beam_impl="physical")
+    np.testing.assert_array_equal(np.asarray(sa), np.asarray(sp))
+    np.testing.assert_allclose(np.asarray(sca), np.asarray(scp),
+                               atol=1e-5, rtol=1e-5)
+
+    # Shrink the limit below this config's estimate to exercise the
+    # guard without allocating GBs.
+    est = gen._ancestry_score_bytes(2, 3, CFG)
+    monkeypatch.setattr(gen, "ANCESTRY_SCORE_LIMIT_BYTES", est // 2)
+    with pytest.warns(UserWarning, match="falling back to the physical"):
+        sf, scf = gen.beam_search(params, prompt, CFG, 5, beam_width=3)
+    np.testing.assert_array_equal(np.asarray(sf), np.asarray(sp))
+    with pytest.raises(ValueError, match="over the"):
+        gen.beam_search(params, prompt, CFG, 5, beam_width=3,
+                        beam_impl="ancestry")
+    monkeypatch.undo()
+
+    win_cfg = dataclasses.replace(CFG, attention_window=4)
+    with pytest.raises(ValueError, match="full cache"):
+        beam_search(params, prompt, win_cfg, 5, beam_width=3,
+                    beam_impl="ancestry")
+    with pytest.raises(ValueError, match="beam_impl must be"):
+        beam_search(params, prompt, CFG, 5, beam_width=3,
+                    beam_impl="fast")
 
 
 def test_top_k_mask_approx_path():
@@ -863,15 +904,29 @@ def test_kv_int8_decode_close_to_fp(rng):
                                    atol=0.05 * base, rtol=0.1)
 
 
-def test_kv_int8_generate_prefill_matches_sequential(rng):
-    """Prefill-quantized and step-quantized caches see the same K/V
-    values, so the two prompt paths agree under kv_int8 like they do in
-    the compute dtype."""
+def test_kv_int8_generate_prefill_close_to_sequential(rng):
+    """Prefill and sequential prompt paths under kv_int8 agree to
+    quantization noise — NOT bit-exactly: prefill computes the prompt's
+    attention in full precision and quantizes the K/V it writes, while
+    the sequential path attends the already-quantized cache, so from
+    layer 2 on the residual streams (and hence cached K/V) differ by
+    int8 rounding.  The contract is closeness on logits (advisor
+    round-3: token equality only held because greedy argmax absorbed
+    the drift on a tiny model — fragile across seeds/backends)."""
+    from distkeras_tpu.models.generate import (_decode_step, init_cache,
+                                               prefill)
+
     params = tfm.init_params(jax.random.key(1), CFG)
     prompt = jnp.asarray(rng.integers(0, 64, (2, 6)).astype(np.int32))
-    a = generate(params, prompt, CFG, 6, kv_int8=True, use_prefill=True)
-    b = generate(params, prompt, CFG, 6, kv_int8=True, use_prefill=False)
-    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _, last_p = prefill(params, prompt, CFG, last_logits=True,
+                        kv_int8=True)
+    cache_s = init_cache(CFG, 2, kv_int8=True)
+    for pos in range(6):
+        last_s, cache_s = _decode_step(params, cache_s, prompt[:, pos],
+                                       pos, CFG)
+    base = np.abs(np.asarray(last_p)).max()
+    np.testing.assert_allclose(np.asarray(last_s), np.asarray(last_p),
+                               atol=0.05 * base, rtol=0.1)
 
 
 def test_kv_int8_beam_and_validation(rng):
@@ -887,7 +942,7 @@ def test_kv_int8_beam_and_validation(rng):
     sa, sca = beam_search(params, prompt, CFG, 6, beam_width=3,
                           kv_int8=True)
     sp, scp = beam_search(params, prompt, CFG, 6, beam_width=3,
-                          kv_int8=True, _force_physical=True)
+                          kv_int8=True, beam_impl="physical")
     np.testing.assert_array_equal(np.asarray(sa), np.asarray(sp))
     np.testing.assert_allclose(np.asarray(sca), np.asarray(scp),
                                atol=1e-5, rtol=1e-5)
