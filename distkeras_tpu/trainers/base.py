@@ -128,6 +128,7 @@ class Trainer(CheckpointingBase):
         self.eval_every = eval_every
         self.eval_history: list[tuple[int, dict]] = []
         self._eval_batch = None
+        self._eval_chunks = None   # multi-process: pre-staged global chunks
         self._eval_fn = None
         self.batch_size = batch_size
         self.num_epoch = num_epoch
@@ -165,18 +166,15 @@ class Trainer(CheckpointingBase):
             dataset = dataset.shuffle(self.seed)
         self.eval_history = []
         self._eval_batch = None
+        self._eval_chunks = None
         if eval_dataset is not None:
             if jax.process_count() > 1:
-                raise ValueError(
-                    "eval_dataset is not supported in the multi-process "
-                    "runtime yet: each process holds only its "
-                    "Dataset.shard, so per-host evaluation would report "
-                    "divergent metrics. Evaluate after training on one "
-                    "host (ModelPredictor + AccuracyEvaluator).")
-            if len(eval_dataset) == 0:
+                self._stage_eval_chunks(eval_dataset)
+            elif len(eval_dataset) == 0:
                 raise ValueError("eval_dataset is empty")
-            self._eval_batch = (eval_dataset[self.features_col],
-                                eval_dataset[self.label_col])
+            else:
+                self._eval_batch = (eval_dataset[self.features_col],
+                                    eval_dataset[self.label_col])
             self._eval_fn = jax.jit(self.adapter.make_eval_fn())
         elif self.eval_every:
             raise ValueError(
@@ -193,6 +191,62 @@ class Trainer(CheckpointingBase):
         return self._export(state)
 
     # -- evaluation hook ---------------------------------------------------
+    def _stage_eval_chunks(self, eval_dataset: Dataset) -> None:
+        """Multi-process eval: pre-stage the (host-local) eval shard as
+        globally-sharded chunks of exactly the training microbatch
+        geometry, mirroring LMTrainer's eval-chunk plumbing.
+
+        Each host contributes ``global_bs / process_count`` rows per
+        chunk (``_global_batch`` assembles the global array from the
+        process-local slabs); the jitted eval fn then computes the
+        global mean with compiler-inserted collectives and returns it
+        replicated, so every host records identical eval_history.  The
+        collective cadence requires every host to pass an eval shard
+        with the SAME row count (checked up front); the tail remainder
+        that doesn't fill a chunk is dropped, as in training.
+
+        Only the host-side slabs are kept here; each global chunk is
+        assembled on device when an eval round actually fires
+        (_eval_hook) — pinning the whole eval set in HBM for the run
+        would cut into training memory, the thing the single-process
+        path's mini-batching exists to protect.
+        """
+        from jax.experimental import multihost_utils
+
+        mesh = getattr(self, "mesh", None)
+        if mesh is None:
+            raise ValueError(
+                "eval_dataset in the multi-process runtime needs a mesh "
+                "trainer (the distributed/elastic family or LMTrainer); "
+                "SingleTrainer has no cross-host eval plane")
+        pcount = jax.process_count()
+        global_bs = self.batch_size * self.num_workers
+        if global_bs % pcount:
+            raise ValueError(
+                f"global batch {global_bs} (batch_size x num_workers) "
+                f"must divide by the process count ({pcount}) to stage "
+                "eval chunks")
+        feed = global_bs // pcount
+        sizes = [int(s) for s in multihost_utils.process_allgather(
+            np.asarray(len(eval_dataset), np.int64))]
+        if len(set(sizes)) != 1:
+            raise ValueError(
+                f"unequal eval shard sizes across processes: {sizes} — "
+                "every host's eval_dataset shard must hold the same "
+                "number of rows (the eval collective runs in lockstep)")
+        usable = len(eval_dataset) - len(eval_dataset) % feed
+        if usable == 0:
+            raise ValueError(
+                f"eval_dataset holds {len(eval_dataset)} rows per host "
+                f"but one eval chunk needs {feed} "
+                "(batch_size x num_workers / process_count)")
+        x = np.asarray(eval_dataset[self.features_col])
+        y = np.asarray(eval_dataset[self.label_col])
+        sh = self._batch_sharding(leading_window=False)
+        self._eval_chunks = (
+            [(x[j:j + feed], y[j:j + feed], feed * pcount)
+             for j in range(0, usable, feed)], sh)
+
     def _eval_state_view(self, pytree):
         """(tv, ntv) of the evaluable model inside a fit-loop pytree."""
         return pytree.tv, pytree.ntv
@@ -200,23 +254,37 @@ class Trainer(CheckpointingBase):
     def _eval_hook(self, pytree, rnd, final: bool = False) -> None:
         """Record eval metrics at round ``rnd``; the end-of-training
         call records round -1 (always runs when an eval set exists)."""
-        if self._eval_batch is None:
+        if self._eval_batch is None and self._eval_chunks is None:
             return
         if not final and not (self.eval_every and rnd % self.eval_every == 0):
             return
         tv, ntv = self._eval_state_view(pytree)
-        x, y = self._eval_batch
-        # Mini-batch the eval set (at the training batch size) so a
-        # large eval split never materializes all activations at once;
-        # at most two compiled shapes (full batches + one remainder).
-        bs = min(self.batch_size, len(x))
         sums, n = {}, 0
-        for i in range(0, len(x), bs):
-            xb, yb = x[i:i + bs], y[i:i + bs]
-            part = self._eval_fn(tv, ntv, xb, yb)
-            for k, v in part.items():
-                sums[k] = sums.get(k, 0.0) + float(v) * len(xb)
-            n += len(xb)
+        if self._eval_chunks is not None:
+            # Multi-process: host slabs are assembled into globally-
+            # sharded chunks only when an eval round fires; the eval
+            # outputs are replicated scalars (global means via the
+            # compiled collectives), identical on every host.
+            slabs, sh = self._eval_chunks
+            for xb, yb, rows in slabs:
+                part = self._eval_fn(tv, ntv,
+                                     self._global_batch(xb, sh),
+                                     self._global_batch(yb, sh))
+                for k, v in part.items():
+                    sums[k] = sums.get(k, 0.0) + float(v) * rows
+                n += rows
+        else:
+            x, y = self._eval_batch
+            # Mini-batch the eval set (at the training batch size) so a
+            # large eval split never materializes all activations at
+            # once; at most two compiled shapes (full + one remainder).
+            bs = min(self.batch_size, len(x))
+            for i in range(0, len(x), bs):
+                xb, yb = x[i:i + bs], y[i:i + bs]
+                part = self._eval_fn(tv, ntv, xb, yb)
+                for k, v in part.items():
+                    sums[k] = sums.get(k, 0.0) + float(v) * len(xb)
+                n += len(xb)
         out = {k: v / n for k, v in sums.items()}
         self.eval_history.append((-1 if final else rnd, out))
 

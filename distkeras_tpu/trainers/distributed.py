@@ -24,7 +24,9 @@ for API parity.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.data.dataset import Dataset
@@ -205,15 +207,15 @@ class ADAG(DistributedTrainer):
         the streaming path is capped by the host link, 320k vs ~10k
         samples/s on this relay).  Training math is EXACTLY the
         streaming path's (same accum step fed the same rows in the same
-        order — exactness-tested).  Single-process meshes: multi-host
-        staging would need per-host shard-local indexing; raise rather
-        than silently duplicate rows.
+        order — exactness-tested).
+
+        Multi-process meshes take :meth:`_fit_device_data_multihost`:
+        per-host shard-local staging (each host's rows live only on its
+        own devices) with replica-local gathers under shard_map — no
+        row is ever duplicated or shipped cross-host.
         """
         if jax.process_count() > 1:
-            raise ValueError(
-                "device_data=True supports single-process meshes (the "
-                "multi-host data plane streams per-host shards; see "
-                "docs/multihost.md)")
+            return self._fit_device_data_multihost(dataset)
         w = self.communication_window
         state = self.adapter.init_state()
         state, state_sh = self._shard_state(state)
@@ -240,6 +242,119 @@ class ADAG(DistributedTrainer):
                     yield (X, Y, jax.device_put(idx, idx_sh))
 
         return self._run_rounds(state, step, index_blocks(), rows,
+                                dataset)
+
+    def _fit_device_data_multihost(self, dataset: Dataset):
+        """Device-resident data plane across hosts (round-3 verdict:
+        the single-process-only ValueError cut against the framework's
+        distributed-first identity).
+
+        Each host stages ITS ``Dataset.shard`` in HBM once, laid out so
+        every replica's consumption stream is CONTIGUOUS in its own
+        shard of the global array: the host's usable rows, viewed as
+        ``[chunks, local_replicas, batch]``, are transposed to
+        ``[local_replicas, chunks * batch]`` before staging under
+        ``P("data")`` — device ``l`` of this host then holds exactly
+        the rows streaming would feed it, in consumption order.  Per
+        round only one replicated ``[window, batch]`` index block
+        crosses the link, and a ``shard_map`` gathers each replica's
+        microbatch rows from its LOCAL block (a sharded-``X`` gather
+        under plain jit would allgather the dataset every step).  The
+        gathered global batch re-enters the same accum step as the
+        streaming path with the same sharding, so the training math
+        and data order are EXACTLY the streaming multi-process run's
+        (replica ``(h, l)`` sees host h's rows
+        ``chunk * feed + l * batch + k`` either way) — parity-tested in
+        tests/test_deploy.py.
+        """
+        from jax.experimental import multihost_utils
+
+        w = self.communication_window
+        pcount = jax.process_count()
+        global_bs = self.batch_size * self.num_workers
+        if global_bs % pcount:
+            raise ValueError(
+                f"global batch {global_bs} (batch_size x num_workers) must "
+                f"divide by the process count ({pcount})")
+        feed_bs = global_bs // pcount          # rows per host per microbatch
+        n_local_dev = self.num_workers // pcount
+        bs = self.batch_size
+        n = len(dataset)
+        usable = n - n % (feed_bs * w)
+        all_usable = [int(u) for u in multihost_utils.process_allgather(
+            np.asarray(usable, np.int64))]
+        if len(set(all_usable)) != 1:
+            raise ValueError(
+                f"unequal usable row counts across processes: {all_usable} "
+                f"— every host's Dataset.shard must stage the same number "
+                f"of {feed_bs * w}-row windows; pad or trim the shards")
+        if usable == 0:
+            raise ValueError(
+                f"dataset shard has {n} rows but one training step needs "
+                f"{feed_bs * w} per host; reduce "
+                "batch_size/communication_window/num_workers or provide "
+                "more data")
+        chunks = usable // feed_bs             # multiple of w
+
+        def stream_layout(col):
+            # [chunks, L, bs, ...] -> [L, chunks*bs, ...]: device l's
+            # contiguous block = its consumption stream.
+            a = np.asarray(col[:usable])
+            a = a.reshape((chunks, n_local_dev, bs) + a.shape[1:])
+            a = np.moveaxis(a, 1, 0)
+            return np.ascontiguousarray(
+                a.reshape((usable,) + a.shape[3:]))
+
+        data_sh = NamedSharding(self.mesh, P("data"))
+        rep = NamedSharding(self.mesh, P())
+        X = jax.make_array_from_process_local_data(
+            data_sh, stream_layout(dataset[self.features_col]))
+        Y = jax.make_array_from_process_local_data(
+            data_sh, stream_layout(dataset[self.label_col]))
+
+        state = self.adapter.init_state()
+        state, state_sh = self._shard_state(state)
+        accum = self.adapter.make_accum_train_step(w)
+        mesh = self.mesh
+
+        def local_gather(Xb, Yb, idx):
+            # Xb [chunks*bs, ...]: THIS replica's stream; idx [w, bs]
+            # replicated block-local offsets (identical per replica).
+            shape = lambda a: (w, bs) + a.shape[1:]
+            return (jnp.take(Xb, idx.reshape(-1), axis=0).reshape(
+                        shape(Xb)),
+                    jnp.take(Yb, idx.reshape(-1), axis=0).reshape(
+                        shape(Yb)))
+
+        gather = shard_map(
+            local_gather, mesh=mesh,
+            in_specs=(P("data"), P("data"), P()),
+            out_specs=(P(None, "data"), P(None, "data")),
+            check_vma=False)
+
+        def step_fn(state, X, Y, idx):
+            xs, ys = gather(X, Y, idx)
+            return accum(state, xs, ys)
+
+        step = jax.jit(
+            step_fn,
+            in_shardings=(state_sh, data_sh, data_sh, rep),
+            out_shardings=(state_sh, NamedSharding(self.mesh, P())),
+            donate_argnums=0,
+        )
+
+        def index_blocks():
+            for _ in range(self.num_epoch):
+                for r in range(chunks // w):
+                    idx = np.arange(r * w * bs, (r + 1) * w * bs,
+                                    dtype=np.int32).reshape(w, bs)
+                    # device_put cannot target non-addressable devices;
+                    # every host holds the identical block, so assemble
+                    # the replicated global array from the local copy.
+                    yield (X, Y, jax.make_array_from_process_local_data(
+                        rep, idx, idx.shape))
+
+        return self._run_rounds(state, step, index_blocks(), feed_bs * w,
                                 dataset)
 
 

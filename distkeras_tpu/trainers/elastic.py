@@ -135,9 +135,17 @@ class ReplicaTrainer(DistributedTrainer):
     def _eval_state_view(self, pytree):
         if isinstance(pytree, dict):  # mid-fit round pytree
             # Evaluate the center variable (the algorithm's product);
-            # aux state (BatchNorm stats) from replica 0.
-            ntv = jax.tree.map(lambda a: a[0], pytree["stacked"].ntv)
-            return pytree["center_tv"], ntv
+            # aux state (BatchNorm stats) from replica 0.  The slice is
+            # compiled with replicated output, same as the export path:
+            # an eager a[0] cannot read non-addressable shards in the
+            # multi-process runtime (and all hosts reach here in
+            # lockstep, so the collective is safe).
+            if getattr(self, "_eval_slice0", None) is None:
+                self._eval_slice0 = jax.jit(
+                    lambda s: jax.tree.map(lambda a: a[0], s),
+                    out_shardings=NamedSharding(self.mesh, P()))
+            return pytree["center_tv"], self._eval_slice0(
+                pytree["stacked"].ntv)
         return super()._eval_state_view(pytree)
 
     # ------------------------------------------------------------ round

@@ -76,7 +76,8 @@ def update_last_green(line: dict, path: str = LAST_GREEN_PATH,
         try:
             with open(path) as f:
                 rec = json.load(f)
-            if not isinstance(rec.get("entries"), dict):
+            if (not isinstance(rec, dict)
+                    or not isinstance(rec.get("entries"), dict)):
                 rec = {"entries": {}}
         except (OSError, ValueError):
             rec = {"entries": {}}

@@ -582,3 +582,140 @@ def test_two_process_packed_training_matches_single(tmp_path, devices):
     for k, v in ref.items():
         np.testing.assert_allclose(got[k], v, rtol=1e-4, atol=1e-5,
                                    err_msg=k)
+
+
+MULTIHOST_EVAL_CHILD = """{preamble}
+
+import numpy as np
+import distkeras_tpu as dk
+from helpers import make_blobs, make_mlp
+
+assert jax.process_count() == 2
+host = int(os.environ["DKT_HOST_ID"])
+
+x, y = make_blobs(n=256)
+ex, ey = make_blobs(n=128, seed=7)
+ds = dk.Dataset.from_arrays(x, y).shard(host, 2)
+eval_ds = dk.Dataset.from_arrays(ex, ey).shard(host, 2)
+
+t = dk.ADAG(make_mlp(), loss="sparse_categorical_crossentropy",
+            worker_optimizer="sgd", learning_rate=0.05, batch_size=8,
+            communication_window=2, num_workers=8, num_epoch=1,
+            metrics=("accuracy",), eval_every=1)
+t.train(ds, eval_dataset=eval_ds)
+assert len(t.eval_history) == 3, t.eval_history  # rounds 1, 2, final
+
+# The replica-stacked family's eval view slices ntv out of the global
+# replica stack — an eager a[0] cannot read non-addressable shards, so
+# this exercises the jitted replicated slice (code-review regression).
+d = dk.DOWNPOUR(make_mlp(), loss="sparse_categorical_crossentropy",
+                worker_optimizer="sgd", learning_rate=0.05, batch_size=8,
+                communication_window=2, num_workers=8, num_epoch=1,
+                metrics=("accuracy",), eval_every=1)
+d.train(ds, eval_dataset=eval_ds)
+assert len(d.eval_history) == 3, d.eval_history  # rounds 1, 2, final
+assert all(np.isfinite(m["loss"]) for _, m in d.eval_history)
+
+np.savez({out!r} + f".h{{host}}.npz",
+         rounds=np.asarray([r for r, _ in t.eval_history]),
+         loss=np.asarray([m["loss"] for _, m in t.eval_history]),
+         accuracy=np.asarray([m["accuracy"]
+                              for _, m in t.eval_history]),
+         d_loss=np.asarray([m["loss"] for _, m in d.eval_history]),
+         d_acc=np.asarray([m["accuracy"] for _, m in d.eval_history]))
+print("HOST", host, "OK", flush=True)
+"""
+
+
+def test_two_process_eval_dataset_matches_single(tmp_path, devices):
+    """Mid-training evaluation on the real multi-process runtime
+    (round-3 verdict: the eval_dataset ValueError is gone): each host
+    stages its eval shard as globally-sharded chunks, the jitted eval
+    fn reduces across hosts via the compiled collectives, and the
+    recorded history must match the single-process run over the full
+    eval set (same rows, permutation-invariant means)."""
+    out = str(tmp_path / "evalhist")
+    _spawn_hosts(MULTIHOST_EVAL_CHILD, num_hosts=2, devs_per_host=4,
+                 out=out)
+
+    import distkeras_tpu as dk
+    from helpers import make_blobs, make_mlp
+
+    x, y = make_blobs(n=256)
+    ex, ey = make_blobs(n=128, seed=7)
+    t = dk.ADAG(make_mlp(), loss="sparse_categorical_crossentropy",
+                worker_optimizer="sgd", learning_rate=0.05, batch_size=8,
+                communication_window=2, num_workers=8, num_epoch=1,
+                metrics=("accuracy",), eval_every=1)
+    t.train(dk.Dataset.from_arrays(x, y),
+            eval_dataset=dk.Dataset.from_arrays(ex, ey))
+
+    got = np.load(out + ".h0.npz")
+    np.testing.assert_array_equal(
+        got["rounds"], [r for r, _ in t.eval_history])
+    np.testing.assert_allclose(
+        got["loss"], [m["loss"] for _, m in t.eval_history],
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        got["accuracy"], [m["accuracy"] for _, m in t.eval_history],
+        rtol=1e-4, atol=1e-5)
+    # Both hosts must record IDENTICAL histories (replicated eval
+    # outputs) — for ADAG and for the replica-stacked DOWNPOUR.
+    h1 = np.load(out + ".h1.npz")
+    for k in ("rounds", "loss", "accuracy", "d_loss", "d_acc"):
+        np.testing.assert_array_equal(got[k], h1[k], err_msg=k)
+
+
+MULTIHOST_DEVICE_DATA_CHILD = """{preamble}
+
+import numpy as np
+import distkeras_tpu as dk
+from helpers import make_blobs, make_mlp
+
+assert jax.process_count() == 2
+host = int(os.environ["DKT_HOST_ID"])
+
+x, y = make_blobs(n=256)
+ds = dk.Dataset.from_arrays(x, y).shard(host, 2)
+
+t = dk.ADAG(make_mlp(), loss="sparse_categorical_crossentropy",
+            worker_optimizer="sgd", learning_rate=0.05, batch_size=8,
+            communication_window=2, num_workers=8, num_epoch=1,
+            device_data=True)
+trained = t.train(ds)
+assert len(t.history) == 2, t.history
+if host == 0:
+    np.savez({out!r}, *[np.asarray(w) for w in trained.get_weights()],
+             losses=np.asarray(t.history))
+print("HOST", host, "OK", flush=True)
+"""
+
+
+def test_two_process_device_data_adag_matches_single(tmp_path, devices):
+    """The device-resident data plane across hosts (round-3 verdict:
+    device_data=True was single-process-only): each host stages its
+    shard in replica-stream layout, gathers are replica-local under
+    shard_map, and the trained weights must match the single-process
+    streaming run (each global microbatch is the same row set; mean
+    gradients are permutation invariant)."""
+    out = str(tmp_path / "host0.npz")
+    _spawn_hosts(MULTIHOST_DEVICE_DATA_CHILD, num_hosts=2,
+                 devs_per_host=4, out=out)
+
+    import distkeras_tpu as dk
+    from helpers import make_blobs, make_mlp
+
+    x, y = make_blobs(n=256)
+    t = dk.ADAG(make_mlp(), loss="sparse_categorical_crossentropy",
+                worker_optimizer="sgd", learning_rate=0.05, batch_size=8,
+                communication_window=2, num_workers=8, num_epoch=1)
+    ref = t.train(dk.Dataset.from_arrays(x, y))
+
+    got = np.load(out)
+    ref_w = [np.asarray(w) for w in ref.get_weights()]
+    got_w = [got[k] for k in got.files if k != "losses"]
+    assert len(got_w) == len(ref_w)
+    for a, b in zip(got_w, ref_w):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got["losses"], np.asarray(t.history),
+                               rtol=1e-4)
