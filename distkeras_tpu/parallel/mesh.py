@@ -117,3 +117,41 @@ def global_batch(arr, sharding):
     if jax.process_count() == 1:
         return jax.device_put(arr, sharding)
     return jax.make_array_from_process_local_data(sharding, arr)
+
+
+def equal_across_hosts(local_count: int, what: str) -> int:
+    """Assert every process computed the same ``local_count``; returns it.
+
+    The ONE definition of the lockstep-safety check the multi-process
+    paths share (streaming rounds, eval shard sizes, device-resident
+    usable windows): a host that would run more collective iterations
+    than its peers deadlocks the mesh, so the imbalance must raise on
+    EVERY host — the allgather here is itself collective, but it runs
+    before the loop, while all processes still agree.  No-op (no
+    collective) single-process.
+    """
+    if jax.process_count() == 1:
+        return local_count
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    counts = [int(c) for c in multihost_utils.process_allgather(
+        np.asarray(local_count, np.int64))]
+    if len(set(counts)) != 1:
+        raise ValueError(
+            f"unequal {what} across processes: {counts} — every host "
+            "must contribute the same count or the collectives "
+            "deadlock; pad or trim the per-host shards")
+    return local_count
+
+
+def per_host_rows(global_bs: int, what: str = "global batch") -> int:
+    """Rows each process feeds per global batch: ``global_bs /
+    process_count``, validated to divide evenly (shared by the
+    streaming, eval-chunk, and device-resident staging geometry)."""
+    pcount = jax.process_count()
+    if global_bs % pcount:
+        raise ValueError(
+            f"{what} {global_bs} (batch_size x num_workers) must "
+            f"divide by the process count ({pcount})")
+    return global_bs // pcount

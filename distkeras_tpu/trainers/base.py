@@ -211,7 +211,8 @@ class Trainer(CheckpointingBase):
         would cut into training memory, the thing the single-process
         path's mini-batching exists to protect.
         """
-        from jax.experimental import multihost_utils
+        from distkeras_tpu.parallel.mesh import (equal_across_hosts,
+                                                  per_host_rows)
 
         mesh = getattr(self, "mesh", None)
         if mesh is None:
@@ -220,20 +221,9 @@ class Trainer(CheckpointingBase):
                 "trainer (the distributed/elastic family or LMTrainer); "
                 "SingleTrainer has no cross-host eval plane")
         pcount = jax.process_count()
-        global_bs = self.batch_size * self.num_workers
-        if global_bs % pcount:
-            raise ValueError(
-                f"global batch {global_bs} (batch_size x num_workers) "
-                f"must divide by the process count ({pcount}) to stage "
-                "eval chunks")
-        feed = global_bs // pcount
-        sizes = [int(s) for s in multihost_utils.process_allgather(
-            np.asarray(len(eval_dataset), np.int64))]
-        if len(set(sizes)) != 1:
-            raise ValueError(
-                f"unequal eval shard sizes across processes: {sizes} — "
-                "every host's eval_dataset shard must hold the same "
-                "number of rows (the eval collective runs in lockstep)")
+        feed = per_host_rows(self.batch_size * self.num_workers,
+                             what="eval-chunk global batch")
+        equal_across_hosts(len(eval_dataset), "eval shard sizes")
         usable = len(eval_dataset) - len(eval_dataset) % feed
         if usable == 0:
             raise ValueError(

@@ -30,7 +30,8 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.data.dataset import Dataset
-from distkeras_tpu.parallel.mesh import (MeshSpec, make_mesh,
+from distkeras_tpu.parallel.mesh import (MeshSpec, equal_across_hosts,
+                                          make_mesh, per_host_rows,
                                           global_batch as mesh_global_batch)
 from distkeras_tpu.parallel.sharding import ShardingPlan, dp_plan, fsdp_plan
 from distkeras_tpu.trainers.base import Trainer
@@ -138,31 +139,13 @@ class ADAG(DistributedTrainer):
 
         # Global batch = num_workers * batch_size rows per microbatch;
         # one jitted call consumes `window` microbatches.  Each process
-        # feeds its share of the global batch from its dataset shard.
-        global_bs = self.batch_size * self.num_workers
-        pcount = jax.process_count()
-        if global_bs % pcount:
-            raise ValueError(
-                f"global batch {global_bs} (batch_size x num_workers) must "
-                f"divide by the process count ({pcount})")
-        feed_bs = global_bs // pcount
-        if pcount > 1:
-            # Every process must dispatch the same number of steps or
-            # the all-reduce deadlocks: check shard balance up front
-            # (the allgather is itself collective, but it sits before
-            # the loop, where every process still agrees).
-            from jax.experimental import multihost_utils
+        # feeds its share of the global batch from its dataset shard;
+        # the balance check keeps hosts from deadlocking the all-reduce
+        # (mesh.equal_across_hosts: raise-before-loop, on every host).
+        feed_bs = per_host_rows(self.batch_size * self.num_workers)
+        equal_across_hosts(len(dataset) // (feed_bs * w),
+                           f"step counts ({feed_bs * w}-row windows)")
 
-            local_rounds = len(dataset) // (feed_bs * w)
-            all_rounds = [int(r) for r in
-                          multihost_utils.process_allgather(
-                              np.asarray(local_rounds, np.int64))]
-            if len(set(all_rounds)) != 1:
-                raise ValueError(
-                    f"unequal step counts across processes: {all_rounds} — "
-                    "every host's Dataset.shard must yield the same number "
-                    f"of window batches ({feed_bs * w} rows each); pad or "
-                    "trim the dataset to a multiple")
         def stream():
             for _ in range(self.num_epoch):
                 for xs, ys in dataset.batches(
@@ -267,27 +250,15 @@ class ADAG(DistributedTrainer):
         ``chunk * feed + l * batch + k`` either way) — parity-tested in
         tests/test_deploy.py.
         """
-        from jax.experimental import multihost_utils
-
         w = self.communication_window
         pcount = jax.process_count()
-        global_bs = self.batch_size * self.num_workers
-        if global_bs % pcount:
-            raise ValueError(
-                f"global batch {global_bs} (batch_size x num_workers) must "
-                f"divide by the process count ({pcount})")
-        feed_bs = global_bs // pcount          # rows per host per microbatch
+        feed_bs = per_host_rows(self.batch_size * self.num_workers)
         n_local_dev = self.num_workers // pcount
         bs = self.batch_size
         n = len(dataset)
-        usable = n - n % (feed_bs * w)
-        all_usable = [int(u) for u in multihost_utils.process_allgather(
-            np.asarray(usable, np.int64))]
-        if len(set(all_usable)) != 1:
-            raise ValueError(
-                f"unequal usable row counts across processes: {all_usable} "
-                f"— every host's Dataset.shard must stage the same number "
-                f"of {feed_bs * w}-row windows; pad or trim the shards")
+        usable = equal_across_hosts(
+            n - n % (feed_bs * w),
+            f"usable row counts ({feed_bs * w}-row windows)")
         if usable == 0:
             raise ValueError(
                 f"dataset shard has {n} rows but one training step needs "

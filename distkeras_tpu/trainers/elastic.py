@@ -40,6 +40,7 @@ from jax import shard_map
 
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.adapter import TrainState
+from distkeras_tpu.parallel.mesh import equal_across_hosts
 from distkeras_tpu.trainers.distributed import DistributedTrainer
 
 # A sync rule: (local_tv, center_tv, axis_name) -> (new_local_tv, new_center_tv)
@@ -222,22 +223,11 @@ class ReplicaTrainer(DistributedTrainer):
             return jax.make_array_from_process_local_data(
                 batch_sh, a, (self.num_workers,) + tuple(a.shape[1:]))
 
-        if pcount > 1:
-            # Every process must run the same number of rounds or the
-            # sync collective deadlocks; check before the loop (the
-            # allgather is itself collective but runs while all
-            # processes still agree).
-            from jax.experimental import multihost_utils
-
-            rows = self.batch_size * self._n_local() * window
-            local_rounds = (len(dataset) // rows) * self.num_epoch
-            all_rounds = [int(r) for r in multihost_utils.process_allgather(
-                np.asarray(local_rounds, np.int64))]
-            if len(set(all_rounds)) != 1:
-                raise ValueError(
-                    f"unequal round counts across processes: {all_rounds} "
-                    f"— every host's Dataset.shard must yield the same "
-                    f"number of {rows}-row windows; pad or trim shards")
+        # Lockstep safety: unequal round counts deadlock the sync
+        # collective (one shared definition — mesh.equal_across_hosts).
+        rows = self.batch_size * self._n_local() * window
+        equal_across_hosts((len(dataset) // rows) * self.num_epoch,
+                           f"round counts ({rows}-row windows)")
 
         restored, start = self._restore_or(
             {"stacked": stacked, "center_tv": center_tv})

@@ -293,6 +293,85 @@ def bench_transformer_fusedce():
                        flops_cfg=dataclasses.replace(cfg, ce_chunks=0))
 
 
+def _d1024_cfg(**kw):
+    from distkeras_tpu.models import transformer as tfm
+
+    # Dense d1024 L8 at seq 1024: the direct comparison row for the MoE
+    # and LoRA configs below (same trunk; transformer_long differs in
+    # seq length and remat, so it can't serve as their baseline).
+    return tfm.TransformerConfig(
+        vocab_size=32768, d_model=1024, n_heads=8, n_layers=8, d_ff=4096,
+        max_len=1025, dtype="bfloat16", **kw)
+
+
+def bench_transformer_d1024(batch=8, seq=1024, iters=30):
+    """Dense-FFN baseline row for the MoE/LoRA family (d1024 L8 s1024).
+    (batch/seq/iters overridable so CPU smoke tests can shrink them.)"""
+    return _measure_lm(_d1024_cfg(), batch=batch, seq=seq, iters=iters)
+
+
+def bench_transformer_moe(top_k):
+    """Mixture-of-experts training: 8 experts over the d1024 L8 trunk,
+    capacity_factor 1.25 (Switch top-1 / renormalized top-2).  The
+    capacity einsum dispatch is all-to-all-shaped even on one chip, so
+    step time vs the dense row IS the routing+dispatch overhead; MFU
+    comes from the compiled program's own cost_analysis (it counts the
+    dispatch/combine einsums — hardware MFU, not active-param MFU)."""
+    def run(batch=8, seq=1024, iters=30):
+        cfg = _d1024_cfg(num_experts=8, moe_top_k=top_k,
+                         capacity_factor=1.25)
+        rate, step_s, flops = _measure_lm(cfg, batch=batch, seq=seq,
+                                          iters=iters)
+        return rate, step_s, flops, {
+            "num_experts": 8, "moe_top_k": top_k,
+            "capacity_factor": 1.25,
+            "dense_baseline": "transformer_d1024"}
+    return run
+
+
+def bench_lora_finetune(batch=8, seq=1024, iters=30):
+    """LoRA fine-tune step throughput on the d1024 L8 row (rank 8,
+    wq/wv): the forward is byte-identical to full fine-tuning (merge
+    inside the step), so the delta vs ``transformer_d1024`` isolates
+    what LoRA saves — backward skips the base's gradient paths and the
+    optimizer touches ~1000x fewer moments."""
+    import jax
+    import numpy as np
+    import optax
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.models.lora import (LoRAConfig, lora_init,
+                                           lora_mask, make_lora_loss)
+
+    cfg = _d1024_cfg()
+    lcfg = LoRAConfig(rank=8, alpha=16.0, targets=("wq", "wv"))
+    base = tfm.init_params(jax.random.key(0), cfg)
+    adapters = lora_init(jax.random.key(1), cfg, lcfg)
+    opt = optax.masked(optax.adamw(3e-4), lora_mask)
+    step = jax.jit(
+        tfm.make_train_step(cfg, opt, loss_fn=make_lora_loss(cfg, lcfg)),
+        donate_argnums=0)
+    packed = (adapters, base)
+    carry = (packed, opt.init(packed))
+    rng = np.random.default_rng(0)
+    tokens = jax.device_put(
+        rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32))
+    step_flops = compiled_flops(step, carry, tokens)
+    for _ in range(5):
+        carry, loss = step(carry, tokens)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry, loss = step(carry, tokens)
+    float(loss)
+    dt = time.perf_counter() - t0
+    n_adapter = sum(int(np.prod(np.shape(a))) for a in
+                    jax.tree.leaves(adapters))
+    return batch * seq * iters / dt, dt / iters, step_flops, {
+        "lora_rank": 8, "lora_targets": "wq,wv",
+        "adapter_params": n_adapter,
+        "dense_baseline": "transformer_d1024"}
+
+
 def _long_cfg():
     from distkeras_tpu.models import transformer as tfm
 
@@ -592,6 +671,10 @@ BENCHES = {
     "transformer_long_noremat": (bench_transformer_long_noremat,
                                  "tokens/sec/chip"),
     "transformer_long_xla": (bench_transformer_long_xla, "tokens/sec/chip"),
+    "transformer_d1024": (bench_transformer_d1024, "tokens/sec/chip"),
+    "transformer_moe_top1": (bench_transformer_moe(1), "tokens/sec/chip"),
+    "transformer_moe_top2": (bench_transformer_moe(2), "tokens/sec/chip"),
+    "lora_finetune": (bench_lora_finetune, "tokens/sec/chip"),
 }
 
 

@@ -1,0 +1,74 @@
+"""Run-to-run variance protocol for the headline benchmarks.
+
+Round-3 left a −21% r2→r3 swing on CIFAR-CNN/MNIST-MLP attributed to
+"relay variance" with no variance data (round-3 verdict, weakness 2).
+This runs each named config N times IN ONE TUNNEL SESSION and reports
+median / min / max / IQR, so BASELINE.md rows can carry spread columns
+and cross-round deltas can be judged against measured noise instead of
+folklore.
+
+Usage:
+    python scripts/variance.py [-n 5] [config ...]
+Defaults: n=5 over the headline set (cifar_cnn, mnist_mlp,
+cifar_cnn_resident, transformer_long).  Prints one JSON line per
+config: {"metric", "runs", "median", "min", "max", "iqr_pct", "unit",
+"values"}.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+HEADLINE = ("cifar_cnn", "mnist_mlp", "cifar_cnn_resident",
+            "transformer_long")
+
+
+def main(argv):
+    import numpy as np
+
+    from bench_suite import BENCHES
+
+    n = 5
+    if argv[:1] == ["-n"]:
+        n, argv = int(argv[1]), argv[2:]
+    names = argv or list(HEADLINE)
+    unknown = set(names) - set(BENCHES)
+    if unknown:
+        sys.exit(f"unknown config(s) {sorted(unknown)}; "
+                 f"choose from {sorted(BENCHES)}")
+    import jax
+
+    print(f"# backend={jax.default_backend()} device={jax.devices()[0]} "
+          f"n={n}", file=sys.stderr)
+    for name in names:
+        fn, unit = BENCHES[name]
+        vals = []
+        for i in range(n):
+            try:
+                vals.append(float(fn()[0]))
+            except Exception as e:
+                print(json.dumps({"metric": name, "run": i,
+                                  "error": repr(e)[:200]}))
+        if not vals:
+            continue
+        v = np.asarray(vals)
+        q1, med, q3 = np.percentile(v, [25, 50, 75])
+        print(json.dumps({
+            "metric": name, "runs": len(vals),
+            "median": round(float(med), 1),
+            "min": round(float(v.min()), 1),
+            "max": round(float(v.max()), 1),
+            "iqr_pct": round(float((q3 - q1) / med * 100), 2),
+            "spread_pct": round(
+                float((v.max() - v.min()) / med * 100), 2),
+            "unit": unit,
+            "values": [round(float(x), 1) for x in vals],
+        }))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
