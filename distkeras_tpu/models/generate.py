@@ -145,6 +145,51 @@ def prefill(params, prompt, cfg: TransformerConfig,
     return cache, _unembed(x[:, -1:], params, cfg)[:, 0]
 
 
+def _ancestry_attend(qg, ck, cv, anc_oh, mask_b, cfg: TransformerConfig,
+                     w_beams: int, kv_scales=None):
+    """Beam ancestry attention for ONE position, shared by the
+    full-cache chunk body and the windowed ring-buffer body.
+
+    ``qg [B, kv_heads, groups, hd]`` f32 queries (beam lanes tiled
+    batch-major, B = bt * W), ``ck/cv [B, S, kv_heads, hd]`` the
+    per-lane cache, ``anc_oh [bt, W, S, W]`` f32 one-hot ancestor map
+    (position s of lane w reads from lane ``anc[b, w, s]``), ``mask_b
+    [bt, W, S]`` bool valid-position mask (position mask full-cache,
+    band mask windowed — the ONLY difference between the two callers:
+    beam_search never decodes past max_len, so ring slots never wrap
+    mid-search and slot == position throughout).  Scores every
+    (query-lane, source-lane) pair — the cache is read once, W x the
+    tiny decode attention FLOPs — then the one-hot selects each
+    position's true ancestor.  ``kv_scales=(cks, cvs) [B, S, kv]``:
+    int8-KV dequant scales (full-cache path only).
+    Returns ``attn [B, n_heads, hd]`` f32.
+    """
+    b = qg.shape[0]
+    s_len = ck.shape[1]
+    bt = b // w_beams
+    qb = qg.reshape(bt, w_beams, cfg.kv_heads, -1, cfg.head_dim)
+    kb = ck.astype(jnp.float32).reshape(
+        bt, w_beams, s_len, cfg.kv_heads, cfg.head_dim)
+    vb = cv.astype(jnp.float32).reshape(
+        bt, w_beams, s_len, cfg.kv_heads, cfg.head_dim)
+    la = jnp.einsum("bwcgk,bvsck->bwcgvs", qb, kb)
+    if kv_scales is not None:
+        # [B, S, C] -> [bt, 1, C, 1, v(=w), S] over la's dims.
+        bsc = lambda sc: sc.reshape(
+            bt, w_beams, s_len, cfg.kv_heads).transpose(
+            0, 3, 1, 2)[:, None, :, None, :, :]
+        la = la * bsc(kv_scales[0])
+    logits = jnp.einsum("bwcgvs,bwsv->bwcgs", la, anc_oh)
+    logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
+    logits = jnp.where(mask_b[:, :, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    pm = jnp.einsum("bwcgs,bwsv->bwcgvs", probs, anc_oh)
+    if kv_scales is not None:
+        pm = pm * bsc(kv_scales[1])
+    return jnp.einsum("bwcgvs,bvsck->bwcgk", pm, vb).reshape(
+        b, cfg.n_heads, cfg.head_dim)
+
+
 def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
                  pad_lens=None, beam_anc=None):
     """One position: tokens [B] at position ``pos`` -> (logits [B, V], cache).
@@ -170,9 +215,12 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
                                    jnp.full((b,), pos, jnp.int32), cfg,
                                    uniform_pos=True, beam_anc=beam_anc)
         return out[:, 0], cache
+    if beam_anc is not None and pad_lens is not None:
+        raise ValueError("beam ancestry attention does not compose with "
+                         "pad_lens (beam search is uniform-prompt only)")
     if beam_anc is not None:
-        raise ValueError("beam ancestry attention is full-cache only "
-                         "(no window, no pad_lens)")
+        anc, w_beams = beam_anc
+        anc_oh = jax.nn.one_hot(anc, w_beams, dtype=jnp.float32)
     if "k_scale" in cache:
         raise ValueError("kv_int8 decode supports full-cache configs "
                          "only (no attention_window, no ragged "
@@ -221,9 +269,6 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
         groups = cfg.n_heads // cfg.kv_heads
         qg = q.astype(jnp.float32).reshape(
             b, cfg.kv_heads, groups, cfg.head_dim)
-        logits = jnp.einsum("bcgk,bsck->bcgs", qg,
-                            ck.astype(jnp.float32))
-        logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
         span = jnp.arange(cfg.max_len)
         if cfg.attention_window is not None:
             # Ring-buffer band: slot s holds global position
@@ -235,18 +280,32 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
             # rolling window.  Distances are pad-invariant, so the
             # ragged pad mask below composes unchanged.
             delta = jnp.mod(pos - span, cfg.max_len)
-            mask = ((delta < cfg.attention_window)
-                    & (pos - delta >= 0))[None, None, None, :]
+            row_mask = (delta < cfg.attention_window) & (pos - delta >= 0)
         else:
-            mask = (span <= pos)[None, None, None, :]
-        if pad_lens is not None:  # left-pad slots never enter attention
-            mask = mask & (span[None, :] >= pad_lens[:, None]
-                           )[:, None, None, :]
-        logits = jnp.where(mask, logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("bcgs,bsck->bcgk", probs,
-                          cv.astype(jnp.float32)).reshape(
-            b, cfg.n_heads, cfg.head_dim)
+            row_mask = span <= pos
+        if beam_anc is not None:
+            # Windowed beam ancestry: beam_search never decodes past
+            # max_len (no rolling_ok), so slots never wrap mid-search —
+            # the per-position ancestor map indexes slots directly and
+            # only the band mask differs from the full-cache path.
+            bt = b // w_beams
+            mask_b = jnp.broadcast_to(row_mask[None, None, :],
+                                      (bt, w_beams, cfg.max_len))
+            attn = _ancestry_attend(qg, ck, cv, anc_oh, mask_b, cfg,
+                                    w_beams)
+        else:
+            logits = jnp.einsum("bcgk,bsck->bcgs", qg,
+                                ck.astype(jnp.float32))
+            logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
+            mask = row_mask[None, None, None, :]
+            if pad_lens is not None:  # left-pad slots never attend
+                mask = mask & (span[None, :] >= pad_lens[:, None]
+                               )[:, None, None, :]
+            logits = jnp.where(mask, logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            attn = jnp.einsum("bcgs,bsck->bcgk", probs,
+                              cv.astype(jnp.float32)).reshape(
+                b, cfg.n_heads, cfg.head_dim)
         x = x + jnp.einsum("bhk,hkd->bd", attn.astype(dtype),
                            deq(lp["attn"]["wo"]))
 
@@ -421,34 +480,17 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
         qg = q.astype(jnp.float32).reshape(
             b, t_len, cfg.kv_heads, groups, cfg.head_dim)
         if beam_anc is not None:
-            # Ancestry attention: score every (query-lane w, source-lane
-            # v) pair — the cache is read once, W x the (tiny) decode
-            # attention FLOPs — then select each position's true
-            # ancestor lane with the one-hot.
+            # Ancestry attention (shared body: _ancestry_attend) — the
+            # cache is read once, W x the (tiny) decode attention
+            # FLOPs, and the one-hot selects each position's true
+            # ancestor lane.
             bt = b // w_beams
-            qb = qg[:, 0].reshape(bt, w_beams, cfg.kv_heads, groups,
-                                  cfg.head_dim)
-            kb = ck.astype(jnp.float32).reshape(
-                bt, w_beams, cfg.max_len, cfg.kv_heads, cfg.head_dim)
-            vb = cv.astype(jnp.float32).reshape(
-                bt, w_beams, cfg.max_len, cfg.kv_heads, cfg.head_dim)
-            la = jnp.einsum("bwcgk,bvsck->bwcgvs", qb, kb)
-            if kv_q:
-                # [bt, v, S, C] -> [bt, 1, C, 1, v, S] over la's dims.
-                bsc = lambda s: s.reshape(
-                    bt, w_beams, cfg.max_len, cfg.kv_heads).transpose(
-                    0, 3, 1, 2)[:, None, :, None, :, :]
-                la = la * bsc(cks)
-            logits = jnp.einsum("bwcgvs,bwsv->bwcgs", la, anc_oh)
-            logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
-            bmask = mask.reshape(bt, w_beams, 1, 1, cfg.max_len)
-            logits = jnp.where(bmask, logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1)
-            pm = jnp.einsum("bwcgs,bwsv->bwcgvs", probs, anc_oh)
-            if kv_q:
-                pm = pm * bsc(cvs)
-            attn = jnp.einsum("bwcgvs,bvsck->bwcgk", pm, vb).reshape(
-                b, t_len, cfg.n_heads, cfg.head_dim)
+            mask_b = mask[:, 0, 0, 0, :].reshape(bt, w_beams,
+                                                 cfg.max_len)
+            attn = _ancestry_attend(
+                qg[:, 0], ck, cv, anc_oh, mask_b, cfg, w_beams,
+                kv_scales=(cks, cvs) if kv_q else None,
+            )[:, None]  # restore T = 1
         else:
             logits = jnp.einsum("btcgk,bsck->btcgs", qg,
                                 ck.astype(jnp.float32))
@@ -920,15 +962,17 @@ def beam_search(params, prompt, cfg: TransformerConfig,
 
     ``beam_impl`` selects how beams read their divergent histories:
 
-    - ``"auto"`` (default): ancestry attention for full-cache configs —
-      unless its per-layer score intermediate (quadratic in beam
-      width; see :data:`ANCESTRY_SCORE_LIMIT_BYTES`) would exceed the
-      limit, in which case it falls back to the physical parent-gather
-      with a warning.  Windowed configs always take the physical path
-      (ring-buffer slots are reused; ancestry cannot represent them).
+    - ``"auto"`` (default): ancestry attention — unless its per-layer
+      score intermediate (quadratic in beam width; see
+      :data:`ANCESTRY_SCORE_LIMIT_BYTES`) would exceed the limit, in
+      which case it falls back to the physical parent-gather with a
+      warning.  Windowed (``attention_window``) configs take ancestry
+      too: beam search never decodes past ``max_len``, so ring-buffer
+      slots never wrap mid-search and the ancestor map indexes slots
+      directly — only the band mask differs (round-4; previously the
+      windowed path always paid the physical gather).
     - ``"ancestry"``: force ancestry attention; raises above the
-      intermediate-size limit or on windowed configs instead of
-      silently changing cost class.
+      intermediate-size limit instead of silently changing cost class.
     - ``"physical"``: force the parent-gather cache reorder (the
       pre-round-3 construction; exact same hypotheses, more HBM
       traffic per step at moderate beam widths).
@@ -960,7 +1004,7 @@ def beam_search(params, prompt, cfg: TransformerConfig,
             f"got {beam_impl!r}")
     if _force_physical:
         beam_impl = "physical"
-    use_anc = cfg.attention_window is None and beam_impl != "physical"
+    use_anc = beam_impl != "physical"
     if use_anc:
         est = _ancestry_score_bytes(b, w, cfg)
         if est > ANCESTRY_SCORE_LIMIT_BYTES:
@@ -979,11 +1023,6 @@ def beam_search(params, prompt, cfg: TransformerConfig,
                           "parent-gather (same hypotheses, more HBM "
                           "traffic per step)", stacklevel=2)
             use_anc = False
-    elif beam_impl == "ancestry":
-        raise ValueError(
-            "beam_impl='ancestry' requires a full cache: the windowed "
-            "ring buffer reuses slots, which the ancestry map cannot "
-            "represent (use beam_impl='auto' or 'physical')")
     total = _check_decode_budget(p, max_new_tokens, cfg, eos_token)
     prompt = jnp.asarray(prompt, jnp.int32)
     off = 0
@@ -1052,9 +1091,10 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     # that wrote position s of beam w's hypothesis (see _decode_chunk's
     # beam_anc).  The physical parent-gather it replaces rewrote the
     # whole [L, B*W, S, kv, hd] cache every step and cost more than the
-    # attention itself (docs/perf_serving.md finding 4).  The windowed
-    # ring-buffer path keeps the gather (its slot arithmetic reuses
-    # slots, which ancestry cannot represent).
+    # attention itself (docs/perf_serving.md finding 4).  Windowed
+    # configs use it too: with total <= max_len the ring never wraps,
+    # so the per-position ancestor map indexes slots directly
+    # (_ancestry_attend under the band mask).
     # (use_anc resolved with the other argument checks at the top —
     # beam_impl errors must fire before any prompt-pass device work.)
     anc0 = jnp.broadcast_to(
@@ -1083,7 +1123,7 @@ def beam_search(params, prompt, cfg: TransformerConfig,
         parent = (idx // v).astype(jnp.int32)      # [B, W]
         token = (idx % v).astype(jnp.int32)
         # Reorder beams by parent: buf rows, done flags — and either
-        # the ancestry map (cheap) or the cache rows (windowed path).
+        # the ancestry map (cheap) or the cache rows (physical impl).
         buf = jnp.take_along_axis(buf, parent[:, :, None], axis=1)
         buf = buf.at[:, :, q + 1].set(token)
         done = jnp.take_along_axis(done, parent, axis=1)

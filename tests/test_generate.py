@@ -814,9 +814,8 @@ def test_beam_impl_knob_and_ancestry_size_guard(rng, monkeypatch):
     """The public beam_impl knob: 'physical' matches 'ancestry' (both
     explicit), 'auto' falls back with a warning when the ancestry score
     intermediate would exceed the limit, explicit 'ancestry' raises at
-    that size (and on windowed configs), and bad values are rejected."""
-    import dataclasses
-
+    that size, and bad values are rejected.  (Windowed configs take
+    ancestry too — test_beam_windowed_ancestry_equals_physical.)"""
     from distkeras_tpu.models import generate as gen
     from distkeras_tpu.models.generate import beam_search
 
@@ -842,13 +841,35 @@ def test_beam_impl_knob_and_ancestry_size_guard(rng, monkeypatch):
                         beam_impl="ancestry")
     monkeypatch.undo()
 
-    win_cfg = dataclasses.replace(CFG, attention_window=4)
-    with pytest.raises(ValueError, match="full cache"):
-        beam_search(params, prompt, win_cfg, 5, beam_width=3,
-                    beam_impl="ancestry")
     with pytest.raises(ValueError, match="beam_impl must be"):
         beam_search(params, prompt, CFG, 5, beam_width=3,
                     beam_impl="fast")
+
+
+def test_beam_windowed_ancestry_equals_physical(rng):
+    """Windowed (ring-buffer) beam search on the ancestry path matches
+    the physical parent-gather exactly — beam search never decodes past
+    max_len, so slots never wrap and the ancestor map indexes them
+    directly; only the band mask differs from the full-cache path
+    (round-4 extension; windowed beam previously always paid the
+    per-step cache gather).  Covers rope + GQA + eos under a window
+    shorter than the sequence."""
+    import dataclasses
+
+    from distkeras_tpu.models.generate import beam_search
+
+    cfg = dataclasses.replace(CFG, n_heads=4, n_kv_heads=2, rope=True,
+                              attention_window=6)
+    params = tfm.init_params(jax.random.key(7), cfg)
+    prompt = jnp.asarray(rng.integers(0, 64, (3, 5)), jnp.int32)
+    for kw in [dict(), dict(eos_token=7), dict(length_penalty=0.6)]:
+        sa, sca = beam_search(params, prompt, cfg, 10, beam_width=3,
+                              beam_impl="ancestry", **kw)
+        sp, scp = beam_search(params, prompt, cfg, 10, beam_width=3,
+                              beam_impl="physical", **kw)
+        np.testing.assert_array_equal(np.asarray(sa), np.asarray(sp))
+        np.testing.assert_allclose(np.asarray(sca), np.asarray(scp),
+                                   atol=1e-5, rtol=1e-5)
 
 
 def test_top_k_mask_approx_path():
