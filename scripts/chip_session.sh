@@ -1,0 +1,43 @@
+#!/bin/bash
+# One green-tunnel measurement session, in priority order (round-4
+# plan — see docs/round4_notes.md).  Run from the repo root the moment
+# the axon tunnel is up; every stage appends JSON lines to
+# chip_session_r4.log so a mid-session tunnel drop loses nothing.
+# Stage order front-loads the round's unmeasured headliners.
+set -u
+cd "$(dirname "$0")/.."
+LOG=chip_session_r4.log
+say() { echo "### $(date -u +%H:%M:%S) $*" | tee -a "$LOG"; }
+
+say "stage 0: probe + headline (writes BENCH_LAST_GREEN.json)"
+python bench.py 2>>"$LOG" | tee -a "$LOG" || exit 1
+
+say "stage 1: staged round-3 serving configs (TTFT + engine)"
+python scripts/bench_serving.py prefix_cache_ttft engine_throughput \
+    2>>"$LOG" | tee -a "$LOG"
+
+say "stage 2: MoE + LoRA serving"
+python scripts/bench_serving.py decode_moe_b8 decode_moe_b64 \
+    decode_moe_top2_b8 lora_merged_serve 2>>"$LOG" | tee -a "$LOG"
+
+say "stage 3: MoE + LoRA training (with the dense baseline row)"
+python scripts/bench_suite.py transformer_d1024 transformer_moe_top1 \
+    transformer_moe_top2 lora_finetune 2>>"$LOG" | tee -a "$LOG"
+
+say "stage 4: engine under load (TTFT/TPOT p50/p99 grid)"
+python scripts/bench_serving.py engine_load_8l_low engine_load_8l_mid \
+    engine_load_8l_high engine_load_4l_mid engine_load_16l_mid \
+    2>>"$LOG" | tee -a "$LOG"
+
+say "stage 5: flagship MFU ablation"
+python scripts/ablate_flagship.py 2>>"$LOG" | tee -a "$LOG"
+
+say "stage 6: variance protocol (headline set, n=5)"
+python scripts/variance.py -n 5 2>>"$LOG" | tee -a "$LOG"
+
+say "stage 7: windowed beam (ancestry vs physical on chip)"
+python scripts/bench_serving.py beam4 beam4_windowed \
+    beam4_windowed_physical decode_rolling_window \
+    2>>"$LOG" | tee -a "$LOG"
+
+say "session complete — transcribe $LOG into BASELINE.md + perf docs"
