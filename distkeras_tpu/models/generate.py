@@ -153,11 +153,12 @@ def _ancestry_attend(qg, ck, cv, anc_oh, mask_b, cfg: TransformerConfig,
     ``qg [B, kv_heads, groups, hd]`` f32 queries (beam lanes tiled
     batch-major, B = bt * W), ``ck/cv [B, S, kv_heads, hd]`` the
     per-lane cache, ``anc_oh [bt, W, S, W]`` f32 one-hot ancestor map
-    (position s of lane w reads from lane ``anc[b, w, s]``), ``mask_b
-    [bt, W, S]`` bool valid-position mask (position mask full-cache,
-    band mask windowed — the ONLY difference between the two callers:
-    beam_search never decodes past max_len, so ring slots never wrap
-    mid-search and slot == position throughout).  Scores every
+    (SLOT s of lane w reads from lane ``anc[b, w, s]`` — slot ==
+    position while total <= max_len, and under rolling decode the
+    beam body retires a reused slot's ancestry in the same step that
+    overwrites its K/V), ``mask_b [bt, W, S]`` bool valid-slot mask
+    (position mask full-cache, band mask windowed — the only
+    difference between the two callers).  Scores every
     (query-lane, source-lane) pair — the cache is read once, W x the
     tiny decode attention FLOPs — then the one-hot selects each
     position's true ancestor.  ``kv_scales=(cks, cvs) [B, S, kv]``:
@@ -284,10 +285,11 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
         else:
             row_mask = span <= pos
         if beam_anc is not None:
-            # Windowed beam ancestry: beam_search never decodes past
-            # max_len (no rolling_ok), so slots never wrap mid-search —
-            # the per-position ancestor map indexes slots directly and
-            # only the band mask differs from the full-cache path.
+            # Windowed beam ancestry: the ancestor map is SLOT-indexed
+            # (identical to positions until the ring wraps; under
+            # rolling decode the beam body retires stale entries as
+            # slots are rewritten) and only the band mask differs from
+            # the full-cache path.
             bt = b // w_beams
             mask_b = jnp.broadcast_to(row_mask[None, None, :],
                                       (bt, w_beams, cfg.max_len))
@@ -642,7 +644,8 @@ def _check_decode_budget(p: int, max_new_tokens: int,
                 "" if cfg.attention_window is None or not cfg.rope else
                 " (rolling decode past max_len needs rope=True, an "
                 "attention_window <= max_len, and a uniform-length "
-                "generate() call)"))
+                "generate() or beam_search() call without "
+                "prompt_cache)"))
     _check_eos(eos_token, cfg)
     return total
 
@@ -967,10 +970,12 @@ def beam_search(params, prompt, cfg: TransformerConfig,
       :data:`ANCESTRY_SCORE_LIMIT_BYTES`) would exceed the limit, in
       which case it falls back to the physical parent-gather with a
       warning.  Windowed (``attention_window``) configs take ancestry
-      too: beam search never decodes past ``max_len``, so ring-buffer
-      slots never wrap mid-search and the ancestor map indexes slots
-      directly — only the band mask differs (round-4; previously the
-      windowed path always paid the physical gather).
+      too — the ancestor map indexes ring SLOTS, so it stays exact
+      both within ``max_len`` and on ROLLING decodes past it (rope +
+      window configs, same eligibility as ``generate``; a reused
+      slot's ancestry is retired in the step that overwrites its K/V).
+      Round-4: previously the windowed path always paid the physical
+      gather and rolling beam decode did not exist.
     - ``"ancestry"``: force ancestry attention; raises above the
       intermediate-size limit instead of silently changing cost class.
     - ``"physical"``: force the parent-gather cache reorder (the
@@ -1023,7 +1028,12 @@ def beam_search(params, prompt, cfg: TransformerConfig,
                           "parent-gather (same hypotheses, more HBM "
                           "traffic per step)", stacklevel=2)
             use_anc = False
-    total = _check_decode_budget(p, max_new_tokens, cfg, eos_token)
+    # Rolling decode past max_len mirrors generate()'s eligibility
+    # (rope + window <= max_len ring; checked inside the budget):
+    # slots wrap, and the slot-indexed ancestry update below stays
+    # exact (prompt_cache is full-cache-only, hence the guard).
+    total = _check_decode_budget(p, max_new_tokens, cfg, eos_token,
+                                 rolling_ok=prompt_cache is None)
     prompt = jnp.asarray(prompt, jnp.int32)
     off = 0
     if prompt_cache is not None:
@@ -1092,9 +1102,10 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     # beam_anc).  The physical parent-gather it replaces rewrote the
     # whole [L, B*W, S, kv, hd] cache every step and cost more than the
     # attention itself (docs/perf_serving.md finding 4).  Windowed
-    # configs use it too: with total <= max_len the ring never wraps,
-    # so the per-position ancestor map indexes slots directly
-    # (_ancestry_attend under the band mask).
+    # configs use it too, rolling decodes included: the ancestor map
+    # is SLOT-indexed — identical to positions until the ring wraps,
+    # and the scan body retires a reused slot's entry in the same step
+    # that overwrites its K/V (_ancestry_attend under the band mask).
     # (use_anc resolved with the other argument checks at the top —
     # beam_impl errors must fire before any prompt-pass device work.)
     anc0 = jnp.broadcast_to(
@@ -1134,9 +1145,15 @@ def beam_search(params, prompt, cfg: TransformerConfig,
         if use_anc:
             # Kept beam w inherits parent's ancestry for s <= q (the
             # parent's lane wrote position q this step); next step's
-            # write position is its own lane.
+            # write SLOT is its own lane.  Slot-indexed (pos % C): the
+            # identity while total <= max_len, and under ROLLING decode
+            # it retires the reused slot's stale ancestry in the same
+            # step that overwrites its K/V — the attention for step q
+            # runs before this update, so no read ever sees the reset
+            # early, and the band mask never reaches the evicted
+            # position afterwards.
             anc = jnp.take_along_axis(anc, parent[:, :, None], axis=1)
-            anc = anc.at[:, :, q + 1].set(
+            anc = anc.at[:, :, (q + 1) % cfg.max_len].set(
                 jnp.arange(w, dtype=jnp.int32)[None, :])
         else:
             flat_parent = (parent

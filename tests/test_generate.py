@@ -681,6 +681,37 @@ def test_rolling_decode_sampling_and_eos(rng):
     np.testing.assert_array_equal(np.asarray(rolled), np.asarray(big))
 
 
+def test_rolling_beam_matches_large_cache(rng):
+    """Beam search past max_len on the ring-buffer cache (round-4)
+    reproduces a non-wrapping run of the same windowed model with a
+    big cache — on BOTH the ancestry path (slot-indexed ancestor map;
+    stale entries retired as slots are rewritten) and the physical
+    parent-gather, with eos and GQA in the mix."""
+    import dataclasses
+
+    from distkeras_tpu.models.generate import beam_search
+
+    base = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                 n_kv_heads=2, n_layers=2, d_ff=64,
+                                 rope=True, attention_window=6,
+                                 max_len=64)
+    small = dataclasses.replace(base, max_len=16)  # will wrap
+    params = tfm.init_params(jax.random.key(2), base)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 5)), jnp.int32)
+    n_new = 30  # 5 + 30 = 35 > 16: several full wraps
+    for kw in [dict(), dict(eos_token=7),
+               dict(beam_impl="physical")]:
+        big_s, big_sc = beam_search(params, prompt, base, n_new,
+                                    beam_width=3, **kw)
+        roll_s, roll_sc = beam_search(params, prompt, small, n_new,
+                                      beam_width=3, **kw)
+        np.testing.assert_array_equal(np.asarray(roll_s),
+                                      np.asarray(big_s), err_msg=str(kw))
+        np.testing.assert_allclose(np.asarray(roll_sc),
+                                   np.asarray(big_sc),
+                                   atol=1e-5, rtol=1e-5)
+
+
 def test_rolling_decode_requires_rope_and_window(rng):
     """Past-max_len decoding without the rolling prerequisites must
     still raise, including for ragged prompts."""
