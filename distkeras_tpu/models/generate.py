@@ -390,13 +390,25 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
     global position — in-chunk causality included — and the chunk's
     K/V land in the cache at per-row offsets, so rows at different
     positions (speculative decoding's per-row accept divergence) share
-    one compiled program.  Full-cache configs only: the sliding-window
-    ring buffer's slot arithmetic is per-scalar-position
-    (_decode_step); speculative decoding rejects windowed configs.
+    one compiled program.
+
+    Windowed (``attention_window``) configs are supported in the two
+    shapes the serving engine needs (round-4; everything else routes
+    through _decode_step's per-scalar-position body): (a) the per-row
+    path with T == 1 — each row writes its ring slot ``pos0[b] %
+    max_len`` and attends under the per-row band mask, which is the
+    rolling-decode arithmetic vectorized over rows at DIFFERENT
+    positions; (b) the uniform_pos chunk path under the caller
+    contract that the chunk does not wrap (``pos0[0] % max_len +
+    T <= max_len`` — admission prefills satisfy it by bucket
+    construction; unverifiable here because pos0 is traced).  Windowed
+    x kv_int8 stays rejected (parity with _decode_step).
 
     Stale cache slots beyond a row's final position are harmless by
-    construction: the position mask excludes them, and every slot is
-    rewritten before the row's position passes it.
+    construction: the position mask excludes them (for ring caches the
+    band-mask's implied-position formula sends slots the row has not
+    reached to negative positions), and every slot is rewritten before
+    the row's position passes it.
 
     ``uniform_pos`` (static): promise that every row of ``pos0`` holds
     the same value, so the cache write is one slab update instead of a
@@ -431,14 +443,37 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
         x = x + params["pos_emb"][pos_ids].astype(dtype)
 
     kv_q = "k_scale" in cache                   # int8 KV cache
+    win = cfg.attention_window is not None
+    if win:
+        if kv_q:
+            raise ValueError("kv_int8 decode supports full-cache "
+                             "configs only (no attention_window)")
+        if not uniform_pos and t_len != 1:
+            raise ValueError(
+                "windowed per-row chunks support T == 1 only (a ring "
+                "chunk at divergent row positions could wrap "
+                "mid-chunk); multi-token windowed chunks need "
+                "uniform positions")
     ck_all, cv_all = cache["k"], cache["v"]     # [L, B, S, kv, hd]
     if kv_q:
         cks_all, cvs_all = cache["k_scale"], cache["v_scale"]
         new_ks, new_vs = [], []
     new_k, new_v = [], []                       # per-row path accumulates
     span = jnp.arange(cfg.max_len)
-    mask = (span[None, None, :] <= pos_ids[:, :, None]
-            )[:, :, None, None, :]                # [B, T, 1, 1, S]
+    if win:
+        # Ring band mask, per row (see _decode_step's windowed body for
+        # the slot->implied-position derivation; here pos differs per
+        # row/chunk position).
+        delta = jnp.mod(pos_ids[:, :, None] - span[None, None, :],
+                        cfg.max_len)
+        mask = ((delta < cfg.attention_window)
+                & (pos_ids[:, :, None] - delta >= 0)
+                )[:, :, None, None, :]            # [B, T, 1, 1, S]
+        wr_pos = pos0 % cfg.max_len               # ring write slots
+    else:
+        mask = (span[None, None, :] <= pos_ids[:, :, None]
+                )[:, :, None, None, :]            # [B, T, 1, 1, S]
+        wr_pos = pos0
     # [B, S, C] scale -> broadcast over the [B, T, C, G, S] logits.
     sc_b = lambda s: s.transpose(0, 2, 1)[:, None, :, None, :]
     if beam_anc is not None:
@@ -460,21 +495,21 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
             k, k_s = quantize_kv(k)
             v, v_s = quantize_kv(v)
         if uniform_pos:
-            ck_all = _layer_slab_update(ck_all, i, k, pos0[0])
-            cv_all = _layer_slab_update(cv_all, i, v, pos0[0])
+            ck_all = _layer_slab_update(ck_all, i, k, wr_pos[0])
+            cv_all = _layer_slab_update(cv_all, i, v, wr_pos[0])
             ck, cv = ck_all[i], cv_all[i]
             if kv_q:
-                cks_all = _layer_slab_update(cks_all, i, k_s, pos0[0])
-                cvs_all = _layer_slab_update(cvs_all, i, v_s, pos0[0])
+                cks_all = _layer_slab_update(cks_all, i, k_s, wr_pos[0])
+                cvs_all = _layer_slab_update(cvs_all, i, v_s, wr_pos[0])
                 cks, cvs = cks_all[i], cvs_all[i]
         else:
-            ck = _rows_update(ck_all[i], k, pos0)
-            cv = _rows_update(cv_all[i], v, pos0)
+            ck = _rows_update(ck_all[i], k, wr_pos)
+            cv = _rows_update(cv_all[i], v, wr_pos)
             new_k.append(ck)
             new_v.append(cv)
             if kv_q:
-                cks = _rows_update(cks_all[i], k_s, pos0)
-                cvs = _rows_update(cvs_all[i], v_s, pos0)
+                cks = _rows_update(cks_all[i], k_s, wr_pos)
+                cvs = _rows_update(cvs_all[i], v_s, wr_pos)
                 new_ks.append(cks)
                 new_vs.append(cvs)
 
@@ -619,6 +654,17 @@ def _device_tree(params):
     return jax.tree.map(jnp.asarray, params)
 
 
+def rolling_eligible(cfg: TransformerConfig) -> bool:
+    """Can this config decode past ``max_len`` on the ring-buffer
+    cache?  Rope (positions beyond max_len have no learned-table
+    embedding) + a window that fits the ring.  The ONE definition —
+    generate/beam_search budgets and the serving engine's rolling-lane
+    gate must never drift (the engine's contract is exact parity with
+    solo runs)."""
+    return (cfg.rope and cfg.attention_window is not None
+            and cfg.attention_window <= cfg.max_len)
+
+
 def _check_decode_budget(p: int, max_new_tokens: int,
                          cfg: TransformerConfig,
                          eos_token: int | None,
@@ -635,8 +681,7 @@ def _check_decode_budget(p: int, max_new_tokens: int,
             "prompt must contain at least one token (decoding starts from "
             "its last position; pass a BOS token for unconditional samples)")
     total = p + max_new_tokens
-    rolling = (rolling_ok and cfg.rope and cfg.attention_window is not None
-               and cfg.attention_window <= cfg.max_len)
+    rolling = rolling_ok and rolling_eligible(cfg)
     if total > cfg.max_len and not rolling:
         raise ValueError(
             f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "

@@ -54,6 +54,7 @@ from distkeras_tpu.models.generate import (
     _resolve_prompt_cache,
     init_cache,
     min_p_mask,
+    rolling_eligible,
     top_k_mask,
     top_p_mask,
 )
@@ -82,18 +83,36 @@ class ContinuousBatcher:
     admission pad widths (a prompt of length P uses the smallest
     bucket >= P - 1; one admission program compiles per bucket).
 
-    Full-cache configs only (no attention_window, no quantized-tree
-    restriction — int8 weights decode on the same chunk path).
+    Full-cache configs, or rope + ``attention_window`` configs — the
+    latter run ROLLING lanes: every lane decodes past ``max_len`` on
+    the ring-buffer cache with no total-length cap (prompts still must
+    fit the ring), each request matching its solo rolling
+    ``generate()`` run exactly.  No quantized-tree restriction — int8
+    weights decode on the same chunk path.
     """
 
     def __init__(self, params, cfg: TransformerConfig, lanes: int = 8,
                  temperature: float = 0.0, top_k=None, top_p=None,
                  min_p=None, eos_token=None, exact_top_k: bool = False,
                  prompt_buckets=(8, 32, 128, 512), prompt_cache=None):
+        # Windowed configs: the engine runs ROLLING lanes — each lane
+        # decodes past max_len on the ring-buffer cache (the unbounded
+        # streaming-chat shape), which needs rope (positions beyond
+        # max_len have no learned-table embedding) and a window that
+        # fits the ring.  Non-rope windowed configs have no rolling
+        # semantics, so they stay rejected rather than silently
+        # becoming bounded.
+        self._rolling = False
         if cfg.attention_window is not None:
-            raise ValueError(
-                "continuous batching supports full-cache configs only "
-                "(no attention_window)")
+            if not rolling_eligible(cfg):
+                raise ValueError(
+                    "windowed continuous batching runs rolling lanes, "
+                    "which needs rope=True and attention_window <= "
+                    "max_len (full-cache configs need no window)")
+            if prompt_cache is not None:
+                raise ValueError("prompt_cache requires a full-cache "
+                                 "config (no attention_window)")
+            self._rolling = True
         if lanes < 1:
             raise ValueError(f"lanes must be >= 1, got {lanes}")
         if prompt_cache is not None and prompt_cache[1] >= cfg.max_len:
@@ -166,17 +185,22 @@ class ContinuousBatcher:
                 nxt = jax.vmap(pick)(keys, scaled, pos)
             else:
                 nxt = logits.argmax(axis=-1)
-            # Device-side invariant: pos NEVER exceeds max_len - 1.
-            # Free/done lanes keep decoding (the price of one static
-            # program) and would otherwise advance unboundedly; the
-            # clamp pins them to re-processing the last slot — their
-            # outputs are discarded and admission reseeds the lane, so
-            # correctness no longer leans on dynamic_update_slice's
-            # start-clamping (advisor round-3: make the invariant
-            # explicit, not incidental).  Live lanes are unaffected:
-            # submit() budgets guarantee they finish at pos <= max_len-1.
-            return (cache, nxt.astype(jnp.int32),
-                    jnp.minimum(pos + 1, cfg.max_len - 1))
+            # Device-side invariant (full-cache engines): pos NEVER
+            # exceeds max_len - 1.  Free/done lanes keep decoding (the
+            # price of one static program) and would otherwise advance
+            # unboundedly; the clamp pins them to re-processing the
+            # last slot — their outputs are discarded and admission
+            # reseeds the lane, so correctness no longer leans on
+            # dynamic_update_slice's start-clamping.  Live lanes are
+            # unaffected: submit() budgets guarantee they finish at
+            # pos <= max_len - 1.  ROLLING (windowed) engines are the
+            # exception by design: pos is unbounded (the ring slot is
+            # pos % max_len), for idle lanes too — harmless, since
+            # their writes land in slots admission reseeds and the
+            # all-idle early-out in step() stops the clock entirely.
+            nxt_pos = (pos + 1 if self._rolling
+                       else jnp.minimum(pos + 1, cfg.max_len - 1))
+            return cache, nxt.astype(jnp.int32), nxt_pos
 
         def make_step(n):
             def step_n(cache, cur, pos, keys):
@@ -250,7 +274,12 @@ class ContinuousBatcher:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        if self._off + p + max_new_tokens > self.cfg.max_len:
+        if (not self._rolling
+                and self._off + p + max_new_tokens > self.cfg.max_len):
+            # Rolling engines have no total-length cap: lanes decode
+            # past max_len on the ring (the admission bucket check
+            # below still caps the PROMPT at the ring size — a longer
+            # prompt's chunk would wrap mid-write).
             raise ValueError(
                 f"prefix ({self._off}) + prompt ({p}) + "
                 f"max_new_tokens ({max_new_tokens}) exceeds "

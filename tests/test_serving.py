@@ -229,3 +229,65 @@ def test_lane_pos_clamped_and_idle_engine_skips_device(params, rng):
     assert lc == 1
     np.testing.assert_array_equal(run_to_done(eng, lc),
                                   solo(params, pc, 6))
+
+
+ROLL_CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, max_len=12,
+                                 rope=True, attention_window=5)
+
+
+def test_rolling_engine_matches_rolling_generate(params, rng):
+    """Windowed (rope + attention_window) engines run ROLLING lanes:
+    each request decodes past max_len on the ring cache and must match
+    its solo rolling generate() run exactly — staggered admission,
+    lane reuse, and lanes mid-wrap while a fresh lane is admitted."""
+    rparams = tfm.init_params(jax.random.key(3), ROLL_CFG)
+
+    def rsolo(prompt, n, **kw):
+        return np.asarray(generate(rparams, np.asarray(prompt)[None],
+                                   ROLL_CFG, n, **kw))[0]
+
+    eng = ContinuousBatcher(rparams, ROLL_CFG, lanes=2)
+    pa = rng.integers(0, 64, (4,)).astype(np.int32)
+    pb = rng.integers(0, 64, (6,)).astype(np.int32)
+    pc = rng.integers(0, 64, (3,)).astype(np.int32)
+    la = eng.submit(pa, 30)              # 4 + 30 = 34 >> 12: wraps
+    for _ in range(10):                  # A rolls past the ring alone
+        eng.step()
+    lb = eng.submit(pb, 20)              # admitted mid-wrap of A
+    out_a = run_to_done(eng, la)
+    out_b = run_to_done(eng, lb)
+    lc = eng.submit(pc, 25)              # reuses a freed, wrapped lane
+    out_c = run_to_done(eng, lc)
+    np.testing.assert_array_equal(out_a, rsolo(pa, 30))
+    np.testing.assert_array_equal(out_b, rsolo(pb, 20))
+    np.testing.assert_array_equal(out_c, rsolo(pc, 25))
+    assert lc in (la, lb)
+
+
+def test_rolling_engine_sampled_and_validation(params, rng):
+    """Sampled rolling lanes match solo rolling generate with the same
+    per-request key; windowed engines without rope are rejected, and
+    rolling submit has no total-length cap while the prompt still must
+    fit the admission buckets."""
+    import dataclasses
+
+    rparams = tfm.init_params(jax.random.key(4), ROLL_CFG)
+    eng = ContinuousBatcher(rparams, ROLL_CFG, lanes=2,
+                            temperature=0.8, top_k=8)
+    p = rng.integers(0, 64, (4,)).astype(np.int32)
+    k = jax.random.key(21)
+    lane = eng.submit(p, 24, key=k)      # 4 + 24 = 28 > 12
+    out = run_to_done(eng, lane)
+    ref = np.asarray(generate(rparams, p[None], ROLL_CFG, 24,
+                              temperature=0.8, top_k=8, key=k))[0]
+    np.testing.assert_array_equal(out, ref)
+
+    norope = dataclasses.replace(ROLL_CFG, rope=False)
+    with pytest.raises(ValueError, match="rolling lanes"):
+        ContinuousBatcher(tfm.init_params(jax.random.key(0), norope),
+                          norope, lanes=1)
+    # Prompt must fit the ring (admission chunk cannot wrap).
+    with pytest.raises(ValueError, match="admission"):
+        eng.submit(rng.integers(0, 64, (20,)).astype(np.int32), 4,
+                   key=jax.random.key(1))
