@@ -88,13 +88,16 @@ class ContinuousBatcher:
     the ring-buffer cache with no total-length cap (prompts still must
     fit the ring), each request matching its solo rolling
     ``generate()`` run exactly.  No quantized-tree restriction — int8
-    weights decode on the same chunk path.
+    weights decode on the same chunk path — and full-cache engines
+    take ``kv_int8=True`` (int8 KV cache; parity vs
+    ``generate(kv_int8=True, use_prefill=False)``).
     """
 
     def __init__(self, params, cfg: TransformerConfig, lanes: int = 8,
                  temperature: float = 0.0, top_k=None, top_p=None,
                  min_p=None, eos_token=None, exact_top_k: bool = False,
-                 prompt_buckets=(8, 32, 128, 512), prompt_cache=None):
+                 prompt_buckets=(8, 32, 128, 512), prompt_cache=None,
+                 kv_int8: bool = False):
         # Windowed configs: the engine runs ROLLING lanes — each lane
         # decodes past max_len on the ring-buffer cache (the unbounded
         # streaming-chat shape), which needs rope (positions beyond
@@ -112,6 +115,9 @@ class ContinuousBatcher:
             if prompt_cache is not None:
                 raise ValueError("prompt_cache requires a full-cache "
                                  "config (no attention_window)")
+            if kv_int8:
+                raise ValueError("kv_int8 decode supports full-cache "
+                                 "configs only (no attention_window)")
             self._rolling = True
         if lanes < 1:
             raise ValueError(f"lanes must be >= 1, got {lanes}")
@@ -138,13 +144,13 @@ class ContinuousBatcher:
         self._prefix_lane = None
         if prompt_cache is not None:
             # The ONE prompt_cache contract (generate's helper): batch
-            # must be 1 here (b=1), the prefix must be full-precision
-            # (the engine cache is too — kv_int8=False), and the
-            # loosest budget (p=1, one new token) must fit; per-request
-            # budgets are re-checked at submit.
+            # must be 1 here (b=1), the prefix quantization must match
+            # the engine cache (build it with prefill(kv_int8=...)),
+            # and the loosest budget (p=1, one new token) must fit;
+            # per-request budgets are re-checked at submit.
             pc, self._off = _resolve_prompt_cache(
                 prompt_cache, cfg, b=1, p=1, max_new_tokens=1,
-                kv_int8=False, use_prefill=None)
+                kv_int8=kv_int8, use_prefill=None)
             self._prefix_lane = jax.tree.map(jnp.asarray, pc)
         self.eos_token = eos_token
         self.temperature = temperature
@@ -159,7 +165,16 @@ class ContinuousBatcher:
 
         # Device state: one cache, per-lane next-position, per-lane
         # current token (the one the next step processes), per-lane key.
-        self.cache = init_cache(cfg, lanes)
+        # ``kv_int8``: the cache stores int8 K/V + f32 scales — halves
+        # the dominant HBM term at batch where cache bytes rule
+        # (+33% measured at b64, a LOSS at b8; see perf_serving.md) —
+        # and every request still matches its solo
+        # ``generate(kv_int8=True, use_prefill=False)`` run exactly:
+        # both the admission chunk and the sequential path attend the
+        # ALREADY-QUANTIZED cache position by position, unlike
+        # prefill() which attends the prompt in full precision.
+        self.kv_int8 = kv_int8
+        self.cache = init_cache(cfg, lanes, kv_int8=kv_int8)
         self.pos = jnp.zeros((lanes,), jnp.int32)
         self.cur = jnp.zeros((lanes,), jnp.int32)
         self.keys = jnp.stack(

@@ -453,12 +453,13 @@ def bench_prefix_ttft():
     return run
 
 
-def bench_engine():
+def bench_engine(kv_int8=False):
     # Continuous-batching engine overhead vs raw generate: 8 full lanes
     # decoding 256 tokens in step(8) windows (one host round-trip per 8
     # tokens/lane).  The value is engine tokens/s; ``raw_tok_s`` in the
     # extras is the same workload through plain generate for the
-    # overhead ratio.
+    # overhead ratio.  ``kv_int8``: int8 KV cache on both sides (the
+    # engine regime where cache bytes dominate).
     def run():
         import jax
         import numpy as np
@@ -471,14 +472,16 @@ def bench_engine():
         prompts = rng.integers(0, cfg.vocab_size, (8, 64)).astype(np.int32)
         new = 256
 
-        g = jax.jit(lambda pp, pr: generate(pp, pr, cfg, new))
+        g = jax.jit(lambda pp, pr: generate(pp, pr, cfg, new,
+                                            kv_int8=kv_int8))
         int(np.asarray(g(params, prompts))[0, -1])
         t0 = time.perf_counter()
         out = g(params, prompts)
         int(np.asarray(out)[0, -1])
         raw = 8 * new / (time.perf_counter() - t0)
 
-        eng = ContinuousBatcher(params, cfg, lanes=8)
+        eng = ContinuousBatcher(params, cfg, lanes=8,
+                                kv_int8=kv_int8)
         lanes = [eng.submit(prompts[i], new) for i in range(8)]
         while eng.running():     # warm compile of admit + step(8)
             eng.step(8)
@@ -495,7 +498,8 @@ def bench_engine():
         return tok_s, dt / new, 0.0, {
             "raw_tok_s": round(raw, 1),
             "engine_overhead": round(raw / tok_s, 3),
-            "lanes": 8, "step_window": 8, "new_tokens": new}
+            "lanes": 8, "step_window": 8, "new_tokens": new,
+            **({"kv_int8": True} if kv_int8 else {})}
     return run
 
 
@@ -590,6 +594,8 @@ BENCHES = {
     "decode_int8_b64": (bench_int8(64), "tokens/sec/chip"),
     "prefix_cache_ttft": (bench_prefix_ttft(), "x speedup"),
     "engine_throughput": (bench_engine(), "tokens/sec/chip"),
+    "engine_throughput_kvint8": (bench_engine(kv_int8=True),
+                                 "tokens/sec/chip"),
     "decode_kv_int8_b8": (bench_kv_int8(8), "tokens/sec/chip"),
     "decode_kv_int8_b64": (bench_kv_int8(64), "tokens/sec/chip"),
     "decode_gqa4_b64": (bench_gqa4(64), "tokens/sec/chip"),

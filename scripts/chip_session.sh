@@ -14,6 +14,7 @@ python bench.py 2>>"$LOG" | tee -a "$LOG" || exit 1
 
 say "stage 1: staged round-3 serving configs (TTFT + engine)"
 python scripts/bench_serving.py prefix_cache_ttft engine_throughput \
+    engine_throughput_kvint8 \
     2>>"$LOG" | tee -a "$LOG"
 
 say "stage 2: MoE + LoRA serving"

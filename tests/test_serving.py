@@ -291,3 +291,68 @@ def test_rolling_engine_sampled_and_validation(params, rng):
     with pytest.raises(ValueError, match="admission"):
         eng.submit(rng.integers(0, 64, (20,)).astype(np.int32), 4,
                    key=jax.random.key(1))
+
+
+def test_kv_int8_engine_matches_sequential_generate(params, rng):
+    """kv_int8 engines: every request matches its solo
+    generate(kv_int8=True, use_prefill=False) run EXACTLY — both paths
+    attend the already-quantized cache position by position (the
+    admission chunk writes quantized K/V and its in-chunk attention
+    reads them, same as the sequential step loop; prefill() would
+    differ by quantization noise).  Staggered admission + lane reuse +
+    a sampled request, plus the windowed/prefix validation edges."""
+    eng = ContinuousBatcher(params, CFG, lanes=2, kv_int8=True)
+    pa = rng.integers(0, 64, (6,)).astype(np.int32)
+    pb = rng.integers(0, 64, (3,)).astype(np.int32)
+    la = eng.submit(pa, 8)
+    for _ in range(3):
+        eng.step()
+    lb = eng.submit(pb, 6)                  # admitted mid-flight
+    out_a = run_to_done(eng, la)
+    out_b = run_to_done(eng, lb)
+    lc = eng.submit(pb, 4)                  # reused (quantized) lane
+    out_c = run_to_done(eng, lc)
+    for out, p, n in [(out_a, pa, 8), (out_b, pb, 6), (out_c, pb, 4)]:
+        np.testing.assert_array_equal(
+            out, solo(params, p, n, kv_int8=True, use_prefill=False))
+
+    seng = ContinuousBatcher(params, CFG, lanes=1, kv_int8=True,
+                             temperature=0.8, top_k=8)
+    k = jax.random.key(31)
+    lane = seng.submit(pa, 6, key=k)
+    np.testing.assert_array_equal(
+        run_to_done(seng, lane),
+        solo(params, pa, 6, kv_int8=True, use_prefill=False,
+             temperature=0.8, top_k=8, key=k))
+
+    with pytest.raises(ValueError, match="full-cache"):
+        ContinuousBatcher(tfm.init_params(jax.random.key(3), ROLL_CFG),
+                          ROLL_CFG, lanes=1, kv_int8=True)
+    # Prefix quantization must match the engine cache.
+    from distkeras_tpu.models.generate import prefill
+
+    fp_cache, _ = prefill(params, pa[None], CFG, last_logits=False)
+    with pytest.raises(ValueError, match="quantization must match"):
+        ContinuousBatcher(params, CFG, lanes=1, kv_int8=True,
+                          prompt_cache=(fp_cache, 6))
+
+
+def test_kv_int8_engine_shared_prefix(params, rng):
+    """A kv_int8 engine over a kv_int8-prefilled shared prefix matches
+    generate(prompt_cache=..., kv_int8=True) per request, including
+    the lane-reuse reseed."""
+    from distkeras_tpu.models.generate import prefill
+
+    prefix = rng.integers(0, 64, (6,)).astype(np.int32)
+    cache, _ = prefill(params, prefix[None], CFG, last_logits=False,
+                       kv_int8=True)
+    eng = ContinuousBatcher(params, CFG, lanes=1, kv_int8=True,
+                            prompt_cache=(cache, 6))
+    for tail_len in (3, 1):
+        tail = rng.integers(0, 64, (tail_len,)).astype(np.int32)
+        lane = eng.submit(tail, 5)
+        out = run_to_done(eng, lane)
+        ref = np.asarray(generate(params, tail[None], CFG, 5,
+                                  prompt_cache=(cache, 6),
+                                  kv_int8=True))[0]
+        np.testing.assert_array_equal(out, ref)
