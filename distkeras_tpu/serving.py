@@ -173,6 +173,8 @@ class ContinuousBatcher:
         # both the admission chunk and the sequential path attend the
         # ALREADY-QUANTIZED cache position by position, unlike
         # prefill() which attends the prompt in full precision.
+        # (Stored for introspection only, like ``lanes``; the runtime
+        # switch is the ``k_scale`` leaf in ``self.cache``.)
         self.kv_int8 = kv_int8
         self.cache = init_cache(cfg, lanes, kv_int8=kv_int8)
         self.pos = jnp.zeros((lanes,), jnp.int32)

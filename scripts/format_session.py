@@ -1,0 +1,67 @@
+"""Format a chip_session log (JSON lines) into BASELINE.md-ready rows.
+
+scripts/chip_session.sh appends one JSON line per measurement; this
+groups them into markdown tables (training / serving / ablation /
+variance) so transcription into BASELINE.md during a short tunnel
+window is mechanical.
+
+Usage: python scripts/format_session.py [chip_session_r4.log]
+"""
+
+import json
+import sys
+
+
+def main(path):
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue
+    if not rows:
+        sys.exit(f"no JSON lines in {path}")
+
+    def table(title, keep, cols):
+        sel = [r for r in rows if keep(r)]
+        if not sel:
+            return
+        print(f"\n### {title}\n")
+        print("| " + " | ".join(cols) + " |")
+        print("|" + "---|" * len(cols))
+        for r in sel:
+            print("| " + " | ".join(
+                str(r.get(c, "")) for c in cols) + " |")
+
+    table("Errors (fix before transcribing)",
+          lambda r: "error" in r, ["metric", "error"])
+    table("Training (bench_suite)",
+          lambda r: r.get("unit") in ("samples/sec/chip",
+                                      "tokens/sec/chip")
+          and "step_ms" in r,
+          ["metric", "value", "unit", "step_ms", "mfu"])
+    table("Serving (bench_serving)",
+          lambda r: "ms_per_token" in r,
+          ["metric", "value", "ms_per_token", "bw_util",
+           "bw_util_measured", "batch"])
+    table("Engine under load",
+          lambda r: "ttft_p50_ms" in r,
+          ["metric", "value", "offered_rps", "achieved_rps",
+           "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms"])
+    table("Ablations",
+          lambda r: str(r.get("metric", "")).startswith("ablate_"),
+          ["metric", "value", "unit"] + sorted(
+              {k for r in rows
+               if str(r.get("metric", "")).startswith("ablate_")
+               for k in r if k not in ("metric", "value", "unit")}))
+    table("Variance (n runs per config)",
+          lambda r: "iqr_pct" in r,
+          ["metric", "median", "min", "max", "iqr_pct", "spread_pct"])
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "chip_session_r4.log")
