@@ -45,13 +45,14 @@ def main(path):
           and "step_ms" in r,
           ["metric", "value", "unit", "step_ms", "mfu"])
     table("Serving (bench_serving)",
-          lambda r: "ms_per_token" in r,
+          lambda r: "ms_per_token" in r and "ttft_p50_ms" not in r,
           ["metric", "value", "ms_per_token", "bw_util",
            "bw_util_measured", "batch"])
     table("Engine under load",
           lambda r: "ttft_p50_ms" in r,
           ["metric", "value", "offered_rps", "achieved_rps",
-           "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms"])
+           "ms_per_request", "ttft_p50_ms", "ttft_p99_ms",
+           "tpot_p50_ms", "tpot_p99_ms", "ttft_granularity_ms"])
     table("Ablations",
           lambda r: str(r.get("metric", "")).startswith("ablate_"),
           ["metric", "value", "unit"] + sorted(
