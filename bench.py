@@ -39,23 +39,17 @@ def _probe_with_retries(attempts=3, probe_s=120, backoff_s=60):
     process initialize its own backend.  Worst case ~(probe+backoff) x
     attempts, then the error line.  Returns the error string or None.
     """
-    import subprocess
     import time
+
+    from distkeras_tpu.utils.misc import probe_device_count_subprocess
 
     err = "no probe attempt ran"
     for i in range(attempts):
         try:
-            out = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(len(jax.devices()))"],
-                capture_output=True, timeout=probe_s, text=True)
-            if out.returncode == 0 and out.stdout.strip().isdigit():
-                return None
-            err = (out.stderr.strip() or "probe subprocess failed"
-                   )[-200:]
-        except subprocess.TimeoutExpired:
-            err = (f"jax device discovery hung >{probe_s}s — "
-                   "accelerator tunnel down?")
+            probe_device_count_subprocess(deadline_s=probe_s)
+            return None
+        except Exception as e:  # TimeoutError / RuntimeError from probe
+            err = str(e)[:220]
         if i + 1 < attempts:
             time.sleep(backoff_s)
     return err

@@ -62,6 +62,34 @@ def probe_devices(deadline_s: float = 120.0):
     return found
 
 
+def probe_device_count_subprocess(deadline_s: float = 15.0) -> int:
+    """Device-count probe from a FRESH subprocess with a hard timeout.
+
+    Unlike :func:`probe_devices`, a timed-out probe leaves THIS process
+    untouched: the thread probe initializes the backend in-process, so
+    after a hang every later ``jax.devices()`` blocks on the same init
+    lock, while a killed subprocess costs nothing.  Use this first when
+    the platform may be a remote tunnel; call :func:`probe_devices`
+    in-process only after it answers.  Raises ``TimeoutError`` on a
+    hang, ``RuntimeError`` on a failed probe.
+    """
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, timeout=deadline_s, text=True)
+    except subprocess.TimeoutExpired:
+        raise TimeoutError(
+            f"jax device discovery hung >{deadline_s:.0f}s — accelerator "
+            "tunnel down?") from None
+    if out.returncode == 0 and out.stdout.strip().isdigit():
+        return int(out.stdout.strip())
+    raise RuntimeError("device probe subprocess failed: "
+                       + (out.stderr.strip() or "no output")[-200:])
+
+
 def nll_to_perplexity(mean_nll: float) -> float:
     """exp(mean NLL) with the overflow guard — the ONE definition of
     the perplexity formula (LMTrainer's eval hook and
