@@ -69,9 +69,10 @@ class DistributedTrainer(Trainer):
         if device_data and not self._supports_device_data:
             raise ValueError(
                 f"device_data=True is not supported by "
-                f"{type(self).__name__}: it is implemented for the "
-                "gradient trainers (ADAG/DynSGD); the replica-stacked "
-                "family streams its per-replica batches")
+                f"{type(self).__name__}; it is implemented for "
+                "ADAG/DynSGD, the replica family (AEASGD/EAMSGD/"
+                "DOWNPOUR/Averaging/Ensemble), SingleTrainer, and "
+                "LMTrainer")
         self.device_data = device_data
         if fsdp and plan is not None:
             raise ValueError("pass either plan= or fsdp=True, not both")

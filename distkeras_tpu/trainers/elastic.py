@@ -79,9 +79,17 @@ class ReplicaTrainer(DistributedTrainer):
     data: every replica scans its ``window`` microbatches locally, then
     the subclass's sync rule runs as a collective.  The whole round —
     local steps *and* synchronization — is a single XLA program.
+
+    ``device_data=True`` stages each replica's consumption stream in
+    its own device's HBM once (P("data") over the replica axis, same
+    stream layout as ADAG._fit_device_data_multihost); each round then
+    ships only a replicated ``[window * batch]`` index block and the
+    round's shard_map gathers locally before the unchanged scan+sync —
+    data order is bit-for-bit the streaming path's (parity-tested).
     """
 
     sync_fn: SyncFn = staticmethod(_no_sync)
+    _supports_device_data = True
 
     def __init__(self, keras_model, loss="categorical_crossentropy", **kw):
         plan = kw.get("plan")
@@ -151,13 +159,14 @@ class ReplicaTrainer(DistributedTrainer):
 
     # ------------------------------------------------------------ round
 
-    def _make_round(self, window: int):
+    def _make_round(self, window: int, indexed: bool = False):
         train_step = self.adapter.make_train_step()
         sync_fn = self.sync_fn
         mesh = self.mesh
+        B = self.batch_size
 
-        def local_round(stacked, center_tv, xs, ys):
-            # Per-device views: stacked leaves [1, ...], xs [1, w, B, ...].
+        def scan_and_sync(stacked, center_tv, xs, ys):
+            # Per-device views: stacked leaves [1, ...], xs [w, B, ...].
             local = jax.tree.map(lambda a: a[0], stacked)
 
             def micro(st, batch):
@@ -165,16 +174,30 @@ class ReplicaTrainer(DistributedTrainer):
                 st2, loss = train_step(st, x, y)
                 return st2, loss
 
-            local, losses = jax.lax.scan(micro, local, (xs[0], ys[0]))
+            local, losses = jax.lax.scan(micro, local, (xs, ys))
             new_tv, new_center = sync_fn(local.tv, center_tv, "data")
             local = local.replace(tv=new_tv)
             mean_loss = jax.lax.pmean(jnp.mean(losses), "data")
             return (jax.tree.map(lambda a: a[None], local), new_center,
                     mean_loss)
 
+        def local_round(stacked, center_tv, xs, ys):
+            return scan_and_sync(stacked, center_tv, xs[0], ys[0])
+
+        def local_round_indexed(stacked, center_tv, Xb, Yb, idx):
+            # Xb is THIS replica's staged consumption stream; idx is the
+            # replicated block-local offset vector (identical per
+            # replica), so the gather is purely device-local.
+            shape = lambda a: (window, B) + a.shape[1:]
+            xs = jnp.take(Xb, idx, axis=0).reshape(shape(Xb))
+            ys = jnp.take(Yb, idx, axis=0).reshape(shape(Yb))
+            return scan_and_sync(stacked, center_tv, xs, ys)
+
+        data_specs = ((P("data"), P("data"), P())
+                      if indexed else (P("data"), P("data")))
         sharded = shard_map(
-            local_round, mesh=mesh,
-            in_specs=(P("data"), P(), P("data"), P("data")),
+            local_round_indexed if indexed else local_round, mesh=mesh,
+            in_specs=(P("data"), P()) + data_specs,
             out_specs=(P("data"), P(), P()),
             check_vma=False,
         )
@@ -200,6 +223,43 @@ class ReplicaTrainer(DistributedTrainer):
                 yield (xs.reshape((n, window) + xs.shape[1:]),
                        ys.reshape((n, window) + ys.shape[1:]))
 
+    def _index_rounds(self, dataset: Dataset, window: int):
+        """Device-resident analogue of :meth:`_round_stream`: stage each
+        replica's consumption stream in HBM once (stream layout: host
+        rows ``[rounds, n_local, w*B, ...]`` transposed to
+        ``[n_local, rounds*w*B, ...]``, sharded P("data") so device i's
+        contiguous shard is replica i's stream), then yield one
+        ``(X, Y, idx)`` per round where idx is a replicated block-local
+        offset vector — the rows streaming would feed, in order."""
+        n_local = self._n_local()
+        rows = n_local * window * self.batch_size
+        usable = len(dataset) - len(dataset) % rows
+        rounds = usable // rows
+        wb = window * self.batch_size
+
+        def layout(col):
+            a = np.asarray(col[:usable])
+            a = a.reshape((rounds, n_local, wb) + a.shape[1:])
+            a = np.moveaxis(a, 1, 0)
+            return np.ascontiguousarray(a.reshape((usable,) + a.shape[3:]))
+
+        sh = NamedSharding(self.mesh, P("data"))
+        rep = NamedSharding(self.mesh, P())
+        X = self._global_batch(layout(dataset[self.features_col]), sh)
+        Y = self._global_batch(layout(dataset[self.label_col]), sh)
+        multi = jax.process_count() > 1
+        for _ in range(self.num_epoch):
+            for r in range(rounds):
+                idx = np.arange(r * wb, (r + 1) * wb, dtype=np.int32)
+                # Replicated blocks need the explicit global shape
+                # (every host holds the identical copy; _global_batch
+                # would concatenate hosts' rows) — same idiom as
+                # ADAG._fit_device_data_multihost's index blocks.
+                yield (X, Y,
+                       jax.make_array_from_process_local_data(
+                           rep, idx, idx.shape) if multi
+                       else jax.device_put(idx, rep))
+
     def _window(self, dataset: Dataset) -> int:
         return self.communication_window
 
@@ -214,7 +274,7 @@ class ReplicaTrainer(DistributedTrainer):
         stacked = self._replica_states()
         center_tv = self.adapter.init_state().tv
         stacked, center_tv = self._put(stacked, center_tv)
-        round_fn = self._make_round(window)
+        round_fn = self._make_round(window, indexed=self.device_data)
         batch_sh = NamedSharding(self.mesh, P("data"))
 
         def globalize(a):
@@ -232,13 +292,18 @@ class ReplicaTrainer(DistributedTrainer):
         restored, start = self._restore_or(
             {"stacked": stacked, "center_tv": center_tv})
         stacked, center_tv = restored["stacked"], restored["center_tv"]
+        if self.device_data:
+            rounds_iter = self._index_rounds(dataset, window)
+        else:
+            rounds_iter = ((globalize(xs), globalize(ys))
+                           for xs, ys in self._round_stream(dataset, window))
         losses, rnd = [], 0
-        for xs, ys in self._round_stream(dataset, window):
+        for args in rounds_iter:
             rnd += 1
             if rnd <= start:
                 continue
             stacked, center_tv, loss = round_fn(
-                stacked, center_tv, globalize(xs), globalize(ys))
+                stacked, center_tv, *args)
             losses.append(loss)
             self._checkpoint({"stacked": stacked, "center_tv": center_tv}, rnd)
             self._eval_hook({"stacked": stacked, "center_tv": center_tv}, rnd)

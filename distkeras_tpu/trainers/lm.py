@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.data.dataset import Dataset
@@ -80,6 +81,17 @@ class LMTrainer(CheckpointingBase):
     uniform contract across its family, distkeras/trainers.py).
     A checkpoint round is one optimizer step.
 
+    ``device_data=True`` stages the token rows in HBM ONCE (int32 —
+    cheap relative to activations), sharded over the ``data`` axis in
+    consumption-stream layout; each step then ships only a tiny
+    replicated index block and gathers its batch on device
+    (_stage_stream).  This is the distributed/flagship form of the
+    input-pipeline win measured in docs/perf_input_pipeline.md (the
+    host link caps streaming); composes with fsdp/TP/ring/pipeline
+    meshes and grad_accum/segments because the gather feeds the
+    unchanged train step inside the same jitted program.  Data order
+    is bit-for-bit the streaming path's (parity-tested).
+
     ``ema_decay``: maintain a Polyak/EMA average of the weights inside
     the optimizer state (decay per optimizer step); after ``train``,
     ``self.ema_params`` holds the servable averaged tree.  Composes
@@ -103,6 +115,7 @@ class LMTrainer(CheckpointingBase):
                  batch_size: int = 8,
                  num_epoch: int = 1, mesh=None, rules=None,
                  microbatches: int | None = None, fsdp: bool = False,
+                 device_data: bool = False,
                  grad_accum: int = 1, grad_clip_norm: float | None = None,
                  tokens_col: str = "tokens", seed: int = 0,
                  shuffle: bool = False, eval_every: int = 0,
@@ -176,6 +189,7 @@ class LMTrainer(CheckpointingBase):
         self.num_epoch = num_epoch
         self.mesh = mesh if mesh is not None else make_mesh()
         self.fsdp = fsdp
+        self.device_data = device_data
         self.plan = ShardingPlan(
             rules=tfm.tp_rules() if rules is None else rules,
             fsdp_axis="data" if fsdp else None)
@@ -295,6 +309,37 @@ class LMTrainer(CheckpointingBase):
     # parallel.mesh.global_batch (one definition of the process-local
     # slab assembly for the whole trainer family).
     _global_batch = staticmethod(mesh_global_batch)
+
+    def _stage_stream(self, rows, steps):
+        """Host token rows (consumption order) -> ONE device-resident
+        int32 array sharded over the ``data`` axis, laid out so each
+        device's shard is exactly its own consumption stream,
+        contiguous — the LM form of ADAG._fit_device_data_multihost's
+        stream layout.  Device ``(h, d)``'s stream position
+        ``(step, accum, k)`` holds host h's row
+        ``step*rows_per_step + accum*local_bs + d*sub + k`` — precisely
+        the row the streaming path's ``_global_batch`` would place on
+        that device — so an on-device ``take`` of a replicated index
+        block reproduces streaming data order bit-for-bit.
+        """
+        n_proc = jax.process_count()
+        n_data = int(self.mesh.shape["data"])
+        n_local_dev = n_data // n_proc
+        sub = self.batch_size // n_data
+        a = np.asarray(rows, np.int32)
+        a = a.reshape((steps, self.grad_accum, n_local_dev, sub)
+                      + a.shape[1:])
+        a = np.moveaxis(a, 2, 0)
+        a = np.ascontiguousarray(a.reshape((len(rows),) + a.shape[4:]))
+        return self._global_batch(a, NamedSharding(self.mesh,
+                                                   P("data", None)))
+
+    def _replicated(self, a):
+        """Small replicated host array -> mesh.  NOT _global_batch:
+        a replicated sharding must keep the local shape as the global
+        shape (every host holds the identical copy), where the shared
+        helper would concatenate hosts' rows."""
+        return self._put_global(a, NamedSharding(self.mesh, P()))
 
     def init_params(self):
         params = tfm.init_params(jax.random.key(self.seed), self.cfg)
@@ -449,11 +494,45 @@ class LMTrainer(CheckpointingBase):
                 # governs placement internally.  rng and segment slots
                 # are always present positionally (None when unused —
                 # an empty pytree binds no sharding).
-                in_sh = ((psh, osh), step_sh, rep, step_sh)
+                if self.device_data:
+                    # The staged stream shares the token sharding: both
+                    # are [rows, S+1] split over the data axis.
+                    in_sh = ((psh, osh), tok_sh, rep, rep, tok_sh)
+                else:
+                    in_sh = ((psh, osh), step_sh, rep, step_sh)
                 jit_kw = dict(in_shardings=in_sh,
                               out_shardings=((psh, osh), rep))
-            step = jax.jit(self._step_builder(self.optimizer),
-                           donate_argnums=0, **jit_kw)
+            if self.device_data:
+                # HBM-resident data plane: the staged stream stays on
+                # device; each step ships only a replicated [accum, sub]
+                # index block and a shard_map gathers every device's
+                # rows from its OWN shard (a plain take on the sharded
+                # array would all-gather the dataset each step).  The
+                # gather fuses into the same XLA program as the step.
+                inner = self._step_builder(self.optimizer)
+                sub = global_bs // n_data
+                accum = self.grad_accum
+
+                def local_take(xb, idx):
+                    g = jnp.take(xb, idx.reshape(-1), axis=0)
+                    return g.reshape(idx.shape + xb.shape[1:])
+
+                gather = shard_map(
+                    local_take, mesh=self.mesh,
+                    in_specs=(P("data", None), P()),
+                    out_specs=(P(None, "data", None) if accum > 1
+                               else P("data", None)),
+                    check_vma=False)
+
+                def dd_step(carry, X, idx, rng, Seg):
+                    tok = gather(X, idx)
+                    seg = None if Seg is None else gather(Seg, idx)
+                    return inner(carry, tok, rng, seg)
+
+                step = jax.jit(dd_step, donate_argnums=0, **jit_kw)
+            else:
+                step = jax.jit(self._step_builder(self.optimizer),
+                               donate_argnums=0, **jit_kw)
             # Dropout stream keyed on the optimizer round: resume from a
             # checkpoint replays the identical mask sequence.
             drop_base = (jax.random.key(self.seed + 0x5eed)
@@ -529,6 +608,13 @@ class LMTrainer(CheckpointingBase):
                     f"dataset has {len(tokens)} rows; one step needs "
                     f"{rows_per_step} (batch_size x grad_accum"
                     + (f" / {n_proc} processes)" if n_proc > 1 else ")"))
+            X_dev = seg_dev = None
+            if self.device_data:
+                steps_pe = n_rows // rows_per_step
+                X_dev = self._stage_stream(tokens[:n_rows], steps_pe)
+                if segments is not None:
+                    seg_dev = self._stage_stream(segments[:n_rows],
+                                                 steps_pe)
             carry, start = self._restore_or(carry)
             rnd = 0
             # Profile rounds relative to the first *executed* round
@@ -540,27 +626,43 @@ class LMTrainer(CheckpointingBase):
                     rnd += 1
                     if rnd <= start:
                         continue
-                    block = np.asarray(tokens[i:i + rows_per_step], np.int32)
-                    seg_batch = None
-                    if segments is not None:
-                        seg_block = np.asarray(
-                            segments[i:i + rows_per_step], np.int32)
+                    if self.device_data:
+                        sub = global_bs // n_data
+                        s = i // rows_per_step
+                        flat = np.arange(s * self.grad_accum * sub,
+                                         (s + 1) * self.grad_accum * sub,
+                                         dtype=np.int32)
+                        idx = (flat.reshape(self.grad_accum, sub)
+                               if self.grad_accum > 1 else flat)
+                        step_args = (X_dev, self._replicated(idx))
+                    else:
+                        block = np.asarray(tokens[i:i + rows_per_step],
+                                           np.int32)
+                        seg_batch = None
+                        if segments is not None:
+                            seg_block = np.asarray(
+                                segments[i:i + rows_per_step], np.int32)
+                            if self.grad_accum > 1:
+                                seg_block = seg_block.reshape(
+                                    self.grad_accum, global_bs // n_proc,
+                                    seg_block.shape[1])
+                            seg_batch = self._global_batch(seg_block,
+                                                           step_sh)
                         if self.grad_accum > 1:
-                            seg_block = seg_block.reshape(
-                                self.grad_accum, global_bs // n_proc,
-                                seg_block.shape[1])
-                        seg_batch = self._global_batch(seg_block, step_sh)
-                    if self.grad_accum > 1:
-                        block = block.reshape(self.grad_accum,
-                                              global_bs // n_proc,
-                                              block.shape[1])
-                    batch = self._global_batch(block, step_sh)
+                            block = block.reshape(self.grad_accum,
+                                                  global_bs // n_proc,
+                                                  block.shape[1])
+                        step_args = (self._global_batch(block, step_sh),)
                     if self.profile_dir and rnd == prof_start:
                         jax.profiler.start_trace(self.profile_dir)
                         profiling = True
                     rng = (jax.random.fold_in(drop_base, rnd)
                            if dropping else None)
-                    carry, loss = step(carry, batch, rng, seg_batch)
+                    if self.device_data:
+                        carry, loss = step(carry, *step_args, rng, seg_dev)
+                    else:
+                        carry, loss = step(carry, *step_args, rng,
+                                           seg_batch)
                     if (profiling
                             and rnd >= prof_start - 1 + self.profile_steps):
                         jax.block_until_ready(loss)  # flush async device work

@@ -649,6 +649,39 @@ def bench_cifar_cnn_resident():
     return batch * steps / dt, dt / steps, step_flops
 
 
+def bench_lm_e2e(device_data):
+    """End-to-end ``LMTrainer.train()`` throughput over real host rows,
+    streaming vs ``device_data=True`` — the LM flagship's input-plane
+    delta (docs/perf_input_pipeline.md round-5).  The per-step
+    ``transformer_*`` rows feed ONE pre-staged device batch and so
+    cannot see the host link at all; this pair trains on a real row
+    set through the public trainer API.  Wall time is the second
+    ``train()`` call in the process: the retrace is cheap and XLA's
+    in-process executable cache absorbs the compile, so both variants
+    pay the same fixed cost and the delta is the data plane."""
+    def run(batch=8, seq=1024, steps=30, cfg=None):
+        import numpy as np
+        from distkeras_tpu.trainers.lm import LMTrainer
+
+        cfg = cfg or _d1024_cfg()
+        rng = np.random.default_rng(0)
+        rows = rng.integers(0, cfg.vocab_size,
+                            (batch * steps, seq + 1)).astype(np.int32)
+
+        def train_once():
+            t = LMTrainer(cfg, learning_rate=3e-4, batch_size=batch,
+                          num_epoch=1, device_data=device_data)
+            t.train(rows)
+            return t
+
+        train_once()                      # compile + warm the exec cache
+        wall = train_once().training_time
+        return batch * steps * seq / wall, wall / steps, 0.0, {
+            "device_data": device_data, "steps": steps, "batch": batch,
+            "seq": seq, "e2e_wall_s": round(wall, 3)}
+    return run
+
+
 BENCHES = {
     "mnist_mlp": (bench_mnist_mlp, "samples/sec/chip"),
     "cifar_cnn": (bench_cifar_cnn, "samples/sec/chip"),
@@ -675,6 +708,8 @@ BENCHES = {
     "transformer_moe_top1": (bench_transformer_moe(1), "tokens/sec/chip"),
     "transformer_moe_top2": (bench_transformer_moe(2), "tokens/sec/chip"),
     "lora_finetune": (bench_lora_finetune, "tokens/sec/chip"),
+    "lm_e2e_stream": (bench_lm_e2e(False), "tokens/sec/chip"),
+    "lm_e2e_device_data": (bench_lm_e2e(True), "tokens/sec/chip"),
 }
 
 

@@ -428,3 +428,46 @@ def test_lora_trainer_rejects_ema(devices):
     base = tfm.init_params(jax.random.key(0), CFG)
     with pytest.raises(ValueError, match="ema_decay is not supported"):
         dk.LoRATrainer(CFG, base, lora_rank=2, ema_decay=0.9)
+
+
+def test_lm_device_data_matches_streaming(devices, rng):
+    """device_data=True reproduces the streaming run's losses exactly:
+    the staged stream layout + on-device gather feed the unchanged
+    train step the same rows in the same order (dp, TP+grad_accum,
+    FSDP, and pipeline meshes)."""
+    toks = tokens(rng, n=96)
+
+    def run(spec, **kw):
+        t = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16,
+                         num_epoch=2, mesh=make_mesh(spec, devices=devices),
+                         **kw)
+        t.train(toks)
+        return t.history
+
+    for spec, kw in [(MeshSpec(data=8), {}),
+                     (MeshSpec(data=4, model=2), {"grad_accum": 2}),
+                     (MeshSpec(data=4, model=2), {"fsdp": True}),
+                     (MeshSpec(data=4, pipeline=2), {})]:
+        np.testing.assert_allclose(run(spec, device_data=True, **kw),
+                                   run(spec, **kw), rtol=1e-6,
+                                   err_msg=f"{spec} {kw}")
+
+
+def test_lm_device_data_packed_segments(devices, rng):
+    """device_data gathers the segment rows with the same index block
+    as the tokens, so packed training matches streaming exactly."""
+    docs = [rng.integers(1, 64, (int(k),)).tolist()
+            for k in rng.integers(5, 14, 64)]
+    rows, segs = dk.pack_documents(docs, seq_len=16)
+    n = (len(rows) // 16) * 16
+    mesh = make_mesh(MeshSpec(data=8), devices=None)
+
+    def run(**kw):
+        t = dk.LMTrainer(tfm.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+            max_len=17), learning_rate=1e-2, batch_size=16, num_epoch=2,
+            mesh=mesh, **kw)
+        t.train(rows[:n], segments=segs[:n])
+        return t.history
+
+    np.testing.assert_allclose(run(device_data=True), run(), rtol=1e-6)
