@@ -162,7 +162,7 @@ def _ancestry_attend(qg, ck, cv, anc_oh, mask_b, cfg: TransformerConfig,
     (query-lane, source-lane) pair — the cache is read once, W x the
     tiny decode attention FLOPs — then the one-hot selects each
     position's true ancestor.  ``kv_scales=(cks, cvs) [B, S, kv]``:
-    int8-KV dequant scales (full-cache path only).
+    int8-KV dequant scales (slot-indexed, so ring caches compose).
     Returns ``attn [B, n_heads, hd]`` f32.
     """
     b = qg.shape[0]
@@ -222,11 +222,7 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
     if beam_anc is not None:
         anc, w_beams = beam_anc
         anc_oh = jax.nn.one_hot(anc, w_beams, dtype=jnp.float32)
-    if "k_scale" in cache:
-        raise ValueError("kv_int8 decode supports full-cache configs "
-                         "only (no attention_window, no ragged "
-                         "prompt_lengths) — those paths keep the "
-                         "compute-dtype cache")
+    kv_q = "k_scale" in cache                   # int8 KV cache
     x = embed_rows(params["tok_emb"], tokens, dtype)  # [B, D]
     if pad_lens is None:
         pos_ids = jnp.full((b,), pos)
@@ -241,6 +237,10 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
         x = x + params["pos_emb"][pos_ids].astype(dtype)
 
     ck_all, cv_all = cache["k"], cache["v"]     # [L, B, S, kv, hd]
+    if kv_q:
+        cks_all, cvs_all = cache["k_scale"], cache["v_scale"]
+    # [B, S, C] scale -> broadcast over the [B, C, G, S] logits.
+    sc_b = lambda s: s.transpose(0, 2, 1)[:, :, None, :]
     for i in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
         h = _rms_norm(x, lp["ln1_scale"])
@@ -255,14 +255,22 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
             # Keys cache post-rotation (each key's rotation depends only
             # on its own position), matching the training forward.
             q, k = rope_rotate(q, rope_ang), rope_rotate(k, rope_ang)
+        if kv_q:  # post-rotation, like the bf16 cache
+            k, k_s = quantize_kv(k)               # scale [B, C]
+            v, v_s = quantize_kv(v)
         # Windowed configs write the ring-buffer slot pos % C (identical
         # to pos while pos < C): with window <= C the cache then
-        # supports generation beyond max_len (rolling decode).
+        # supports generation beyond max_len (rolling decode) — the
+        # int8 scales ride the same slot arithmetic.
         slot = jnp.asarray(pos % cfg.max_len if cfg.attention_window
                            else pos, jnp.int32)
         ck_all = _layer_slab_update(ck_all, i, k[:, None], slot)
         cv_all = _layer_slab_update(cv_all, i, v[:, None], slot)
         ck, cv = ck_all[i], cv_all[i]
+        if kv_q:
+            cks_all = _layer_slab_update(cks_all, i, k_s[:, None], slot)
+            cvs_all = _layer_slab_update(cvs_all, i, v_s[:, None], slot)
+            cks, cvs = cks_all[i], cvs_all[i]
 
         # GQA: grouped einsums read only the kv-head cache — never
         # materialize an expanded per-query-head copy (that repeat
@@ -294,10 +302,14 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
             mask_b = jnp.broadcast_to(row_mask[None, None, :],
                                       (bt, w_beams, cfg.max_len))
             attn = _ancestry_attend(qg, ck, cv, anc_oh, mask_b, cfg,
-                                    w_beams)
+                                    w_beams,
+                                    kv_scales=(cks, cvs) if kv_q
+                                    else None)
         else:
             logits = jnp.einsum("bcgk,bsck->bcgs", qg,
                                 ck.astype(jnp.float32))
+            if kv_q:
+                logits = logits * sc_b(cks)
             logits = logits / jnp.sqrt(jnp.float32(cfg.head_dim))
             mask = row_mask[None, None, None, :]
             if pad_lens is not None:  # left-pad slots never attend
@@ -305,7 +317,8 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
                                )[:, None, None, :]
             logits = jnp.where(mask, logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
-            attn = jnp.einsum("bcgs,bsck->bcgk", probs,
+            attn = jnp.einsum("bcgs,bsck->bcgk",
+                              probs * sc_b(cvs) if kv_q else probs,
                               cv.astype(jnp.float32)).reshape(
                 b, cfg.n_heads, cfg.head_dim)
         x = x + jnp.einsum("bhk,hkd->bd", attn.astype(dtype),
@@ -339,7 +352,10 @@ def _decode_step(params, cache, tokens, pos, cfg: TransformerConfig,
     # result (int8 stays the HBM operand by construction — see
     # quant.unembed_logits), instead of dequantizing [V, d] per step.
     out = unembed_logits(x, params["tok_emb"], dtype)
-    return out.astype(jnp.float32), {"k": ck_all, "v": cv_all}
+    cache = {"k": ck_all, "v": cv_all}
+    if kv_q:
+        cache["k_scale"], cache["v_scale"] = cks_all, cvs_all
+    return out.astype(jnp.float32), cache
 
 
 def _rows_update(cache_layer, rows, pos0):
@@ -402,7 +418,9 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
     contract that the chunk does not wrap (``pos0[0] % max_len +
     T <= max_len`` — admission prefills satisfy it by bucket
     construction; unverifiable here because pos0 is traced).  Windowed
-    x kv_int8 stays rejected (parity with _decode_step).
+    x kv_int8 composes on both shapes: the scale slabs take the same
+    ring-slot updates as the K/V they scale (round-5; parity vs the
+    bf16-cache run in tests/test_serving.py and test_generate.py).
 
     Stale cache slots beyond a row's final position are harmless by
     construction: the position mask excludes them (for ring caches the
@@ -445,9 +463,6 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
     kv_q = "k_scale" in cache                   # int8 KV cache
     win = cfg.attention_window is not None
     if win:
-        if kv_q:
-            raise ValueError("kv_int8 decode supports full-cache "
-                             "configs only (no attention_window)")
         if not uniform_pos and t_len != 1:
             raise ValueError(
                 "windowed per-row chunks support T == 1 only (a ring "
@@ -845,11 +860,6 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
             f"top_k must be in [1, vocab_size={cfg.vocab_size}], got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    if kv_int8 and (cfg.attention_window is not None
-                    or prompt_lengths is not None):
-        raise ValueError(
-            "kv_int8 decoding supports full-cache uniform-prompt "
-            "configs only (no attention_window, no prompt_lengths)")
     if min_p is not None and not 0.0 < min_p <= 1.0:
         raise ValueError(f"min_p must be in (0, 1], got {min_p}")
     cached_len = 0
@@ -1040,9 +1050,6 @@ def beam_search(params, prompt, cfg: TransformerConfig,
     if length_penalty < 0:
         raise ValueError(
             f"length_penalty must be >= 0, got {length_penalty}")
-    if kv_int8 and cfg.attention_window is not None:
-        raise ValueError("kv_int8 beam search requires a full cache "
-                         "(no attention_window)")
     # ``_force_physical`` is the deprecated private spelling of
     # beam_impl="physical" (kept for back-compat).  Resolved HERE, with
     # the other argument checks: an invalid beam_impl or an over-limit

@@ -88,9 +88,11 @@ class ContinuousBatcher:
     the ring-buffer cache with no total-length cap (prompts still must
     fit the ring), each request matching its solo rolling
     ``generate()`` run exactly.  No quantized-tree restriction — int8
-    weights decode on the same chunk path — and full-cache engines
-    take ``kv_int8=True`` (int8 KV cache; parity vs
-    ``generate(kv_int8=True, use_prefill=False)``).
+    weights decode on the same chunk path — and every engine shape
+    takes ``kv_int8=True`` (int8 KV cache; parity vs
+    ``generate(kv_int8=True, use_prefill=False)``), rolling ring
+    lanes included (round-5: the scale slabs ride the same ring-slot
+    updates as the K/V).
     """
 
     def __init__(self, params, cfg: TransformerConfig, lanes: int = 8,
@@ -115,9 +117,8 @@ class ContinuousBatcher:
             if prompt_cache is not None:
                 raise ValueError("prompt_cache requires a full-cache "
                                  "config (no attention_window)")
-            if kv_int8:
-                raise ValueError("kv_int8 decode supports full-cache "
-                                 "configs only (no attention_window)")
+            # kv_int8 composes: the int8 ring slab is the same
+            # slot-addressed slab update with scale slabs riding along.
             self._rolling = True
         if lanes < 1:
             raise ValueError(f"lanes must be >= 1, got {lanes}")

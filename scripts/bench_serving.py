@@ -238,6 +238,26 @@ def bench_rolling_window():
     return run
 
 
+def bench_rolling_window_kvint8():
+    """Rolling ring decode x int8 KV cache (round-5: the composition
+    the engine refused through round 4).  Window 256 ring + int8 K/V:
+    the cache term drops ~8x vs the full-1025-slot bf16 config (4x
+    ring, 2x int8, minus the f32 scale rows)."""
+    import dataclasses
+
+    def run():
+        import jax
+        from distkeras_tpu.models import transformer as tfm
+
+        cfg = dataclasses.replace(_cfg(window=256), max_len=256)
+        params = tfm.init_params(jax.random.key(0), cfg)
+        c_bytes = (cache_bytes_per_row(cfg, None, bytes_per_el=1)
+                   + 2 * cfg.n_layers * cfg.max_len * cfg.kv_heads * 4)
+        return _measure_decode(cfg, params, batch=8, new=512, p_len=64,
+                               kv_int8=True, c_bytes=c_bytes)
+    return run
+
+
 def bench_beam4(window=None, beam_impl="auto"):
     """Beam-4 decode; ``window`` runs the ring-buffer config (the
     round-4 ancestry extension — compare beam4_windowed vs
@@ -611,6 +631,8 @@ BENCHES = {
     "decode_kv_int8_b64": (bench_kv_int8(64), "tokens/sec/chip"),
     "decode_gqa4_b64": (bench_gqa4(64), "tokens/sec/chip"),
     "decode_rolling_window": (bench_rolling_window(), "tokens/sec/chip"),
+    "decode_rolling_window_kvint8": (bench_rolling_window_kvint8(),
+                                     "tokens/sec/chip"),
     "beam4": (bench_beam4(), "tokens/sec/chip"),
     "beam4_windowed": (bench_beam4(window=256), "tokens/sec/chip"),
     "beam4_windowed_physical": (bench_beam4(window=256,

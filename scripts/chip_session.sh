@@ -1,12 +1,12 @@
 #!/bin/bash
-# One green-tunnel measurement session, in priority order (round-4
-# plan — see docs/round4_notes.md).  Run from the repo root the moment
-# the axon tunnel is up; every stage appends JSON lines to
-# chip_session_r4.log so a mid-session tunnel drop loses nothing.
-# Stage order front-loads the round's unmeasured headliners.
+# One green-tunnel measurement session, in priority order (round-5
+# plan; round-4 backlog front-loaded — see VERDICT.md round-4 item 1).
+# Run from the repo root the moment the axon tunnel is up; every stage
+# appends JSON lines to chip_session_r5.log so a mid-session tunnel
+# drop loses nothing.
 set -u
 cd "$(dirname "$0")/.."
-LOG=chip_session_r4.log
+LOG=chip_session_r5.log
 say() { echo "### $(date -u +%H:%M:%S) $*" | tee -a "$LOG"; }
 
 say "stage 0: probe + headline (writes BENCH_LAST_GREEN.json)"
@@ -41,4 +41,10 @@ python scripts/bench_serving.py beam4 beam4_windowed \
     beam4_windowed_physical decode_rolling_window \
     2>>"$LOG" | tee -a "$LOG"
 
-say "session complete — transcribe $LOG into BASELINE.md + perf docs"
+say "stage 8 (round-5 additions): LM e2e input plane + int8 ring"
+python scripts/bench_suite.py lm_e2e_stream lm_e2e_device_data \
+    2>>"$LOG" | tee -a "$LOG"
+python scripts/bench_serving.py decode_rolling_window_kvint8 \
+    2>>"$LOG" | tee -a "$LOG"
+
+say "session complete — transcribe: python scripts/format_session.py $LOG"

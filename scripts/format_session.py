@@ -65,4 +65,4 @@ def main(path):
 
 
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else "chip_session_r4.log")
+    main(sys.argv[1] if len(sys.argv) > 1 else "chip_session_r5.log")

@@ -981,12 +981,9 @@ def test_kv_int8_generate_prefill_close_to_sequential(rng):
                                atol=0.05 * base, rtol=0.1)
 
 
-def test_kv_int8_beam_and_validation(rng):
+def test_kv_int8_beam_ancestry_equals_physical(rng):
     """Beam search runs on the int8 cache through BOTH the ancestry and
-    physical paths with identical results; windowed/ragged configs
-    reject kv_int8 loudly."""
-    import dataclasses
-
+    physical paths with identical results."""
     from distkeras_tpu.models.generate import beam_search
 
     params = tfm.init_params(jax.random.key(2), CFG)
@@ -998,15 +995,69 @@ def test_kv_int8_beam_and_validation(rng):
     np.testing.assert_array_equal(np.asarray(sa), np.asarray(sp))
     np.testing.assert_allclose(np.asarray(sca), np.asarray(scp),
                                atol=1e-5, rtol=1e-5)
-    win_cfg = dataclasses.replace(CFG, attention_window=4)
-    with pytest.raises(ValueError, match="kv_int8"):
-        generate(params, prompt, win_cfg, 4, kv_int8=True)
-    with pytest.raises(ValueError, match="kv_int8"):
-        generate(params, prompt, CFG, 4, kv_int8=True,
-                 prompt_lengths=[2, 4])
-    with pytest.raises(ValueError, match="kv_int8"):
-        beam_search(params, prompt, win_cfg, 4, beam_width=2,
-                    kv_int8=True)
+
+
+def test_kv_int8_rolling_decode_matches_large_cache(rng):
+    """kv_int8 on the ring-buffer cache (round-5: the scale slabs ride
+    the same slot updates as the K/V): generation past max_len must
+    EXACTLY reproduce a non-wrapping kv_int8 run with a big cache —
+    quantization is per-token and slot-independent, so the wrap must
+    stay invisible, int8 or not."""
+    import dataclasses
+
+    base = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, rope=True,
+                                 attention_window=6, max_len=64)
+    small = dataclasses.replace(base, max_len=16)  # will wrap
+    params = tfm.init_params(jax.random.key(0), base)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 5)), jnp.int32)
+    big = generate(params, prompt, base, 35, kv_int8=True,
+                   use_prefill=False)
+    rolled = generate(params, prompt, small, 35, kv_int8=True,
+                      use_prefill=False)
+    np.testing.assert_array_equal(np.asarray(rolled), np.asarray(big))
+
+
+def test_kv_int8_rolling_beam_matches_large_cache(rng):
+    """Rolling beam search on the int8 ring cache, both impls, vs a
+    non-wrapping int8 big-cache run."""
+    import dataclasses
+
+    from distkeras_tpu.models.generate import beam_search
+
+    base = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                 n_kv_heads=2, n_layers=2, d_ff=64,
+                                 rope=True, attention_window=6,
+                                 max_len=64)
+    small = dataclasses.replace(base, max_len=16)  # will wrap
+    params = tfm.init_params(jax.random.key(2), base)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 5)), jnp.int32)
+    kw = dict(beam_width=3, kv_int8=True, use_prefill=False)
+    bs, bsc = beam_search(params, prompt, base, 20, **kw)
+    for impl in ("ancestry", "physical"):
+        rs, rsc = beam_search(params, prompt, small, 20, beam_impl=impl,
+                              **kw)
+        np.testing.assert_array_equal(np.asarray(rs), np.asarray(bs))
+        np.testing.assert_allclose(np.asarray(rsc), np.asarray(bsc),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_kv_int8_ragged_rows_match_solo(rng):
+    """Ragged prompts x kv_int8: each row decodes exactly as it would
+    alone on the int8 cache (left-pad slots never attend; position ids
+    count from the row's true start; per-token quantization makes the
+    comparison exact, not just close)."""
+    params = tfm.init_params(jax.random.key(3), ROPE_CFG)
+    p = 6
+    rows = jnp.asarray(rng.integers(0, 64, (2, p)), jnp.int32)
+    lens = [3, 6]
+    out = generate(params, rows, ROPE_CFG, 5, kv_int8=True,
+                   prompt_lengths=lens)
+    for i, ln in enumerate(lens):
+        alone = generate(params, rows[i:i + 1, :ln], ROPE_CFG, 5,
+                         kv_int8=True, use_prefill=False)
+        np.testing.assert_array_equal(np.asarray(out[i, :ln + 5]),
+                                      np.asarray(alone[0]))
 
 
 # ------------------------------------------------------- prompt/prefix cache

@@ -325,9 +325,8 @@ def test_kv_int8_engine_matches_sequential_generate(params, rng):
         solo(params, pa, 6, kv_int8=True, use_prefill=False,
              temperature=0.8, top_k=8, key=k))
 
-    with pytest.raises(ValueError, match="full-cache"):
-        ContinuousBatcher(tfm.init_params(jax.random.key(3), ROLL_CFG),
-                          ROLL_CFG, lanes=1, kv_int8=True)
+    # Windowed engines take kv_int8 too since round 5 — positive
+    # coverage in test_kv_int8_rolling_engine_matches_rolling_generate.
     # Prefix quantization must match the engine cache.
     from distkeras_tpu.models.generate import prefill
 
@@ -356,3 +355,30 @@ def test_kv_int8_engine_shared_prefix(params, rng):
                                   prompt_cache=(cache, 6),
                                   kv_int8=True))[0]
         np.testing.assert_array_equal(out, ref)
+
+
+def test_kv_int8_rolling_engine_matches_rolling_generate(rng):
+    """kv_int8 on ROLLING ring lanes (round-5: serving.py's windowed x
+    kv_int8 rejection deleted): every request decodes past max_len on
+    the int8 ring cache and matches its solo sequential
+    generate(kv_int8=True, use_prefill=False) run EXACTLY — admission
+    chunk and decode loop both attend the already-quantized cache."""
+    rparams = tfm.init_params(jax.random.key(5), ROLL_CFG)
+    eng = ContinuousBatcher(rparams, ROLL_CFG, lanes=2, kv_int8=True)
+    assert eng.kv_int8 and "k_scale" in eng.cache
+
+    def rsolo(prompt, n):
+        return np.asarray(generate(rparams, np.asarray(prompt)[None],
+                                   ROLL_CFG, n, kv_int8=True,
+                                   use_prefill=False))[0]
+
+    pa = rng.integers(0, 64, (4,)).astype(np.int32)
+    pb = rng.integers(0, 64, (6,)).astype(np.int32)
+    la = eng.submit(pa, 30)              # 4 + 30 = 34 >> 12: wraps
+    for _ in range(8):                   # A rolls ahead alone
+        eng.step()
+    lb = eng.submit(pb, 20)              # admitted mid-wrap of A
+    out_a = run_to_done(eng, la)
+    out_b = run_to_done(eng, lb)
+    np.testing.assert_array_equal(out_a, rsolo(pa, 30))
+    np.testing.assert_array_equal(out_b, rsolo(pb, 20))
