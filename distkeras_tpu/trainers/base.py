@@ -230,6 +230,19 @@ class Trainer(CheckpointingBase):
                 f"eval_dataset holds {len(eval_dataset)} rows per host "
                 f"but one eval chunk needs {feed} "
                 "(batch_size x num_workers / process_count)")
+        if usable < len(eval_dataset):
+            import warnings
+
+            # The single-process path mini-batches ALL rows, so a
+            # ragged shard silently diverges from that run's metrics
+            # unless the caller is told (advisor round-4).
+            warnings.warn(
+                f"multi-process eval uses {usable} of "
+                f"{len(eval_dataset)} eval rows per host (chunks of "
+                f"{feed}); the {len(eval_dataset) - usable}-row tail is "
+                "excluded from eval metrics on every host — pad or trim "
+                "the shard to a multiple of the chunk size for "
+                "single-process-identical numbers", stacklevel=3)
         x = np.asarray(eval_dataset[self.features_col])
         y = np.asarray(eval_dataset[self.label_col])
         sh = self._batch_sharding(leading_window=False)

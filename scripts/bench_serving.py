@@ -166,16 +166,21 @@ def _params(quant=False, cfg=None):
     return quantize_params(p) if quant else p
 
 
+def kv_int8_cache_bytes(cfg):
+    """Modeled per-row cache traffic of the int8 KV cache: data bytes
+    halve (bytes_per_el=1) and the per-token per-kv-head f32 scales add
+    a head_dim/4 x smaller term.  ONE definition for every kv_int8
+    bench row."""
+    return (cache_bytes_per_row(cfg, None, bytes_per_el=1)
+            + 2 * cfg.n_layers * cfg.max_len * cfg.kv_heads * 4)
+
+
 def bench_kv_int8(batch):
-    # int8 KV cache (quant.quantize_kv): cache data bytes halve; the
-    # per-token per-head f32 scales add head_dim/4 x less. The modeled
-    # cache term counts both.
     def run():
         cfg = _cfg()
-        c_bytes = (cache_bytes_per_row(cfg, None, bytes_per_el=1)
-                   + 2 * cfg.n_layers * cfg.max_len * cfg.kv_heads * 4)
         return _measure_decode(cfg, _params(), batch, new=512,
-                               kv_int8=True, c_bytes=c_bytes)
+                               kv_int8=True,
+                               c_bytes=kv_int8_cache_bytes(cfg))
     return run
 
 
@@ -251,10 +256,9 @@ def bench_rolling_window_kvint8():
 
         cfg = dataclasses.replace(_cfg(window=256), max_len=256)
         params = tfm.init_params(jax.random.key(0), cfg)
-        c_bytes = (cache_bytes_per_row(cfg, None, bytes_per_el=1)
-                   + 2 * cfg.n_layers * cfg.max_len * cfg.kv_heads * 4)
         return _measure_decode(cfg, params, batch=8, new=512, p_len=64,
-                               kv_int8=True, c_bytes=c_bytes)
+                               kv_int8=True,
+                               c_bytes=kv_int8_cache_bytes(cfg))
     return run
 
 

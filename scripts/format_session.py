@@ -5,7 +5,7 @@ groups them into markdown tables (training / serving / ablation /
 variance) so transcription into BASELINE.md during a short tunnel
 window is mechanical.
 
-Usage: python scripts/format_session.py [chip_session_r4.log]
+Usage: python scripts/format_session.py [chip_session_r5.log]
 """
 
 import json

@@ -616,6 +616,20 @@ d.train(ds, eval_dataset=eval_ds)
 assert len(d.eval_history) == 3, d.eval_history  # rounds 1, 2, final
 assert all(np.isfinite(m["loss"]) for _, m in d.eval_history)
 
+# A ragged eval shard (not a multiple of the chunk size) must WARN
+# about the dropped tail (advisor round-4) — and still run.
+import warnings
+rag = dk.Dataset.from_arrays(ex[:68], ey[:68]).shard(host, 2)
+w = dk.ADAG(make_mlp(), loss="sparse_categorical_crossentropy",
+            worker_optimizer="sgd", learning_rate=0.05, batch_size=8,
+            communication_window=2, num_workers=8, num_epoch=1,
+            eval_every=1)
+with warnings.catch_warnings(record=True) as caught:
+    warnings.simplefilter("always")
+    w.train(ds, eval_dataset=rag)
+assert any("excluded from eval metrics" in str(c.message)
+           for c in caught), [str(c.message) for c in caught]
+
 np.savez({out!r} + f".h{{host}}.npz",
          rounds=np.asarray([r for r, _ in t.eval_history]),
          loss=np.asarray([m["loss"] for _, m in t.eval_history]),
