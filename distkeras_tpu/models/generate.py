@@ -369,6 +369,18 @@ def _rows_update(cache_layer, rows, pos0):
             (p,) + (0,) * (c.ndim - 1)))(cache_layer, rows, pos0)
 
 
+def _rows_update_ring(cache_layer, rows, pos0, max_len):
+    """Per-row T-span write at ring slots ``(pos0[b] + t) % max_len`` —
+    the modular generalization of :func:`_rows_update` for windowed
+    chunks that may WRAP mid-chunk (speculative decoding's divergent
+    per-row positions on a ring cache).  A per-row gather-scatter
+    (``c.at[idx].set``): a dynamic_update_slice span cannot wrap."""
+    t_len = rows.shape[1]
+    idx = (pos0[:, None] + jnp.arange(t_len)) % max_len    # [B, T]
+    return jax.vmap(lambda c, r, ix: c.at[ix].set(
+        r.astype(c.dtype)))(cache_layer, rows, idx)
+
+
 def _layer_slab_update(cache_all, i, rows, pos):
     """Write ``rows [B, T, kv, hd]`` (all rows at position ``pos``) into
     layer ``i`` of the stacked cache ``[L, B, S, kv, hd]`` — WITHOUT
@@ -408,19 +420,23 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
     positions (speculative decoding's per-row accept divergence) share
     one compiled program.
 
-    Windowed (``attention_window``) configs are supported in the two
-    shapes the serving engine needs (round-4; everything else routes
-    through _decode_step's per-scalar-position body): (a) the per-row
-    path with T == 1 — each row writes its ring slot ``pos0[b] %
-    max_len`` and attends under the per-row band mask, which is the
-    rolling-decode arithmetic vectorized over rows at DIFFERENT
-    positions; (b) the uniform_pos chunk path under the caller
-    contract that the chunk does not wrap (``pos0[0] % max_len +
-    T <= max_len`` — admission prefills satisfy it by bucket
-    construction; unverifiable here because pos0 is traced).  Windowed
-    x kv_int8 composes on both shapes: the scale slabs take the same
-    ring-slot updates as the K/V they scale (round-5; parity vs the
-    bf16-cache run in tests/test_serving.py and test_generate.py).
+    Windowed (``attention_window``) configs run in three shapes:
+    (a) the per-row path with T == 1 — each row writes its ring slot
+    ``pos0[b] % max_len`` and attends under the per-row band mask,
+    which is the rolling-decode arithmetic vectorized over rows at
+    DIFFERENT positions (the serving engine's decode step); (b) the
+    uniform_pos chunk path under the caller contract that the chunk
+    does not wrap (``pos0[0] % max_len + T <= max_len`` — admission
+    prefills satisfy it by bucket construction; unverifiable here
+    because pos0 is traced); (c) per-row MULTI-token chunks (round-5,
+    speculative decoding on a ring): writes go through a modular
+    scatter that may wrap mid-chunk, guarded by
+    ``T + window <= max_len`` so in-chunk future positions and
+    rejected-tail garbage always alias OUTSIDE every live query's
+    band.  Windowed x kv_int8 composes on all three shapes: the scale
+    slabs take the same ring-slot updates as the K/V they scale
+    (round-5; parity vs the bf16-cache run in tests/test_serving.py
+    and test_generate.py).
 
     Stale cache slots beyond a row's final position are harmless by
     construction: the position mask excludes them (for ring caches the
@@ -462,13 +478,22 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
 
     kv_q = "k_scale" in cache                   # int8 KV cache
     win = cfg.attention_window is not None
-    if win:
-        if not uniform_pos and t_len != 1:
-            raise ValueError(
-                "windowed per-row chunks support T == 1 only (a ring "
-                "chunk at divergent row positions could wrap "
-                "mid-chunk); multi-token windowed chunks need "
-                "uniform positions")
+    if (win and t_len > 1 and not uniform_pos
+            and t_len + cfg.attention_window > cfg.max_len):
+        # A per-row ring chunk may WRAP, and then two invariants need
+        # chunk + window <= max_len: an in-chunk future position
+        # q > t wrapped onto slot q % C must alias to implied position
+        # q - C with delta C - (q - t) >= window (masked), and a
+        # speculative chunk's rejected-tail garbage must never fall
+        # inside a live query's band (speculative._validate states the
+        # same bound with T = n_draft + 1).  uniform_pos chunks are
+        # exempt: their no-wrap caller contract keeps every future
+        # slot at implied position q - C < 0, masked unconditionally.
+        raise ValueError(
+            f"windowed per-row chunk of {t_len} tokens + "
+            f"attention_window={cfg.attention_window} exceeds the ring "
+            f"size (max_len={cfg.max_len}); shrink the chunk or grow "
+            "the ring")
     ck_all, cv_all = cache["k"], cache["v"]     # [L, B, S, kv, hd]
     if kv_q:
         cks_all, cvs_all = cache["k_scale"], cache["v_scale"]
@@ -518,13 +543,20 @@ def _decode_chunk(params, cache, tokens, pos0, cfg: TransformerConfig,
                 cvs_all = _layer_slab_update(cvs_all, i, v_s, wr_pos[0])
                 cks, cvs = cks_all[i], cvs_all[i]
         else:
-            ck = _rows_update(ck_all[i], k, wr_pos)
-            cv = _rows_update(cv_all[i], v, wr_pos)
+            if win and t_len > 1:
+                # A multi-token ring chunk at divergent row positions
+                # can wrap mid-chunk: modular per-element scatter.
+                upd = lambda c, r: _rows_update_ring(c, r, pos0,
+                                                     cfg.max_len)
+            else:
+                upd = lambda c, r: _rows_update(c, r, wr_pos)
+            ck = upd(ck_all[i], k)
+            cv = upd(cv_all[i], v)
             new_k.append(ck)
             new_v.append(cv)
             if kv_q:
-                cks = _rows_update(cks_all[i], k_s, wr_pos)
-                cvs = _rows_update(cvs_all[i], v_s, wr_pos)
+                cks = upd(cks_all[i], k_s)
+                cvs = upd(cvs_all[i], v_s)
                 new_ks.append(cks)
                 new_vs.append(cvs)
 

@@ -42,6 +42,7 @@ from distkeras_tpu.models.generate import (
     _decode_chunk,
     init_cache,
     prefill,
+    rolling_eligible,
 )
 from distkeras_tpu.models.quant import is_quantized
 from distkeras_tpu.models.transformer import TransformerConfig
@@ -56,11 +57,6 @@ def _validate(params, draft_params, cfg, draft_cfg, p, max_new_tokens,
         raise ValueError(
             f"draft vocab_size {draft_cfg.vocab_size} != target "
             f"{cfg.vocab_size} — the models must share a tokenizer")
-    if cfg.attention_window is not None or draft_cfg.attention_window:
-        raise ValueError(
-            "speculative decoding supports full-cache configs only "
-            "(the sliding-window ring buffer's slot arithmetic is "
-            "per-scalar-position; use generate() for windowed configs)")
     if n_draft < 1:
         raise ValueError(f"n_draft must be >= 1, got {n_draft}")
     if max_new_tokens < 1:
@@ -71,15 +67,42 @@ def _validate(params, draft_params, cfg, draft_cfg, p, max_new_tokens,
     if temperature > 0 and key is None:
         raise ValueError("temperature sampling needs an explicit PRNG key")
     total = p + max_new_tokens
-    # The verify chunk reaches position cur + n_draft <= total - 1 +
-    # n_draft, so both caches need n_draft slots of slack past the
-    # generated length (no silent clamping — see _decode_chunk).
+    # Full-cache configs: the verify chunk reaches position cur +
+    # n_draft <= total - 1 + n_draft, so the cache needs n_draft slots
+    # of slack past the generated length (no silent clamping — see
+    # _decode_chunk).  Windowed configs (round-5): the ring absorbs
+    # any total, but (a) rolling past max_len needs rope + a fitting
+    # window (rolling_eligible — same bound as generate), (b) the
+    # prompt warm pass writes [0, p) without wrapping, and (c) the
+    # write-ahead window must satisfy window + n_draft + 1 <= max_len
+    # so a rejected tail's ring slots alias OUTSIDE every live query's
+    # band until real decoding overwrites them (the _decode_chunk
+    # chunk-fits-ring bound with T = n_draft + 1).
     for name, c in (("cfg", cfg), ("draft_cfg", draft_cfg)):
-        if total + n_draft > c.max_len:
+        if c.attention_window is None:
+            if total + n_draft > c.max_len:
+                raise ValueError(
+                    f"speculative decoding needs cache slack: "
+                    f"{name}.max_len={c.max_len} < prompt ({p}) + "
+                    f"max_new_tokens ({max_new_tokens}) + n_draft "
+                    f"({n_draft})")
+            continue
+        if c.attention_window + n_draft + 1 > c.max_len:
             raise ValueError(
-                f"speculative decoding needs cache slack: {name}.max_len="
-                f"{c.max_len} < prompt ({p}) + max_new_tokens "
-                f"({max_new_tokens}) + n_draft ({n_draft})")
+                f"speculative decoding on a ring cache needs "
+                f"{name}.attention_window ({c.attention_window}) + "
+                f"n_draft + 1 ({n_draft + 1}) <= max_len "
+                f"({c.max_len}): the verify chunk's rejected tail "
+                "must alias outside every live query's band")
+        if p > c.max_len:
+            raise ValueError(
+                f"prompt ({p}) exceeds {name}.max_len={c.max_len} "
+                "(the prompt warm pass cannot wrap the ring)")
+        if total + n_draft > c.max_len and not rolling_eligible(c):
+            raise ValueError(
+                f"speculative decoding past {name}.max_len={c.max_len} "
+                "rolls the ring cache, which needs rope=True and "
+                f"attention_window <= max_len (got rope={c.rope})")
     return total
 
 
@@ -135,6 +158,14 @@ def speculative_generate(params, draft_params, prompt, cfg: TransformerConfig,
     ``kv_int8=True`` stores BOTH models' caches int8 (generate's
     cache-byte lever; the per-row accept-divergence writes carry the
     scale leaves through the same row-update path).
+
+    Windowed configs compose (round-5): either model may run a
+    rope + ``attention_window`` ring cache — including ROLLING past
+    ``max_len`` — under ``window + n_draft + 1 <= max_len`` (verify
+    chunks write through _decode_chunk's modular ring scatter; the
+    bound keeps a rejected tail's slots outside every live query's
+    band).  Output parity with windowed ``generate`` is exact, wraps
+    included.
     """
     from distkeras_tpu.models.generate import _device_tree
 

@@ -1,0 +1,148 @@
+"""Minimal repro hunt for the serial-full-suite XLA-CPU segfault.
+
+Rounds 3 and 4 both saw a serial `pytest tests/ -q` run segfault inside
+``backend_compile`` late in the run (~85%, two DIFFERENT victim tests,
+each green standalone), while xdist with 4 workers (~110 tests/process)
+is reliably green.  Working theory: accumulated per-process XLA-CPU
+backend state, not any specific test.  This script is that theory with
+the test framework removed: ONE process compiles N structurally
+distinct programs (a mix of plain jits and 8-device shard_map/pjit
+steps with donation, shaped like the suite's trainers) until it
+crashes or hits the cap, reporting the compile count and RSS every
+``--report-every`` compiles.
+
+Usage:
+    python scripts/repro_xla_compile_crash.py [--cap 1500]
+        [--clear-every 0] [--mode mix|plain|mesh]
+
+``--clear-every K`` calls ``jax.clear_caches()`` every K compiles (the
+candidate mitigation); ``JAX_ENABLE_COMPILATION_CACHE=0`` in the env
+tests the other one.  Crash reporting: run it under a shell that
+prints the exit code; rc=139 = the repro fired.  Results land in
+docs/xla_cpu_compile_crash.md.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import faulthandler
+
+faulthandler.enable()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def rss_mb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) // 1024
+    return -1
+
+
+def plain_program(i):
+    """A structurally unique small jit: depth/width keyed on i."""
+    w = 4 + (i % 7)
+
+    def f(x, y):
+        for j in range(2 + i % 3):
+            x = jnp.tanh(x @ y) + float(i)
+        return x.sum()
+
+    return jax.jit(f), (jnp.ones((w, w)), jnp.ones((w, w)))
+
+
+def mesh_program(i):
+    """An 8-device shard_map train-step-shaped program with donation —
+    the suite's dominant compile shape."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                ("data", "model"))
+    w = 8 + 2 * (i % 5)
+    sh = NamedSharding(mesh, P("data", None))
+    rep = NamedSharding(mesh, P())
+
+    def step(params, x):
+        def loss(p):
+            h = jnp.tanh(x @ p) * (1.0 + i % 4)
+            return (h * h).mean()
+
+        g = jax.grad(loss)(params)
+        return params - 0.01 * g, loss(params)
+
+    f = jax.jit(step, in_shardings=(rep, sh), out_shardings=(rep, rep),
+                donate_argnums=0)
+    return f, (jnp.ones((w, w)), jnp.ones((8, w)))
+
+
+def transformer_program(i):
+    """A real repo train-step compile — the suite's dominant shape
+    (shard_map-free dp path, donation, remat every 3rd, MoE every
+    4th, packed segments every 5th) at a unique tiny size per i."""
+    import optax
+
+    from distkeras_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=64 + (i % 3) * 8, d_model=16 + 8 * (i % 2),
+        n_heads=2, n_layers=1 + i % 2, d_ff=32 + 16 * (i % 3),
+        max_len=17, rope=bool(i % 2), remat=(i % 3 == 0),
+        **({"num_experts": 2, "capacity_factor": 2.0}
+           if i % 4 == 0 else {}))
+    params = tfm.init_params(jax.random.key(i), cfg)
+    opt = optax.adam(1e-2)
+    step = jax.jit(tfm.make_train_step(cfg, opt), donate_argnums=0)
+    toks = jnp.ones((4, 17), jnp.int32)
+    seg = jnp.ones((4, 17), jnp.int32) if i % 5 == 0 else None
+    return step, ((params, opt.init(params)), toks, None, seg)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cap", type=int, default=1500)
+    ap.add_argument("--clear-every", type=int, default=0)
+    ap.add_argument("--report-every", type=int, default=100)
+    ap.add_argument("--mode", default="mix",
+                    choices=("mix", "plain", "mesh", "transformer"))
+    ap.add_argument("--drop-refs", action="store_true",
+                    help="let each compiled executable be GC'd (the "
+                    "suite keeps them alive, so default is keep)")
+    args = ap.parse_args()
+
+    print(f"pid={os.getpid()} mode={args.mode} cap={args.cap} "
+          f"clear_every={args.clear_every} drop_refs={args.drop_refs} "
+          f"comp_cache={os.environ.get('JAX_ENABLE_COMPILATION_CACHE')}",
+          flush=True)
+    keep = []
+    for i in range(1, args.cap + 1):
+        if args.mode == "transformer":
+            f, xs = transformer_program(i)
+        elif args.mode == "plain" or (args.mode == "mix" and i % 2):
+            f, xs = plain_program(i)
+        else:
+            f, xs = mesh_program(i)
+        out = f(*xs)
+        jax.block_until_ready(jax.tree.leaves(out)[0])
+        if not args.drop_refs:
+            keep.append(f)  # live executables accumulate, like pytest
+        if i % args.report_every == 0:
+            print(f"compiles={i} rss_mb={rss_mb()}", flush=True)
+        if args.clear_every and i % args.clear_every == 0:
+            jax.clear_caches()
+    print(f"SURVIVED {args.cap} compiles, rss_mb={rss_mb()}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

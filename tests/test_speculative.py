@@ -215,11 +215,18 @@ def test_validation_errors(rng):
     with pytest.raises(ValueError, match="vocab"):
         speculative_generate(params, draft, prompt, CFG,
                              dataclasses.replace(DRAFT, vocab_size=32), 4)
-    with pytest.raises(ValueError, match="full-cache"):
+    # Windowed configs are supported since round 5; their own bounds:
+    with pytest.raises(ValueError, match="rejected tail"):
         speculative_generate(
             params, draft, prompt,
-            dataclasses.replace(CFG, rope=True, attention_window=8),
-            DRAFT, 4)
+            dataclasses.replace(CFG, rope=True, attention_window=29,
+                                max_len=32),
+            DRAFT, 4, n_draft=4)  # 29 + 5 > 32
+    with pytest.raises(ValueError, match="rope"):
+        speculative_generate(
+            params, draft, prompt,
+            dataclasses.replace(CFG, attention_window=8, max_len=16),
+            DRAFT, 20, n_draft=2)  # rolls past max_len without rope
     with pytest.raises(ValueError, match="slack"):
         speculative_generate(params, draft, prompt, CFG, DRAFT, 26,
                              n_draft=4)  # 4+26+4 > 32
@@ -286,3 +293,58 @@ def test_speculative_kv_int8_greedy_matches_generate_kv_int8(rng):
                                       8, n_draft=3, kv_int8=True)
     np.testing.assert_array_equal(np.asarray(out), ref)
     assert float(stats["acceptance_rate"]) > 0.9  # self-draft
+
+
+# ------------------------------------------------------ windowed / rolling
+
+WIN = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, rope=True,
+                            attention_window=6, max_len=16)
+WIN_DRAFT = tfm.TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
+                                  n_layers=1, d_ff=32, rope=True,
+                                  attention_window=6, max_len=16)
+
+
+def test_windowed_greedy_matches_generate(rng):
+    """Speculative decoding on rope + attention_window ring caches
+    (round-5): greedy output equals windowed generate()'s, including
+    ROLLING past max_len — both models' rings wrap mid-run and the
+    verify chunks wrap mid-chunk."""
+    params, draft = _models(WIN, WIN_DRAFT)
+    prompt = jnp.asarray(rng.integers(1, 64, (2, 5)), jnp.int32)
+    out, stats = speculative_generate(params, draft, prompt, WIN,
+                                      WIN_DRAFT, 25, n_draft=3)
+    ref = generate(params, prompt, WIN, 25)   # 5 + 25 = 30 >> 16
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert float(stats["acceptance_rate"]) >= 0.0
+
+
+def test_windowed_mixed_draft_full_cache(rng):
+    """Target on a ring, draft on a full cache (each model's budget is
+    checked independently) — still exact vs windowed generate."""
+    big_draft = dataclasses.replace(WIN_DRAFT, attention_window=None,
+                                    max_len=40)
+    params, draft = _models(WIN, big_draft)
+    prompt = jnp.asarray(rng.integers(1, 64, (2, 4)), jnp.int32)
+    out, _ = speculative_generate(params, draft, prompt, WIN,
+                                  big_draft, 20, n_draft=3)
+    ref = generate(params, prompt, WIN, 20)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+@pytest.mark.parametrize("kv_int8", [False, True])
+def test_windowed_small_ring_matches_big_cache_sampled(rng, kv_int8):
+    """Sampled speculative decoding on a wrapping ring reproduces the
+    non-wrapping big-cache run EXACTLY (same key -> same logits ->
+    same accept/reject draws), with and without the int8 cache."""
+    big = dataclasses.replace(WIN, max_len=64)
+    big_d = dataclasses.replace(WIN_DRAFT, max_len=64)
+    params, draft = _models(big, big_d)
+    prompt = jnp.asarray(rng.integers(1, 64, (2, 5)), jnp.int32)
+    kw = dict(n_draft=3, temperature=0.8, key=jax.random.key(11),
+              kv_int8=kv_int8)
+    ref, _ = speculative_generate(params, draft, prompt, big, big_d,
+                                  25, **kw)
+    out, _ = speculative_generate(params, draft, prompt, WIN,
+                                  WIN_DRAFT, 25, **kw)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
