@@ -51,6 +51,15 @@ def rss_mb():
     return -1
 
 
+def map_count():
+    """Live mmap regions (vs /proc/sys/vm/max_map_count, default
+    65530): every live LLVM-JIT'd executable holds several mapped code
+    sections, so THIS — not RSS — is the resource a long-lived
+    compiling process exhausts."""
+    with open("/proc/self/maps") as f:
+        return sum(1 for _ in f)
+
+
 def plain_program(i):
     """A structurally unique small jit: depth/width keyed on i."""
     w = 4 + (i % 7)
@@ -138,10 +147,12 @@ def main():
         if not args.drop_refs:
             keep.append(f)  # live executables accumulate, like pytest
         if i % args.report_every == 0:
-            print(f"compiles={i} rss_mb={rss_mb()}", flush=True)
+            print(f"compiles={i} rss_mb={rss_mb()} maps={map_count()}",
+                  flush=True)
         if args.clear_every and i % args.clear_every == 0:
             jax.clear_caches()
-    print(f"SURVIVED {args.cap} compiles, rss_mb={rss_mb()}", flush=True)
+    print(f"SURVIVED {args.cap} compiles, rss_mb={rss_mb()} "
+          f"maps={map_count()}", flush=True)
 
 
 if __name__ == "__main__":

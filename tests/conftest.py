@@ -219,3 +219,24 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
         if key in MULTIPROCESS:
             item.add_marker(pytest.mark.multiprocess)
+
+
+# Every live XLA-CPU executable holds dozens-to-hundreds of LLVM-JIT
+# mmap sections, and jax's global caches keep every test's programs
+# alive for the whole run — a serial full run used to hit the kernel's
+# vm.max_map_count wall (~65k) at ~85% and SIGSEGV inside
+# backend_compile (root cause + repro: docs/xla_cpu_compile_crash.md).
+# Dropping the caches every 50 tests releases the maps (measured: map
+# count pinned flat vs linear growth to the wall) at the price of
+# recompiles across the boundary.  The xdist gate (-n 4) never gets
+# near the wall; this makes plain serial runs safe too.
+_TESTS_PER_CACHE_DROP = 50
+_test_tally = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _bound_llvm_jit_maps():
+    yield
+    _test_tally["n"] += 1
+    if _test_tally["n"] % _TESTS_PER_CACHE_DROP == 0:
+        jax.clear_caches()
