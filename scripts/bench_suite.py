@@ -655,11 +655,19 @@ def bench_lm_e2e(device_data):
     delta (docs/perf_input_pipeline.md round-5).  The per-step
     ``transformer_*`` rows feed ONE pre-staged device batch and so
     cannot see the host link at all; this pair trains on a real row
-    set through the public trainer API.  Wall time is the second
-    ``train()`` call in the process: the retrace is cheap and XLA's
-    in-process executable cache absorbs the compile, so both variants
-    pay the same fixed cost and the delta is the data plane."""
-    def run(batch=8, seq=1024, steps=30, cfg=None):
+    set through the public trainer API.
+
+    Timing is a DELTA of two train() calls (``steps`` vs
+    ``warm_steps`` rows, same shapes), after one DISCARDED warmup
+    call: the warmup absorbs process-level one-time costs (backend
+    init, first-compile cache seeding), and whatever per-call cost
+    remains — train() builds its jitted step from fresh closures, so
+    the compile is re-resolved per call, cached or not — lands
+    equally on both measured calls and cancels in the subtraction,
+    leaving steady-state step time + the per-row input plane (for
+    device_data that includes its share of the bulk staging transfer,
+    which is the thing being measured)."""
+    def run(batch=8, seq=1024, steps=64, warm_steps=4, cfg=None):
         import numpy as np
         from distkeras_tpu.trainers.lm import LMTrainer
 
@@ -668,17 +676,32 @@ def bench_lm_e2e(device_data):
         rows = rng.integers(0, cfg.vocab_size,
                             (batch * steps, seq + 1)).astype(np.int32)
 
-        def train_once():
+        def train_once(n):
             t = LMTrainer(cfg, learning_rate=3e-4, batch_size=batch,
                           num_epoch=1, device_data=device_data)
-            t.train(rows)
-            return t
+            t.train(rows[:batch * n])
+            return t.training_time
 
-        train_once()                      # compile + warm the exec cache
-        wall = train_once().training_time
-        return batch * steps * seq / wall, wall / steps, 0.0, {
-            "device_data": device_data, "steps": steps, "batch": batch,
-            "seq": seq, "e2e_wall_s": round(wall, 3)}
+        if steps <= warm_steps:
+            raise ValueError(
+                f"steps ({steps}) must exceed warm_steps ({warm_steps}) "
+                "— the delta IS the measurement")
+        train_once(warm_steps)            # discarded: one-time costs
+        wall_short = train_once(warm_steps)
+        wall_long = train_once(steps)
+        d_steps = steps - warm_steps
+        wall = wall_long - wall_short
+        if wall <= 0:
+            raise RuntimeError(
+                f"non-positive delta wall ({wall_long:.3f}s - "
+                f"{wall_short:.3f}s): per-call compile variance exceeds "
+                f"the {d_steps}-step term at these dims — raise steps "
+                "(chip dims resolve; toy CPU dims often cannot)")
+        return batch * d_steps * seq / wall, wall / d_steps, 0.0, {
+            "device_data": device_data, "steps_delta": d_steps,
+            "batch": batch, "seq": seq,
+            "e2e_wall_long_s": round(wall_long, 3),
+            "e2e_wall_short_s": round(wall_short, 3)}
     return run
 
 
