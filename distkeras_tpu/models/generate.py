@@ -659,7 +659,18 @@ def top_k_mask(logits, k: int, exact: bool = False):
     return jnp.where(logits < kth, -jnp.inf, logits)
 
 
-def min_p_mask(logits, min_p: float):
+def _validate_unit_interval(name, p):
+    """Range-check a sampling filter value when it is concretely
+    scalar (python/numpy scalars and 0-d arrays outside jit); per-row
+    arrays and tracers pass through — THEIR values are validated by
+    the caller (the serving engine's submit/constructor)."""
+    if isinstance(p, jax.core.Tracer) or np.ndim(p) != 0:
+        return
+    if not 0.0 < float(p) <= 1.0:
+        raise ValueError(f"{name} must be in (0, 1], got {p}")
+
+
+def min_p_mask(logits, min_p):
     """Keep tokens whose probability is at least ``min_p`` times the
     top token's probability; the rest go to -inf.
 
@@ -667,9 +678,12 @@ def min_p_mask(logits, min_p: float):
     model is uncertain (flat distribution -> many tokens clear the
     relative bar), strict when confident.  Static shapes; the top token
     always survives (ratio 1 >= min_p).
+
+    ``min_p`` may be a per-row ``[B, 1]`` array (the serving engine's
+    per-request path); a row of 0.0 is a no-op (log 0 = -inf keeps
+    everything) — array values are validated by the caller.
     """
-    if not 0.0 < min_p <= 1.0:
-        raise ValueError(f"min_p must be in (0, 1], got {min_p}")
+    _validate_unit_interval("min_p", min_p)
     # log p_i - log p_max >= log(min_p), computed on logits directly
     # (the softmax normalizer cancels in the difference).
     gap = logits - logits.max(axis=-1, keepdims=True)
@@ -682,9 +696,12 @@ def top_p_mask(logits, p: float):
 
     Sort-based with an exclusive cumulative sum, so the top token is
     always kept (exclusive mass 0 < p) — static shapes throughout.
+
+    ``p`` may be a per-row ``[B, 1]`` array (the serving engine's
+    per-request path); a row of 1.0 is a no-op — array values are
+    validated by the caller.
     """
-    if not 0.0 < p <= 1.0:
-        raise ValueError(f"top_p must be in (0, 1], got {p}")
+    _validate_unit_interval("top_p", p)
     sl = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
     probs = jax.nn.softmax(sl, axis=-1)
     exclusive = jnp.cumsum(probs, axis=-1) - probs

@@ -69,6 +69,7 @@ class _Lane:
     key: object          # per-request PRNG key (None for greedy)
     tokens: list         # host-side transcript, prompt included
     done: bool = False
+    eos: object = None   # per-request eos token (engine default)
 
 
 class ContinuousBatcher:
@@ -78,6 +79,17 @@ class ContinuousBatcher:
     ``top_k`` / ``top_p`` / ``min_p``, ``eos_token``, ``exact_top_k``
     — fixed per engine (they are compiled into the step).  Per-request
     PRNG keys arrive with ``submit``.
+
+    ``per_request_sampling=True`` compiles the vectorized step instead
+    (per-lane temperature/top_p/min_p carried as [lanes] device
+    arrays): ``submit`` then takes per-request ``temperature`` /
+    ``top_p`` / ``min_p`` / ``eos_token`` overrides — greedy and
+    sampled requests mix in one batch, each still matching its solo
+    ``generate`` run exactly.  The constructor values become the
+    per-request DEFAULTS.  Off by default because the general program
+    pays the nucleus sort and the sampling draw every step even for a
+    greedy-only workload; ``top_k`` stays engine-level either way (a
+    static shape baked into the program).
 
     ``lanes``: decode rows held by the engine; ``prompt_buckets``:
     admission pad widths (a prompt of length P uses the smallest
@@ -99,7 +111,8 @@ class ContinuousBatcher:
                  temperature: float = 0.0, top_k=None, top_p=None,
                  min_p=None, eos_token=None, exact_top_k: bool = False,
                  prompt_buckets=(8, 32, 128, 512), prompt_cache=None,
-                 kv_int8: bool = False):
+                 kv_int8: bool = False,
+                 per_request_sampling: bool = False):
         # Windowed configs: the engine runs ROLLING lanes — each lane
         # decodes past max_len on the ring-buffer cache (the unbounded
         # streaming-chat shape), which needs rope (positions beyond
@@ -126,10 +139,25 @@ class ContinuousBatcher:
             raise ValueError(
                 f"shared prefix length {prompt_cache[1]} must leave "
                 f"room under max_len={cfg.max_len}")
-        if temperature <= 0 and (top_k or top_p or min_p):
+        if (temperature <= 0 and (top_k or top_p or min_p)
+                and not per_request_sampling):
+            # With per-request sampling the constructor values are only
+            # DEFAULTS; a filter default alongside a greedy default
+            # temperature is legal (it applies to requests that
+            # override the temperature).
             raise ValueError(
                 "top_k/top_p/min_p need temperature > 0 (greedy always "
                 "takes the argmax)")
+        # Eager range checks: the scalar step validates these lazily at
+        # first trace, but the per-request path bakes them into device
+        # arrays where a bad value would sample silent garbage
+        # (log of a negative min_p is NaN, which masks every token).
+        if temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
+        for nm, v in (("top_p", top_p), ("min_p", min_p)):
+            if v is not None and not 0.0 < v <= 1.0:
+                raise ValueError(f"{nm} must be in (0, 1], got {v}")
         if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
             raise ValueError(
                 f"eos_token {eos_token} outside vocab [0, "
@@ -155,6 +183,8 @@ class ContinuousBatcher:
             self._prefix_lane = jax.tree.map(jnp.asarray, pc)
         self.eos_token = eos_token
         self.temperature = temperature
+        self.top_p = top_p
+        self.min_p = min_p
         # Buckets clamp to the cache slots past the shared prefix and
         # always include the largest legal width, so any prompt that
         # fits the budget has an admission program.
@@ -177,17 +207,47 @@ class ContinuousBatcher:
         # (Stored for introspection only, like ``lanes``; the runtime
         # switch is the ``k_scale`` leaf in ``self.cache``.)
         self.kv_int8 = kv_int8
+        self.per_request_sampling = per_request_sampling
         self.cache = init_cache(cfg, lanes, kv_int8=kv_int8)
         self.pos = jnp.zeros((lanes,), jnp.int32)
         self.cur = jnp.zeros((lanes,), jnp.int32)
-        self.keys = jnp.stack(
-            [jax.random.key(0)] * lanes) if temperature > 0 else None
+        sampling = temperature > 0 or per_request_sampling
+        self.keys = (jnp.stack([jax.random.key(0)] * lanes)
+                     if sampling else None)
+        # Per-lane sampling params (per_request_sampling only):
+        # constructor values are the defaults; submit() overrides the
+        # admitted lane's slots.  top_p 1.0 / min_p 0.0 are exact
+        # no-ops in the row-wise masks.
+        if per_request_sampling:
+            self.temps = jnp.full((lanes,), float(temperature))
+            self.tps = jnp.full((lanes,), float(top_p or 1.0))
+            self.mps = jnp.full((lanes,), float(min_p or 0.0))
+        else:
+            self.temps = self.tps = self.mps = None
 
-        def one_step(cache, cur, pos, keys):
+        def pick(k, row, q):
+            return jax.random.categorical(
+                jax.random.fold_in(k, q), row)
+
+        def one_step(cache, cur, pos, keys, temps, tps, mps):
             logits, cache = _decode_chunk(
                 self.params, cache, cur[:, None], pos, cfg)
             logits = logits[:, 0]                      # [lanes, V]
-            if temperature > 0:
+            if per_request_sampling:
+                # Vectorized per-lane params: greedy lanes (t <= 0)
+                # take the argmax of the RAW logits; the sampled draw
+                # is computed for every lane (one static program) and
+                # selected per lane.
+                safe_t = jnp.where(temps > 0, temps, 1.0)
+                scaled = logits / safe_t[:, None]
+                if top_k is not None:
+                    scaled = top_k_mask(scaled, top_k, exact=exact_top_k)
+                scaled = top_p_mask(scaled, tps[:, None])
+                scaled = min_p_mask(scaled, mps[:, None])
+                nxt = jnp.where(temps > 0,
+                                jax.vmap(pick)(keys, scaled, pos),
+                                logits.argmax(axis=-1))
+            elif temperature > 0:
                 scaled = logits / temperature
                 if top_k is not None:
                     scaled = top_k_mask(scaled, top_k, exact=exact_top_k)
@@ -195,11 +255,6 @@ class ContinuousBatcher:
                     scaled = top_p_mask(scaled, top_p)
                 if min_p is not None:
                     scaled = min_p_mask(scaled, min_p)
-
-                def pick(k, row, q):
-                    return jax.random.categorical(
-                        jax.random.fold_in(k, q), row)
-
                 nxt = jax.vmap(pick)(keys, scaled, pos)
             else:
                 nxt = logits.argmax(axis=-1)
@@ -221,10 +276,11 @@ class ContinuousBatcher:
             return cache, nxt.astype(jnp.int32), nxt_pos
 
         def make_step(n):
-            def step_n(cache, cur, pos, keys):
+            def step_n(cache, cur, pos, keys, temps, tps, mps):
                 def body(carry, _):
                     cache, cur, pos = carry
-                    cache, cur, pos = one_step(cache, cur, pos, keys)
+                    cache, cur, pos = one_step(cache, cur, pos, keys,
+                                               temps, tps, mps)
                     return (cache, cur, pos), cur
                 (cache, cur, pos), toks = jax.lax.scan(
                     body, (cache, cur, pos), None, length=n)
@@ -281,10 +337,19 @@ class ContinuousBatcher:
     def free_lanes(self):
         return [i for i, s in enumerate(self._lane_state) if s is None]
 
-    def submit(self, prompt, max_new_tokens: int, key=None):
+    def submit(self, prompt, max_new_tokens: int, key=None,
+               temperature=None, top_p=None, min_p=None, eos_token=None):
         """Admit one request; returns its lane id, or None if the
         engine is full.  ``prompt``: 1-D int tokens; ``key``: per-
-        request PRNG key (required iff the engine samples)."""
+        request PRNG key (required iff THIS request samples).
+
+        ``temperature`` / ``top_p`` / ``min_p`` / ``eos_token``:
+        per-request overrides of the engine defaults — engines built
+        with ``per_request_sampling=True`` only (``eos_token`` is
+        host-side bookkeeping and works on every engine).  Pass
+        ``top_p=1.0`` / ``min_p=0.0`` (the explicit no-op values) for
+        an unfiltered request on an engine whose default filters.
+        """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = prompt.size
         if p < 1:
@@ -292,6 +357,34 @@ class ContinuousBatcher:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if ((temperature is not None or top_p is not None
+             or min_p is not None) and not self.per_request_sampling):
+            raise ValueError(
+                "per-request temperature/top_p/min_p need "
+                "ContinuousBatcher(per_request_sampling=True) — the "
+                "default engine compiles the constructor's sampling "
+                "params into the step")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if min_p is not None and not 0.0 <= min_p <= 1.0:
+            # 0.0 is the explicit "no min-p filter" override.
+            raise ValueError(f"min_p must be in [0, 1], got {min_p}")
+        if temperature is not None and temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
+        if eos_token is not None and not (
+                0 <= eos_token < self.cfg.vocab_size):
+            raise ValueError(
+                f"eos_token {eos_token} outside vocab [0, "
+                f"{self.cfg.vocab_size})")
+        eff_t = self.temperature if temperature is None else temperature
+        if eff_t <= 0 and ((top_p is not None and top_p < 1.0)
+                           or (min_p is not None and min_p > 0.0)):
+            # The explicit no-op values (top_p=1.0 / min_p=0.0) stay
+            # legal on greedy requests — they turn a default filter OFF.
+            raise ValueError(
+                "per-request top_p/min_p need a sampling temperature "
+                f"(effective temperature is {eff_t})")
         if (not self._rolling
                 and self._off + p + max_new_tokens > self.cfg.max_len):
             # Rolling engines have no total-length cap: lanes decode
@@ -302,10 +395,10 @@ class ContinuousBatcher:
                 f"prefix ({self._off}) + prompt ({p}) + "
                 f"max_new_tokens ({max_new_tokens}) exceeds "
                 f"max_len={self.cfg.max_len}")
-        if (key is None) == (self.temperature > 0):
+        if (key is None) == (eff_t > 0):
             raise ValueError(
-                "pass a per-request key iff the engine samples "
-                f"(temperature={self.temperature})")
+                "pass a per-request key iff this request samples "
+                f"(effective temperature={eff_t})")
         free = self.free_lanes()
         if not free:
             return None
@@ -333,12 +426,19 @@ class ContinuousBatcher:
         # until the decode loop overwrites them.
         self.pos = self.pos.at[lane].set(self._off + warm)
         self.cur = self.cur.at[lane].set(int(prompt[-1]))
-        if self.keys is not None:
+        if self.keys is not None and key is not None:
             self.keys = self.keys.at[lane].set(key)
+        if self.per_request_sampling:
+            self.temps = self.temps.at[lane].set(float(eff_t))
+            self.tps = self.tps.at[lane].set(float(
+                (self.top_p or 1.0) if top_p is None else top_p))
+            self.mps = self.mps.at[lane].set(float(
+                (self.min_p or 0.0) if min_p is None else min_p))
 
         self._lane_state[lane] = _Lane(
             request_id=self._next_id, prompt_len=p,
-            max_new=max_new_tokens, key=key, tokens=list(prompt))
+            max_new=max_new_tokens, key=key, tokens=list(prompt),
+            eos=self.eos_token if eos_token is None else eos_token)
         self._next_id += 1
         return lane
 
@@ -363,10 +463,14 @@ class ContinuousBatcher:
             return {}
         if n not in self._steps:
             self._steps[n] = self._make_step(n)
+        filler = jnp.zeros((self.lanes,), jnp.float32)
         self.cache, self.cur, self.pos, toks = self._steps[n](
             self.cache, self.cur, self.pos,
             self.keys if self.keys is not None else jnp.zeros(
-                (self.lanes,), jnp.int32))
+                (self.lanes,), jnp.int32),
+            self.temps if self.temps is not None else filler,
+            self.tps if self.tps is not None else filler,
+            self.mps if self.mps is not None else filler)
         toks = np.asarray(toks)
         out = {}
         for lane, st in enumerate(self._lane_state):
@@ -377,8 +481,7 @@ class ContinuousBatcher:
                 st.tokens.append(int(tok))
                 emitted.append(int(tok))
                 budget = len(st.tokens) - st.prompt_len >= st.max_new
-                if budget or (self.eos_token is not None
-                              and tok == self.eos_token):
+                if budget or (st.eos is not None and tok == st.eos):
                     st.done = True
                     break
             out[lane] = emitted

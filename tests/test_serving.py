@@ -382,3 +382,80 @@ def test_kv_int8_rolling_engine_matches_rolling_generate(rng):
     out_b = run_to_done(eng, lb)
     np.testing.assert_array_equal(out_a, rsolo(pa, 30))
     np.testing.assert_array_equal(out_b, rsolo(pb, 20))
+
+
+def test_per_request_sampling_mixed_lanes(params, rng):
+    """per_request_sampling=True: greedy and differently-parameterized
+    sampled requests decode in ONE batch, each matching its solo
+    generate() run exactly (the vectorized per-lane params select per
+    row; no-op rows are bit-exact with the scalar path)."""
+    eng = ContinuousBatcher(params, CFG, lanes=4,
+                            per_request_sampling=True)
+    pa, pb, pc, pd = (rng.integers(0, 64, (5,)).astype(np.int32)
+                      for _ in range(4))
+    ka, kc, kd = (jax.random.key(i) for i in (41, 42, 43))
+    la = eng.submit(pa, 8, key=ka, temperature=0.8)
+    lb = eng.submit(pb, 8)                        # greedy default
+    lc = eng.submit(pc, 8, key=kc, temperature=1.0, top_p=0.9)
+    ld = eng.submit(pd, 8, key=kd, temperature=0.7, min_p=0.2)
+    outs = {ln: run_to_done(eng, ln) for ln in (la, lb, lc, ld)}
+    np.testing.assert_array_equal(
+        outs[la], solo(params, pa, 8, temperature=0.8, key=ka))
+    np.testing.assert_array_equal(outs[lb], solo(params, pb, 8))
+    np.testing.assert_array_equal(
+        outs[lc], solo(params, pc, 8, temperature=1.0, top_p=0.9,
+                       key=kc))
+    np.testing.assert_array_equal(
+        outs[ld], solo(params, pd, 8, temperature=0.7, min_p=0.2,
+                       key=kd))
+    # Lane reuse flips a sampled lane back to greedy cleanly.
+    le = eng.submit(pa, 6)
+    np.testing.assert_array_equal(run_to_done(eng, le),
+                                  solo(params, pa, 6))
+
+
+def test_per_request_eos_and_validation(params, rng):
+    """Per-request eos_token works on ANY engine (host-side
+    bookkeeping); param overrides need per_request_sampling=True and
+    keep generate()'s key/filter contracts per request."""
+    eng = ContinuousBatcher(params, CFG, lanes=2)
+    p = rng.integers(0, 64, (4,)).astype(np.int32)
+    base = solo(params, p, 10)
+    tok = int(base[len(p) + 2])           # emitted at the 3rd new slot
+    lane = eng.submit(p, 10, eos_token=tok)
+    out = run_to_done(eng, lane)
+    assert out[-1] == tok and len(out) <= len(base)
+    np.testing.assert_array_equal(out, base[:len(out)])
+
+    with pytest.raises(ValueError, match="per_request_sampling"):
+        eng.submit(p, 4, key=jax.random.key(0), temperature=0.5)
+    pr = ContinuousBatcher(params, CFG, lanes=2,
+                           per_request_sampling=True)
+    with pytest.raises(ValueError, match="iff this request samples"):
+        pr.submit(p, 4, temperature=0.5)  # samples but no key
+    with pytest.raises(ValueError, match="iff this request samples"):
+        pr.submit(p, 4, key=jax.random.key(0))  # greedy with key
+    with pytest.raises(ValueError, match="top_p/min_p need"):
+        pr.submit(p, 4, top_p=0.9)        # filter on a greedy request
+    with pytest.raises(ValueError, match="top_p must be"):
+        pr.submit(p, 4, key=jax.random.key(0), temperature=0.5,
+                  top_p=1.5)
+    # Sampling-default engine: a request can drop to greedy (no key).
+    sd = ContinuousBatcher(params, CFG, lanes=2, temperature=0.8,
+                           top_k=8, per_request_sampling=True)
+    ln = sd.submit(p, 6, temperature=0.0)
+    np.testing.assert_array_equal(run_to_done(sd, ln),
+                                  solo(params, p, 6))
+    # min_p=0.0 is the explicit OFF override for a filtering default.
+    fd = ContinuousBatcher(params, CFG, lanes=2, temperature=0.8,
+                           min_p=0.3, per_request_sampling=True)
+    k2 = jax.random.key(77)
+    ln2 = fd.submit(p, 6, key=k2, min_p=0.0)
+    np.testing.assert_array_equal(
+        run_to_done(fd, ln2),
+        solo(params, p, 6, temperature=0.8, key=k2))
+    # Bad constructor defaults fail eagerly (the per-request arrays
+    # would otherwise sample silent garbage).
+    with pytest.raises(ValueError, match="min_p must be"):
+        ContinuousBatcher(params, CFG, temperature=0.8, min_p=-0.5,
+                          per_request_sampling=True)
