@@ -155,9 +155,16 @@ class ContinuousBatcher:
         if temperature < 0:
             raise ValueError(
                 f"temperature must be >= 0, got {temperature}")
-        for nm, v in (("top_p", top_p), ("min_p", min_p)):
-            if v is not None and not 0.0 < v <= 1.0:
-                raise ValueError(f"{nm} must be in (0, 1], got {v}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        # Per-request engines accept min_p=0.0 as the default too —
+        # the same explicit "no filter" value submit() documents.
+        if min_p is not None and not 0.0 < min_p <= 1.0 and not (
+                per_request_sampling and min_p == 0.0):
+            raise ValueError(
+                f"min_p must be in "
+                f"{'[0, 1]' if per_request_sampling else '(0, 1]'}, "
+                f"got {min_p}")
         if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
             raise ValueError(
                 f"eos_token {eos_token} outside vocab [0, "
@@ -223,7 +230,15 @@ class ContinuousBatcher:
             self.tps = jnp.full((lanes,), float(top_p or 1.0))
             self.mps = jnp.full((lanes,), float(min_p or 0.0))
         else:
-            self.temps = self.tps = self.mps = None
+            # Placeholder args keep one step signature across modes
+            # (allocated once — step() is the latency-floor hot loop).
+            self.temps = self.tps = self.mps = jnp.zeros((lanes,),
+                                                         jnp.float32)
+        if self.keys is None:
+            self.keys = jnp.zeros((lanes,), jnp.int32)  # unused filler
+            self._keyed = False
+        else:
+            self._keyed = True
 
         def pick(k, row, q):
             return jax.random.categorical(
@@ -242,7 +257,14 @@ class ContinuousBatcher:
                 scaled = logits / safe_t[:, None]
                 if top_k is not None:
                     scaled = top_k_mask(scaled, top_k, exact=exact_top_k)
-                scaled = top_p_mask(scaled, tps[:, None])
+                # tps == 1.0 rows bypass the nucleus mask entirely:
+                # float cumsum can overshoot 1.0 and mask an
+                # underflowed-tail token that solo generate (which
+                # skips the mask when top_p is None) could sample —
+                # the bypass keeps the exact-parity contract.
+                # min_p's 0.0 no-op is exact as-is (log 0 = -inf).
+                scaled = jnp.where(tps[:, None] >= 1.0, scaled,
+                                   top_p_mask(scaled, tps[:, None]))
                 scaled = min_p_mask(scaled, mps[:, None])
                 nxt = jnp.where(temps > 0,
                                 jax.vmap(pick)(keys, scaled, pos),
@@ -426,7 +448,7 @@ class ContinuousBatcher:
         # until the decode loop overwrites them.
         self.pos = self.pos.at[lane].set(self._off + warm)
         self.cur = self.cur.at[lane].set(int(prompt[-1]))
-        if self.keys is not None and key is not None:
+        if self._keyed and key is not None:
             self.keys = self.keys.at[lane].set(key)
         if self.per_request_sampling:
             self.temps = self.temps.at[lane].set(float(eff_t))
@@ -463,14 +485,9 @@ class ContinuousBatcher:
             return {}
         if n not in self._steps:
             self._steps[n] = self._make_step(n)
-        filler = jnp.zeros((self.lanes,), jnp.float32)
         self.cache, self.cur, self.pos, toks = self._steps[n](
-            self.cache, self.cur, self.pos,
-            self.keys if self.keys is not None else jnp.zeros(
-                (self.lanes,), jnp.int32),
-            self.temps if self.temps is not None else filler,
-            self.tps if self.tps is not None else filler,
-            self.mps if self.mps is not None else filler)
+            self.cache, self.cur, self.pos, self.keys,
+            self.temps, self.tps, self.mps)
         toks = np.asarray(toks)
         out = {}
         for lane, st in enumerate(self._lane_state):

@@ -95,6 +95,26 @@ def main():
     print(f"{ok}/{len(requests)} requests continued their sequence")
     assert ok >= len(requests) - 1   # trained model, not a proof
 
+    # ---- per-request sampling ---------------------------------------
+    # One engine, one batch: a greedy request decodes next to a
+    # creative one (its own temperature/top_p) — each matches its solo
+    # generate() run exactly.
+    eng = dk.ContinuousBatcher(params, cfg, lanes=2,
+                               per_request_sampling=True)
+    prompt = requests[0]
+    greedy = eng.submit(prompt, 8)
+    key = jax.random.key(42)
+    creative = eng.submit(prompt, 8, key=key, temperature=1.2,
+                          top_p=0.9)
+    while eng.running():
+        eng.step()
+    g, c = eng.drain(greedy), eng.drain(creative)
+    print("greedy  :", np.asarray(g)[5:].tolist())
+    print("creative:", np.asarray(c)[5:].tolist())
+    ref = generate(params, prompt[None], cfg, 8, temperature=1.2,
+                   top_p=0.9, key=key)
+    assert (np.asarray(c) == np.asarray(ref)[0]).all()
+
 
 if __name__ == "__main__":
     main()

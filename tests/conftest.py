@@ -82,6 +82,7 @@ MULTIPROCESS = {
 
 SLOW = MULTIPROCESS | {
     "test_serving::test_engine_fuzz_schedule_matches_solo",
+    "test_serving::test_per_request_fuzz_schedule_matches_solo",
     "test_serving::test_staggered_admission_and_lane_reuse",
     "test_generate::test_beam_prompt_cache_matches_full_prompt",
     "test_generate::test_beam_ancestry_equals_physical_reorder",

@@ -459,3 +459,52 @@ def test_per_request_eos_and_validation(params, rng):
     with pytest.raises(ValueError, match="min_p must be"):
         ContinuousBatcher(params, CFG, temperature=0.8, min_p=-0.5,
                           per_request_sampling=True)
+
+
+def test_per_request_fuzz_schedule_matches_solo(params, rng):
+    """Property test: randomized arrivals x random per-request params
+    (greedy/temperature/top_p/min_p/eos mixes) on a
+    per_request_sampling engine — every request still equals its solo
+    generate() run."""
+    eng = ContinuousBatcher(params, CFG, lanes=3,
+                            per_request_sampling=True)
+    reqs = []           # (prompt, n, submit_kw, solo_kw)
+    for i in range(8):
+        p = rng.integers(1, 10)
+        prompt = rng.integers(0, 64, (p,)).astype(np.int32)
+        n = int(rng.integers(1, 32 - p))
+        kind = i % 4
+        if kind == 0:
+            sub, sol = {}, {}
+        elif kind == 1:
+            k = jax.random.key(100 + i)
+            sub = dict(key=k, temperature=0.8)
+            sol = dict(key=k, temperature=0.8)
+        elif kind == 2:
+            k = jax.random.key(100 + i)
+            sub = dict(key=k, temperature=1.1, top_p=0.85, eos_token=9)
+            sol = dict(key=k, temperature=1.1, top_p=0.85, eos_token=9)
+        else:
+            k = jax.random.key(100 + i)
+            sub = dict(key=k, temperature=0.6, min_p=0.25)
+            sol = dict(key=k, temperature=0.6, min_p=0.25)
+        reqs.append((prompt, n, sub, sol))
+    pending = list(range(len(reqs)))
+    lane_of, outs = {}, {}
+    while len(outs) < len(reqs):
+        while pending and eng.free_lanes():
+            rid = pending.pop(0)
+            prompt, n, sub, _ = reqs[rid]
+            lane_of[eng.submit(prompt, n, **sub)] = rid
+        eng.step(int(rng.integers(1, 4)))
+        for lane in list(lane_of):
+            if lane not in eng.running():
+                outs[lane_of.pop(lane)] = eng.drain(lane)
+    for rid, (prompt, n, _, sol) in enumerate(reqs):
+        ref = solo(params, prompt, n, **sol)
+        out = outs[rid]
+        np.testing.assert_array_equal(out, ref[:len(out)],
+                                      err_msg=f"request {rid}")
+        if len(out) < len(ref):   # eos truncation: tail is sticky fill
+            eos = sol["eos_token"]
+            assert out[-1] == eos and (ref[len(out):] == eos).all()
