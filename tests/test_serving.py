@@ -508,3 +508,25 @@ def test_per_request_fuzz_schedule_matches_solo(params, rng):
         if len(out) < len(ref):   # eos truncation: tail is sticky fill
             eos = sol["eos_token"]
             assert out[-1] == eos and (ref[len(out):] == eos).all()
+
+
+def test_per_request_sampling_on_rolling_lanes(rng):
+    """per_request_sampling composes with rolling ring lanes: a greedy
+    and a sampled request decode past max_len side by side, each
+    matching its solo rolling generate() run."""
+    rparams = tfm.init_params(jax.random.key(6), ROLL_CFG)
+    eng = ContinuousBatcher(rparams, ROLL_CFG, lanes=2,
+                            per_request_sampling=True)
+    pa = rng.integers(0, 64, (4,)).astype(np.int32)
+    pb = rng.integers(0, 64, (5,)).astype(np.int32)
+    kb = jax.random.key(33)
+    la = eng.submit(pa, 20)                        # greedy, wraps
+    lb = eng.submit(pb, 18, key=kb, temperature=0.9, top_p=0.9)
+    out_a = run_to_done(eng, la)
+    out_b = run_to_done(eng, lb)
+    np.testing.assert_array_equal(
+        out_a, np.asarray(generate(rparams, pa[None], ROLL_CFG, 20))[0])
+    np.testing.assert_array_equal(
+        out_b, np.asarray(generate(rparams, pb[None], ROLL_CFG, 18,
+                                   temperature=0.9, top_p=0.9,
+                                   key=kb))[0])
