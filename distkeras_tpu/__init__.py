@@ -76,7 +76,8 @@ from distkeras_tpu.data.transformers import (
     DenseTransformer,
 )
 from distkeras_tpu.checkpoint import CheckpointManager
-from distkeras_tpu.serving import ContinuousBatcher
+from distkeras_tpu.serving import (ContinuousBatcher,
+                                   SpeculativeBatcher)
 from distkeras_tpu.evaluators import (Evaluator, AccuracyEvaluator,
                                        PerplexityEvaluator)
 from distkeras_tpu.predictors import Predictor, ModelPredictor
@@ -136,5 +137,6 @@ __all__ = [
     "EnsembleTrainer",
     "LMTrainer",
     "ContinuousBatcher",
+    "SpeculativeBatcher",
     "LoRATrainer",
 ]

@@ -72,7 +72,80 @@ class _Lane:
     eos: object = None   # per-request eos token (engine default)
 
 
-class ContinuousBatcher:
+def _make_lane_admit(model_params, model_cfg, off=0, prefix_lane=None):
+    """ONE-lane admission program factory shared by both engines:
+    prefill ``rows`` (bucket-padded) into a single lane's cache slice,
+    seeded from ``prefix_lane`` (shared system prompt) or zeros — a
+    fresh occupant must never see the previous request's K/V beyond
+    its own positions.  Returns a jitted (cache, rows, lane) -> cache.
+    """
+    def admit(cache, rows, lane):
+        lane_cache = jax.tree.map(
+            lambda a: jax.lax.dynamic_slice_in_dim(a, lane, 1, axis=1),
+            cache)
+        if prefix_lane is not None:
+            # prefill() returns a full-max_len cache with the prefix
+            # slots filled and the rest zero — exactly the fresh-lane
+            # seed we need.
+            lane_cache = jax.tree.map(
+                lambda z, pre: pre.astype(z.dtype),
+                lane_cache, prefix_lane)
+        else:
+            lane_cache = jax.tree.map(jnp.zeros_like, lane_cache)
+        _, lane_cache = _decode_chunk(
+            model_params, lane_cache, rows,
+            jnp.full((1,), off, jnp.int32), model_cfg,
+            uniform_pos=True)
+        return jax.tree.map(
+            lambda a, u: jax.lax.dynamic_update_slice_in_dim(
+                a, u, lane, axis=1), cache, lane_cache)
+    return jax.jit(admit, donate_argnums=0)
+
+
+class _LaneEngine:
+    """Host-side lane machinery shared by the serving engines: the
+    lane table, free/running/drain, and the per-step emission loop
+    (append to the transcript, stop at budget or the lane's eos)."""
+
+    def free_lanes(self):
+        return [i for i, s in enumerate(self._lane_state) if s is None]
+
+    def running(self):
+        return [i for i, s in enumerate(self._lane_state)
+                if s is not None and not s.done]
+
+    def drain(self, lane):
+        """Return the finished lane's [prompt + generation] tokens and
+        free the lane; raises if the lane is still running."""
+        st = self._lane_state[lane]
+        if st is None:
+            raise ValueError(f"lane {lane} is empty")
+        if not st.done:
+            raise ValueError(f"lane {lane} is still decoding")
+        self._lane_state[lane] = None
+        return np.asarray(st.tokens, np.int32)
+
+    def _emit(self, lane_tokens):
+        """Feed each live lane's new tokens (``lane_tokens(lane)``)
+        through the transcript/budget/eos bookkeeping; returns the
+        ``{lane: [emitted...]}`` step result."""
+        out = {}
+        for lane, st in enumerate(self._lane_state):
+            if st is None or st.done:
+                continue
+            emitted = []
+            for tok in lane_tokens(lane):
+                st.tokens.append(int(tok))
+                emitted.append(int(tok))
+                budget = len(st.tokens) - st.prompt_len >= st.max_new
+                if budget or (st.eos is not None and tok == st.eos):
+                    st.done = True
+                    break
+            out[lane] = emitted
+        return out
+
+
+class ContinuousBatcher(_LaneEngine):
     """Lane-based continuous batching over one jitted decode step.
 
     Args mirror ``generate``'s sampling surface: ``temperature``,
@@ -311,38 +384,14 @@ class ContinuousBatcher:
 
         self._make_step, self._steps = make_step, {}
 
-        # Admission: prefill `width` positions of ONE lane from scratch
-        # (lane-sliced cache write; padded tail slots stay masked until
-        # the decode loop overwrites them).  One program per bucket.
-        def make_admit(width):
-            def admit(cache, rows, lane):
-                lane_cache = jax.tree.map(
-                    lambda a: jax.lax.dynamic_slice_in_dim(a, lane, 1,
-                                                           axis=1),
-                    cache)
-                # A fresh occupant must not see the previous request's
-                # K/V beyond its own positions; reseeding the lane
-                # (shared prefix, or zeros) makes staleness reasoning
-                # trivial.
-                if self._prefix_lane is not None:
-                    # prefill() returns a full-max_len cache with the
-                    # prefix slots filled and the rest zero — exactly
-                    # the fresh-lane seed we need.
-                    lane_cache = jax.tree.map(
-                        lambda z, pre: pre.astype(z.dtype),
-                        lane_cache, self._prefix_lane)
-                else:
-                    lane_cache = jax.tree.map(jnp.zeros_like, lane_cache)
-                _, lane_cache = _decode_chunk(
-                    self.params, lane_cache, rows,
-                    jnp.full((1,), self._off, jnp.int32), self.cfg,
-                    uniform_pos=True)
-                return jax.tree.map(
-                    lambda a, u: jax.lax.dynamic_update_slice_in_dim(
-                        a, u, lane, axis=1), cache, lane_cache)
-            return jax.jit(admit, donate_argnums=0)
-
-        self._admit = {w: make_admit(w) for w in self._buckets}
+        # Admission: prefill `width` positions of ONE lane (lane-sliced
+        # cache write; padded tail slots stay masked until the decode
+        # loop overwrites them).  One program per bucket, from the
+        # shared factory.
+        self._admit = {
+            w: _make_lane_admit(self.params, cfg, off=self._off,
+                                prefix_lane=self._prefix_lane)
+            for w in self._buckets}
 
         def reseed(cache, lane):
             """Copy the shared prefix into one lane (1-token prompts
@@ -355,9 +404,6 @@ class ContinuousBatcher:
         self._reseed = jax.jit(reseed, donate_argnums=0)
 
     # ------------------------------------------------------------ API
-
-    def free_lanes(self):
-        return [i for i, s in enumerate(self._lane_state) if s is None]
 
     def submit(self, prompt, max_new_tokens: int, key=None,
                temperature=None, top_p=None, min_p=None, eos_token=None):
@@ -489,32 +535,206 @@ class ContinuousBatcher:
             self.cache, self.cur, self.pos, self.keys,
             self.temps, self.tps, self.mps)
         toks = np.asarray(toks)
-        out = {}
-        for lane, st in enumerate(self._lane_state):
-            if st is None or st.done:
-                continue
-            emitted = []
-            for tok in toks[lane].tolist():
-                st.tokens.append(int(tok))
-                emitted.append(int(tok))
-                budget = len(st.tokens) - st.prompt_len >= st.max_new
-                if budget or (st.eos is not None and tok == st.eos):
-                    st.done = True
-                    break
-            out[lane] = emitted
-        return out
+        return self._emit(lambda lane: toks[lane].tolist())
 
-    def drain(self, lane):
-        """Return the finished lane's [prompt + generation] tokens and
-        free the lane; raises if the lane is still running."""
-        st = self._lane_state[lane]
-        if st is None:
-            raise ValueError(f"lane {lane} is empty")
-        if not st.done:
-            raise ValueError(f"lane {lane} is still decoding")
-        self._lane_state[lane] = None
-        return np.asarray(st.tokens, np.int32)
 
-    def running(self):
-        return [i for i, s in enumerate(self._lane_state)
-                if s is not None and not s.done]
+class SpeculativeBatcher(_LaneEngine):
+    """Draft-assisted continuous batching: every lane advances up to
+    ``n_draft + 1`` positions per device round-trip.
+
+    The lane/admission machinery is :class:`ContinuousBatcher`'s; the
+    step is one iteration of :func:`speculative_generate`'s body
+    vectorized over lanes at divergent positions — ``n_draft`` draft
+    proposals (the draft's first chunk is T=2, closing the
+    full-acceptance cache gap exactly like the solo loop), ONE target
+    verify chunk, per-lane greedy acceptance, and a per-lane advance
+    of ``accepted + 1`` tokens.  Rejected-tail cache writes land
+    beyond each lane's frontier and are masked until overwritten
+    (the _decode_chunk staleness argument), so lanes never interact.
+
+    Contract: every request's emitted tokens are EXACTLY its solo
+    greedy ``speculative_generate`` run's — which is itself exactly
+    ``generate``'s greedy rollout (the acceptance rule).  v1 scope:
+    greedy only, full-cache configs, no shared prefix (the sampled
+    acceptance rule and ring-cache garbage bounds each need their own
+    engine-side treatment; reject loudly rather than approximate).
+
+    Budget: a request needs ``prompt + max_new_tokens + n_draft <=
+    max_len`` on BOTH models (the verify chunk writes ``n_draft + 1``
+    slots past the frontier; same slack as the solo fn).  Finished
+    lanes keep decoding with their frontier clamped at the last
+    budget-safe position — outputs discarded, admission reseeds.
+    """
+
+    def __init__(self, params, draft_params, cfg: TransformerConfig,
+                 draft_cfg: TransformerConfig, lanes: int = 8,
+                 n_draft: int = 4, eos_token=None,
+                 prompt_buckets=(8, 32, 128, 512)):
+        if cfg.attention_window is not None or draft_cfg.attention_window:
+            raise ValueError(
+                "SpeculativeBatcher v1 supports full-cache configs "
+                "only (ring-cache speculative serving needs its own "
+                "garbage-aliasing bound per lane); use "
+                "speculative_generate for offline windowed runs or "
+                "ContinuousBatcher for rolling lanes")
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab_size {draft_cfg.vocab_size} != target "
+                f"{cfg.vocab_size} — the models must share a tokenizer")
+        if n_draft < 1:
+            raise ValueError(f"n_draft must be >= 1, got {n_draft}")
+        if lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes}")
+        if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
+            raise ValueError(
+                f"eos_token {eos_token} outside vocab [0, "
+                f"{cfg.vocab_size})")
+        self.params = _device_tree(params)
+        self.draft_params = _device_tree(draft_params)
+        self.cfg, self.draft_cfg = cfg, draft_cfg
+        self.lanes, self.n_draft = lanes, n_draft
+        self.eos_token = eos_token
+        # The verify chunk writes k+1 slots past the frontier on BOTH
+        # caches; bucket admission caps prompts the same way.
+        self._cap = min(cfg.max_len, draft_cfg.max_len) - n_draft - 1
+        self._buckets = tuple(sorted(
+            {min(int(w), self._cap) for w in prompt_buckets}
+            | {self._cap}))
+        self._lane_state: list[_Lane | None] = [None] * lanes
+        self._next_id = 0
+
+        self.tcache = init_cache(cfg, lanes)
+        self.dcache = init_cache(draft_cfg, lanes)
+        self.pos = jnp.zeros((lanes,), jnp.int32)   # last FINAL position
+        self.cur = jnp.zeros((lanes,), jnp.int32)   # token at pos
+        self.prev = jnp.zeros((lanes,), jnp.int32)  # token at pos - 1
+
+        k = n_draft
+        idx = jnp.arange(k + 1)
+        cap = jnp.int32(self._cap)
+
+        def step_fn(tcache, dcache, prev, cur, pos):
+            # ---- draft: first chunk T=2 rewrites [pos-1, pos] (the
+            # full-acceptance gap closure, exactly the solo body's).
+            pos0 = jnp.maximum(pos - 1, 0)
+            first = jnp.where(
+                (pos == 0)[:, None],
+                jnp.stack([cur, jnp.zeros_like(cur)], axis=1),
+                jnp.stack([prev, cur], axis=1))
+            lg2, dcache = _decode_chunk(self.draft_params, dcache,
+                                        first, pos0, draft_cfg)
+            lg = jnp.take_along_axis(
+                lg2, (pos - pos0)[:, None, None], axis=1)[:, 0]
+            d_toks = []
+            for j in range(k):
+                nxt = lg.argmax(axis=-1).astype(jnp.int32)
+                d_toks.append(nxt)
+                if j < k - 1:
+                    lgj, dcache = _decode_chunk(
+                        self.draft_params, dcache, nxt[:, None],
+                        pos + 1 + j, draft_cfg)
+                    lg = lgj[:, 0]
+            d = jnp.stack(d_toks, axis=1)               # [lanes, k]
+
+            # ---- one target verify chunk over [cur, d_1..d_k]
+            chunk = jnp.concatenate([cur[:, None], d], axis=1)
+            tlog, tcache = _decode_chunk(self.params, tcache, chunk,
+                                         pos, cfg)
+            t_pred = tlog.argmax(axis=-1).astype(jnp.int32)
+            match = d == t_pred[:, :k]
+            n = jnp.cumprod(match, axis=1).sum(axis=1)   # [lanes]
+            corrective = jnp.take_along_axis(t_pred, n[:, None],
+                                             axis=1)[:, 0]
+            d_ext = jnp.concatenate([d, d[:, -1:]], axis=1)
+            win = jnp.where(idx[None, :] < n[:, None], d_ext,
+                            corrective[:, None]).astype(jnp.int32)
+
+            # ---- advance: accepted + corrective, frontier clamped at
+            # the budget-safe cap (live lanes never reach it — submit
+            # guarantees total - 1 <= cap; clamped lanes spin and the
+            # host discards their output).
+            adv = jnp.where(pos >= cap, 0,
+                            jnp.minimum(n + 1, cap - pos)
+                            ).astype(jnp.int32)
+            new_pos = pos + adv
+            last = jnp.take_along_axis(
+                win, jnp.maximum(adv - 1, 0)[:, None], axis=1)[:, 0]
+            new_cur = jnp.where(adv > 0, last, cur)
+            second_last = jnp.take_along_axis(
+                win, jnp.maximum(adv - 2, 0)[:, None], axis=1)[:, 0]
+            new_prev = jnp.where(adv >= 2, second_last,
+                                 jnp.where(adv == 1, cur, prev))
+            return (tcache, dcache, new_prev, new_cur, new_pos, win,
+                    adv)
+
+        self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+
+        # Admission: one program per (bucket, model), from the shared
+        # factory (no shared-prefix support in v1).
+        self._admit_t = {w: _make_lane_admit(self.params, cfg)
+                         for w in self._buckets}
+        self._admit_d = {w: _make_lane_admit(self.draft_params,
+                                             draft_cfg)
+                         for w in self._buckets}
+
+    # -------------------------------------------------------------- API
+
+    def submit(self, prompt, max_new_tokens: int, eos_token=None):
+        """Admit one request; returns its lane id, or None if full."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = prompt.size
+        if p < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if p + max_new_tokens - 1 > self._cap:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) + "
+                f"n_draft ({self.n_draft}) exceeds "
+                f"max_len={min(self.cfg.max_len, self.draft_cfg.max_len)}"
+                " (the verify chunk needs n_draft + 1 slots of slack)")
+        if eos_token is not None and not (
+                0 <= eos_token < self.cfg.vocab_size):
+            raise ValueError(
+                f"eos_token {eos_token} outside vocab [0, "
+                f"{self.cfg.vocab_size})")
+        free = self.free_lanes()
+        if not free:
+            return None
+        lane = free[0]
+        warm = p - 1
+        if warm:
+            # The budget check above bounds warm < cap, and _buckets
+            # always contains cap, so a bucket always exists.
+            width = next(w for w in self._buckets if w >= warm)
+            rows = np.zeros((1, width), np.int32)
+            rows[0, :warm] = prompt[:-1]
+            rows_j = jnp.asarray(rows)
+            self.tcache = self._admit_t[width](self.tcache, rows_j,
+                                               jnp.int32(lane))
+            self.dcache = self._admit_d[width](self.dcache, rows_j,
+                                               jnp.int32(lane))
+        # else: stale slots stay masked until overwritten.
+        self.pos = self.pos.at[lane].set(p - 1)
+        self.cur = self.cur.at[lane].set(int(prompt[-1]))
+        self.prev = self.prev.at[lane].set(
+            int(prompt[-2]) if p > 1 else 0)
+        self._lane_state[lane] = _Lane(
+            request_id=self._next_id, prompt_len=p,
+            max_new=max_new_tokens, key=None, tokens=list(prompt),
+            eos=self.eos_token if eos_token is None else eos_token)
+        self._next_id += 1
+        return lane
+
+    def step(self):
+        """One draft+verify round for every lane; returns
+        ``{lane: [tokens...]}`` — up to ``n_draft + 1`` tokens per
+        lane per call."""
+        if all(s is None or s.done for s in self._lane_state):
+            return {}
+        (self.tcache, self.dcache, self.prev, self.cur, self.pos,
+         win, adv) = self._step(self.tcache, self.dcache, self.prev,
+                                self.cur, self.pos)
+        win, adv = np.asarray(win), np.asarray(adv)
+        return self._emit(lambda lane: win[lane, :adv[lane]].tolist())

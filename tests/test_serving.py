@@ -530,3 +530,71 @@ def test_per_request_sampling_on_rolling_lanes(rng):
         out_b, np.asarray(generate(rparams, pb[None], ROLL_CFG, 18,
                                    temperature=0.9, top_p=0.9,
                                    key=kb))[0])
+
+
+# ------------------------------------------------------ SpeculativeBatcher
+
+def test_speculative_batcher_matches_solo(params, rng):
+    """Draft-assisted lanes: each request's output is exactly its solo
+    greedy speculative_generate run (== generate's greedy rollout),
+    under staggered admission and lane reuse, with per-request eos."""
+    from distkeras_tpu.models.speculative import speculative_generate
+    from distkeras_tpu.serving import SpeculativeBatcher
+
+    draft_cfg = tfm.TransformerConfig(vocab_size=64, d_model=16,
+                                      n_heads=2, n_layers=1, d_ff=32,
+                                      max_len=32, rope=True)
+    draft = tfm.init_params(jax.random.key(9), draft_cfg)
+    eng = SpeculativeBatcher(params, draft, CFG, draft_cfg, lanes=2,
+                             n_draft=3)
+    pa = rng.integers(0, 64, (5,)).astype(np.int32)
+    pb = rng.integers(0, 64, (1,)).astype(np.int32)   # 1-token prompt
+    pc = rng.integers(0, 64, (7,)).astype(np.int32)
+
+    la = eng.submit(pa, 10)
+    eng.step()                            # A advances alone first
+    lb = eng.submit(pb, 8)                # admitted mid-flight
+    out_a = run_to_done(eng, la)
+    out_b = run_to_done(eng, lb)
+    lc = eng.submit(pc, 6, eos_token=9)   # reuses a freed lane
+    out_c = run_to_done(eng, lc)
+
+    def solo_spec(p, n, **kw):
+        out, _ = speculative_generate(params, draft, p[None], CFG,
+                                      draft_cfg, n, n_draft=3, **kw)
+        return np.asarray(out)[0]
+
+    np.testing.assert_array_equal(out_a, solo_spec(pa, 10))
+    np.testing.assert_array_equal(out_b, solo_spec(pb, 8))
+    ref_c = solo_spec(pc, 6, eos_token=9)
+    np.testing.assert_array_equal(out_c, ref_c[:len(out_c)])
+    if len(out_c) < len(ref_c):
+        assert out_c[-1] == 9 and (ref_c[len(out_c):] == 9).all()
+    assert lc in (la, lb)
+
+
+def test_speculative_batcher_validation(params, rng):
+    import dataclasses
+
+    from distkeras_tpu.serving import SpeculativeBatcher
+
+    draft_cfg = tfm.TransformerConfig(vocab_size=64, d_model=16,
+                                      n_heads=2, n_layers=1, d_ff=32,
+                                      max_len=32, rope=True)
+    draft = tfm.init_params(jax.random.key(9), draft_cfg)
+    p = rng.integers(0, 64, (4,)).astype(np.int32)
+    with pytest.raises(ValueError, match="full-cache"):
+        SpeculativeBatcher(params, draft,
+                           dataclasses.replace(CFG, attention_window=8),
+                           draft_cfg)
+    with pytest.raises(ValueError, match="vocab"):
+        SpeculativeBatcher(params, draft, CFG,
+                           dataclasses.replace(draft_cfg, vocab_size=32))
+    eng = SpeculativeBatcher(params, draft, CFG, draft_cfg, lanes=1,
+                             n_draft=3)
+    with pytest.raises(ValueError, match="slack"):
+        eng.submit(p, 26)                  # 4 + 26 + 3 > 32
+    assert eng.submit(p, 8) == 0
+    assert eng.submit(p, 8) is None        # full
+    with pytest.raises(ValueError, match="still decoding"):
+        eng.drain(0)
