@@ -386,12 +386,10 @@ class ContinuousBatcher(_LaneEngine):
 
         # Admission: prefill `width` positions of ONE lane (lane-sliced
         # cache write; padded tail slots stay masked until the decode
-        # loop overwrites them).  One program per bucket, from the
-        # shared factory.
-        self._admit = {
-            w: _make_lane_admit(self.params, cfg, off=self._off,
-                                prefix_lane=self._prefix_lane)
-            for w in self._buckets}
+        # loop overwrites them).  ONE jitted program — jax.jit
+        # specializes per bucket-padded rows shape on its own.
+        self._admit = _make_lane_admit(self.params, cfg, off=self._off,
+                                       prefix_lane=self._prefix_lane)
 
         def reseed(cache, lane):
             """Copy the shared prefix into one lane (1-token prompts
@@ -482,7 +480,7 @@ class ContinuousBatcher(_LaneEngine):
                     "prompt_buckets")
             rows = np.zeros((1, width), np.int32)
             rows[0, :warm] = prompt[:-1]
-            self.cache = self._admit[width](
+            self.cache = self._admit(
                 self.cache, jnp.asarray(rows), jnp.int32(lane))
         elif self._prefix_lane is not None:
             # 1-token prompt: no admission chunk runs, but the lane
@@ -669,13 +667,10 @@ class SpeculativeBatcher(_LaneEngine):
 
         self._step = jax.jit(step_fn, donate_argnums=(0, 1))
 
-        # Admission: one program per (bucket, model), from the shared
-        # factory (no shared-prefix support in v1).
-        self._admit_t = {w: _make_lane_admit(self.params, cfg)
-                         for w in self._buckets}
-        self._admit_d = {w: _make_lane_admit(self.draft_params,
-                                             draft_cfg)
-                         for w in self._buckets}
+        # Admission: one jitted program per MODEL (jit specializes per
+        # bucket-padded rows shape); no shared-prefix support in v1.
+        self._admit_t = _make_lane_admit(self.params, cfg)
+        self._admit_d = _make_lane_admit(self.draft_params, draft_cfg)
 
     # -------------------------------------------------------------- API
 
@@ -711,10 +706,10 @@ class SpeculativeBatcher(_LaneEngine):
             rows = np.zeros((1, width), np.int32)
             rows[0, :warm] = prompt[:-1]
             rows_j = jnp.asarray(rows)
-            self.tcache = self._admit_t[width](self.tcache, rows_j,
-                                               jnp.int32(lane))
-            self.dcache = self._admit_d[width](self.dcache, rows_j,
-                                               jnp.int32(lane))
+            self.tcache = self._admit_t(self.tcache, rows_j,
+                                        jnp.int32(lane))
+            self.dcache = self._admit_d(self.dcache, rows_j,
+                                        jnp.int32(lane))
         # else: stale slots stay masked until overwritten.
         self.pos = self.pos.at[lane].set(p - 1)
         self.cur = self.cur.at[lane].set(int(prompt[-1]))

@@ -45,6 +45,7 @@ say "stage 8 (round-5 additions): LM e2e input plane + int8 ring"
 python scripts/bench_suite.py lm_e2e_stream lm_e2e_device_data \
     2>>"$LOG" | tee -a "$LOG"
 python scripts/bench_serving.py decode_rolling_window_kvint8 \
+    engine_speculative \
     2>>"$LOG" | tee -a "$LOG"
 
 say "session complete — transcribe: python scripts/format_session.py $LOG"
