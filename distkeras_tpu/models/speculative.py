@@ -106,6 +106,37 @@ def _validate(params, draft_params, cfg, draft_cfg, p, max_new_tokens,
     return total
 
 
+def speculative_accept(p_logp, q_logp, d, u):
+    """The Leviathan/Chen acceptance + residual math, shared by the
+    solo loop and :class:`~distkeras_tpu.serving.SpeculativeBatcher`
+    (their draw KEYS differ — shared key per batch vs per-lane
+    iteration-keyed — but this math must stay bit-identical or the
+    engine's exact-parity contract silently breaks).
+
+    ``p_logp [B, k+1, V]`` target log-probs, ``q_logp [B, k, V]``
+    draft log-probs, ``d [B, k]`` draft tokens, ``u [B, k]`` uniform
+    draws.  Returns ``(n [B], corrective_logits [B, V])``: accepted
+    prefix lengths and the log-residual ``log(norm(max(p - q, 0)))``
+    at the first rejected position (past-the-end the residual reduces
+    to p itself — q padded with zeros; rs == 0 iff p == q, where
+    rejection has probability 0, but the normalizer is guarded)."""
+    k = q_logp.shape[1]
+    p_d = jnp.take_along_axis(p_logp[:, :k], d[..., None],
+                              axis=-1)[..., 0]
+    q_d = jnp.take_along_axis(q_logp, d[..., None], axis=-1)[..., 0]
+    accept = u < jnp.exp(jnp.minimum(p_d - q_d, 0.0))      # [B, k]
+    n = jnp.cumprod(accept, axis=1).sum(axis=1)            # [B]
+    p_n = jnp.take_along_axis(jnp.exp(p_logp), n[:, None, None],
+                              axis=1)[:, 0]                # [B, V]
+    q_pad = jnp.concatenate(
+        [jnp.exp(q_logp), jnp.zeros_like(q_logp[:, :1])], axis=1)
+    q_n = jnp.take_along_axis(q_pad, n[:, None, None], axis=1)[:, 0]
+    r = jnp.maximum(p_n - q_n, 0.0)
+    rs = r.sum(axis=-1, keepdims=True)
+    r = jnp.where(rs > 0, r / jnp.maximum(rs, 1e-30), p_n)
+    return n, jnp.log(r + 1e-30)
+
+
 def _warm_cache(model_params, model_cfg, buf, p, kv_int8=False):
     """Fill a cache for prompt positions 0..p-2 (position p-1 is
     re-processed by the first verify/draft chunk, like generate()'s
@@ -244,30 +275,13 @@ def speculative_generate(params, draft_params, prompt, cfg: TransformerConfig,
         if temperature > 0:
             p_logp = jax.nn.log_softmax(tlog / temperature, -1)  # [B,k+1,V]
             q_logp = jnp.stack(q_logps, axis=1)                  # [B,k,V]
-            p_d = jnp.take_along_axis(p_logp[:, :k], d[..., None],
-                                      axis=-1)[..., 0]
-            q_d = jnp.take_along_axis(q_logp, d[..., None],
-                                      axis=-1)[..., 0]
             u = jax.random.uniform(jax.random.fold_in(kit, k + 1), (b, k))
-            accept = u < jnp.exp(jnp.minimum(p_d - q_d, 0.0))    # [B, k]
-            n = jnp.cumprod(accept, axis=1).sum(axis=1)          # [B]
-            # Corrective draw: residual norm(max(p - q, 0)) at the first
-            # rejected position; past-the-end (n == k) the residual
-            # reduces to p itself (q padded with zeros).
-            p_n = jnp.take_along_axis(
-                jnp.exp(p_logp), n[:, None, None], axis=1)[:, 0]  # [B, V]
-            q_pad = jnp.concatenate(
-                [jnp.exp(q_logp), jnp.zeros_like(q_logp[:, :1])], axis=1)
-            q_n = jnp.take_along_axis(q_pad, n[:, None, None],
-                                      axis=1)[:, 0]
-            r = jnp.maximum(p_n - q_n, 0.0)
-            rs = r.sum(axis=-1, keepdims=True)
-            # rs == 0 iff p <= q everywhere, i.e. p == q: rejection has
-            # probability 0 there, but guard the normalizer anyway.
-            r = jnp.where(rs > 0, r / jnp.maximum(rs, 1e-30), p_n)
+            # Acceptance + residual: the ONE definition, shared with
+            # the serving engine (speculative_accept docstring).
+            n, corr_logits = speculative_accept(p_logp, q_logp, d, u)
             corrective = jax.random.categorical(
                 jax.random.fold_in(kit, k + 2),
-                jnp.log(r + 1e-30), axis=-1).astype(jnp.int32)
+                corr_logits, axis=-1).astype(jnp.int32)
         else:
             t_pred = tlog.argmax(axis=-1).astype(jnp.int32)      # [B, k+1]
             match = d == t_pred[:, :k]

@@ -58,6 +58,7 @@ from distkeras_tpu.models.generate import (
     top_k_mask,
     top_p_mask,
 )
+from distkeras_tpu.models.speculative import speculative_accept
 from distkeras_tpu.models.transformer import TransformerConfig
 
 
@@ -545,17 +546,21 @@ class SpeculativeBatcher(_LaneEngine):
     vectorized over lanes at divergent positions — ``n_draft`` draft
     proposals (the draft's first chunk is T=2, closing the
     full-acceptance cache gap exactly like the solo loop), ONE target
-    verify chunk, per-lane greedy acceptance, and a per-lane advance
-    of ``accepted + 1`` tokens.  Rejected-tail cache writes land
+    verify chunk, per-lane acceptance, and a per-lane advance of
+    ``accepted + 1`` tokens.  Rejected-tail cache writes land
     beyond each lane's frontier and are masked until overwritten
     (the _decode_chunk staleness argument), so lanes never interact.
 
     Contract: every request's emitted tokens are EXACTLY its solo
-    greedy ``speculative_generate`` run's — which is itself exactly
-    ``generate``'s greedy rollout (the acceptance rule).  v1 scope:
-    greedy only, full-cache configs, no shared prefix (the sampled
-    acceptance rule and ring-cache garbage bounds each need their own
-    engine-side treatment; reject loudly rather than approximate).
+    ``speculative_generate`` run's (batch 1, same key).  Greedy
+    (``temperature=0``) that is ``generate``'s greedy rollout;
+    sampled (engine-level ``temperature > 0``, per-request keys) it
+    is the Leviathan/Chen speculative-sampling rollout — each lane
+    carries its own iteration counter so its accept/corrective draws
+    replay the solo run's ``fold_in(key, iteration)`` stream exactly,
+    whenever the lane was admitted.  Scope: full-cache configs, no
+    shared prefix, no top-k/p filters (the solo fn has none either);
+    unsupported combinations reject loudly.
 
     Budget: a request needs ``prompt + max_new_tokens + n_draft <=
     max_len`` on BOTH models (the verify chunk writes ``n_draft + 1``
@@ -566,8 +571,8 @@ class SpeculativeBatcher(_LaneEngine):
 
     def __init__(self, params, draft_params, cfg: TransformerConfig,
                  draft_cfg: TransformerConfig, lanes: int = 8,
-                 n_draft: int = 4, eos_token=None,
-                 prompt_buckets=(8, 32, 128, 512)):
+                 n_draft: int = 4, temperature: float = 0.0,
+                 eos_token=None, prompt_buckets=(8, 32, 128, 512)):
         if cfg.attention_window is not None or draft_cfg.attention_window:
             raise ValueError(
                 "SpeculativeBatcher v1 supports full-cache configs "
@@ -583,6 +588,9 @@ class SpeculativeBatcher(_LaneEngine):
             raise ValueError(f"n_draft must be >= 1, got {n_draft}")
         if lanes < 1:
             raise ValueError(f"lanes must be >= 1, got {lanes}")
+        if temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
         if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
             raise ValueError(
                 f"eos_token {eos_token} outside vocab [0, "
@@ -591,6 +599,7 @@ class SpeculativeBatcher(_LaneEngine):
         self.draft_params = _device_tree(draft_params)
         self.cfg, self.draft_cfg = cfg, draft_cfg
         self.lanes, self.n_draft = lanes, n_draft
+        self.temperature = temperature
         self.eos_token = eos_token
         # The verify chunk writes k+1 slots past the frontier on BOTH
         # caches; bucket admission caps prompts the same way.
@@ -606,12 +615,20 @@ class SpeculativeBatcher(_LaneEngine):
         self.pos = jnp.zeros((lanes,), jnp.int32)   # last FINAL position
         self.cur = jnp.zeros((lanes,), jnp.int32)   # token at pos
         self.prev = jnp.zeros((lanes,), jnp.int32)  # token at pos - 1
+        # Sampled mode: per-lane request keys + per-lane ITERATION
+        # counters — a lane's draws are keyed fold_in(key, iter) like
+        # the solo loop's, so wherever the lane was admitted it
+        # replays its solo b=1 run's PRNG stream exactly (RNG bits are
+        # shape-row invariant: (V,) and (1, V) draws agree).
+        self.keys = jnp.stack([jax.random.key(0)] * lanes)
+        self.iters = jnp.zeros((lanes,), jnp.int32)
 
         k = n_draft
         idx = jnp.arange(k + 1)
         cap = jnp.int32(self._cap)
+        sampled = temperature > 0
 
-        def step_fn(tcache, dcache, prev, cur, pos):
+        def step_fn(tcache, dcache, prev, cur, pos, keys, iters):
             # ---- draft: first chunk T=2 rewrites [pos-1, pos] (the
             # full-acceptance gap closure, exactly the solo body's).
             pos0 = jnp.maximum(pos - 1, 0)
@@ -623,9 +640,18 @@ class SpeculativeBatcher(_LaneEngine):
                                         first, pos0, draft_cfg)
             lg = jnp.take_along_axis(
                 lg2, (pos - pos0)[:, None, None], axis=1)[:, 0]
-            d_toks = []
+            kit = jax.vmap(jax.random.fold_in)(keys, iters)
+            d_toks, q_logps = [], []
             for j in range(k):
-                nxt = lg.argmax(axis=-1).astype(jnp.int32)
+                if sampled:
+                    logp = jax.nn.log_softmax(lg / temperature, axis=-1)
+                    nxt = jax.vmap(
+                        lambda kk, row, _j=j: jax.random.categorical(
+                            jax.random.fold_in(kk, _j), row))(kit, logp)
+                    q_logps.append(logp)
+                else:
+                    nxt = lg.argmax(axis=-1)
+                nxt = nxt.astype(jnp.int32)
                 d_toks.append(nxt)
                 if j < k - 1:
                     lgj, dcache = _decode_chunk(
@@ -638,11 +664,27 @@ class SpeculativeBatcher(_LaneEngine):
             chunk = jnp.concatenate([cur[:, None], d], axis=1)
             tlog, tcache = _decode_chunk(self.params, tcache, chunk,
                                          pos, cfg)
-            t_pred = tlog.argmax(axis=-1).astype(jnp.int32)
-            match = d == t_pred[:, :k]
-            n = jnp.cumprod(match, axis=1).sum(axis=1)   # [lanes]
-            corrective = jnp.take_along_axis(t_pred, n[:, None],
-                                             axis=1)[:, 0]
+            if sampled:
+                # The Leviathan/Chen rule via the ONE shared
+                # definition (speculative.speculative_accept); only
+                # the draw keys differ from the solo loop — per-lane
+                # iteration-keyed so each lane replays its solo run.
+                p_logp = jax.nn.log_softmax(tlog / temperature, -1)
+                q_logp = jnp.stack(q_logps, axis=1)
+                u = jax.vmap(lambda kk: jax.random.uniform(
+                    jax.random.fold_in(kk, k + 1), (k,)))(kit)
+                n, corr_logits = speculative_accept(p_logp, q_logp,
+                                                    d, u)
+                corrective = jax.vmap(
+                    lambda kk, row: jax.random.categorical(
+                        jax.random.fold_in(kk, k + 2),
+                        row))(kit, corr_logits).astype(jnp.int32)
+            else:
+                t_pred = tlog.argmax(axis=-1).astype(jnp.int32)
+                match = d == t_pred[:, :k]
+                n = jnp.cumprod(match, axis=1).sum(axis=1)   # [lanes]
+                corrective = jnp.take_along_axis(t_pred, n[:, None],
+                                                 axis=1)[:, 0]
             d_ext = jnp.concatenate([d, d[:, -1:]], axis=1)
             win = jnp.where(idx[None, :] < n[:, None], d_ext,
                             corrective[:, None]).astype(jnp.int32)
@@ -662,8 +704,8 @@ class SpeculativeBatcher(_LaneEngine):
                 win, jnp.maximum(adv - 2, 0)[:, None], axis=1)[:, 0]
             new_prev = jnp.where(adv >= 2, second_last,
                                  jnp.where(adv == 1, cur, prev))
-            return (tcache, dcache, new_prev, new_cur, new_pos, win,
-                    adv)
+            return (tcache, dcache, new_prev, new_cur, new_pos,
+                    iters + 1, win, adv)
 
         self._step = jax.jit(step_fn, donate_argnums=(0, 1))
 
@@ -674,8 +716,11 @@ class SpeculativeBatcher(_LaneEngine):
 
     # -------------------------------------------------------------- API
 
-    def submit(self, prompt, max_new_tokens: int, eos_token=None):
-        """Admit one request; returns its lane id, or None if full."""
+    def submit(self, prompt, max_new_tokens: int, key=None,
+               eos_token=None):
+        """Admit one request; returns its lane id, or None if full.
+        ``key``: per-request PRNG key (required iff the engine
+        samples, i.e. ``temperature > 0``)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = prompt.size
         if p < 1:
@@ -683,6 +728,10 @@ class SpeculativeBatcher(_LaneEngine):
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if (key is None) == (self.temperature > 0):
+            raise ValueError(
+                "pass a per-request key iff the engine samples "
+                f"(temperature={self.temperature})")
         if p + max_new_tokens - 1 > self._cap:
             raise ValueError(
                 f"prompt ({p}) + max_new_tokens ({max_new_tokens}) + "
@@ -715,9 +764,12 @@ class SpeculativeBatcher(_LaneEngine):
         self.cur = self.cur.at[lane].set(int(prompt[-1]))
         self.prev = self.prev.at[lane].set(
             int(prompt[-2]) if p > 1 else 0)
+        if key is not None:
+            self.keys = self.keys.at[lane].set(key)
+        self.iters = self.iters.at[lane].set(0)
         self._lane_state[lane] = _Lane(
             request_id=self._next_id, prompt_len=p,
-            max_new=max_new_tokens, key=None, tokens=list(prompt),
+            max_new=max_new_tokens, key=key, tokens=list(prompt),
             eos=self.eos_token if eos_token is None else eos_token)
         self._next_id += 1
         return lane
@@ -729,7 +781,8 @@ class SpeculativeBatcher(_LaneEngine):
         if all(s is None or s.done for s in self._lane_state):
             return {}
         (self.tcache, self.dcache, self.prev, self.cur, self.pos,
-         win, adv) = self._step(self.tcache, self.dcache, self.prev,
-                                self.cur, self.pos)
+         self.iters, win, adv) = self._step(
+            self.tcache, self.dcache, self.prev, self.cur, self.pos,
+            self.keys, self.iters)
         win, adv = np.asarray(win), np.asarray(adv)
         return self._emit(lambda lane: win[lane, :adv[lane]].tolist())

@@ -598,3 +598,42 @@ def test_speculative_batcher_validation(params, rng):
     assert eng.submit(p, 8) is None        # full
     with pytest.raises(ValueError, match="still decoding"):
         eng.drain(0)
+
+
+def test_speculative_batcher_sampled_matches_solo(params, rng):
+    """Sampled speculative lanes: per-lane iteration-keyed draws
+    replay each request's solo b=1 sampled speculative_generate run
+    exactly, regardless of when the lane was admitted."""
+    from distkeras_tpu.models.speculative import speculative_generate
+    from distkeras_tpu.serving import SpeculativeBatcher
+
+    draft_cfg = tfm.TransformerConfig(vocab_size=64, d_model=16,
+                                      n_heads=2, n_layers=1, d_ff=32,
+                                      max_len=32, rope=True)
+    draft = tfm.init_params(jax.random.key(9), draft_cfg)
+    eng = SpeculativeBatcher(params, draft, CFG, draft_cfg, lanes=2,
+                             n_draft=3, temperature=0.8)
+    pa = rng.integers(0, 64, (5,)).astype(np.int32)
+    pb = rng.integers(0, 64, (3,)).astype(np.int32)
+    ka, kb = jax.random.key(51), jax.random.key(52)
+    la = eng.submit(pa, 10, key=ka)
+    eng.step()                            # A ahead by one round
+    lb = eng.submit(pb, 8, key=kb)        # admitted mid-flight
+    out_a = run_to_done(eng, la)
+    out_b = run_to_done(eng, lb)
+
+    def solo(p, n, key):
+        out, _ = speculative_generate(params, draft, p[None], CFG,
+                                      draft_cfg, n, n_draft=3,
+                                      temperature=0.8, key=key)
+        return np.asarray(out)[0]
+
+    np.testing.assert_array_equal(out_a, solo(pa, 10, ka))
+    np.testing.assert_array_equal(out_b, solo(pb, 8, kb))
+
+    with pytest.raises(ValueError, match="key iff"):
+        eng.submit(pa, 4)                 # sampling engine, no key
+    greedy = SpeculativeBatcher(params, draft, CFG, draft_cfg,
+                                lanes=1, n_draft=2)
+    with pytest.raises(ValueError, match="key iff"):
+        greedy.submit(pa, 4, key=ka)      # greedy engine with key
