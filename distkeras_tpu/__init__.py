@@ -76,6 +76,9 @@ from distkeras_tpu.data.transformers import (
     DenseTransformer,
 )
 from distkeras_tpu.checkpoint import CheckpointManager
+from distkeras_tpu.resilience import (EngineClosed, FaultPlan, Preempted,
+                                       QueueFull, RequestResult,
+                                       Supervisor)
 from distkeras_tpu.serving import (ContinuousBatcher,
                                    SpeculativeBatcher)
 from distkeras_tpu.evaluators import (Evaluator, AccuracyEvaluator,
@@ -121,6 +124,12 @@ __all__ = [
     "ReshapeTransformer",
     "DenseTransformer",
     "CheckpointManager",
+    "EngineClosed",
+    "FaultPlan",
+    "Preempted",
+    "QueueFull",
+    "RequestResult",
+    "Supervisor",
     "Evaluator",
     "AccuracyEvaluator",
     "PerplexityEvaluator",

@@ -1,43 +1,148 @@
-"""Checkpoint / resume (orbax-backed) — a capability the reference lacks.
+"""Checkpoint / resume — a capability the reference lacks.
 
 SURVEY.md §5: the reference has **no** mid-training checkpointing; a
 model survives only by being serialized back to the Spark driver after
 training completes, and the parameter server is a single point of
 failure.  The TPU rebuild's failure story is checkpoint/restart: the
 whole training state (parameters, optimizer state, step counter — any
-pytree) is written asynchronously by orbax while the next step runs,
-and restored sharding-aware onto the mesh.
+pytree) is written by a pluggable backend and restored sharding-aware
+onto the mesh.
+
+Two backends behind one :class:`CheckpointManager` surface:
+
+- ``"orbax"`` — the production path: async, multi-host, sharded saves
+  via ``orbax.checkpoint``.
+- ``"pickle"`` — a pure-stdlib single-host fallback: synchronous
+  atomic writes (tmp dir + ``os.replace``), the same integer-step
+  directory layout and refuse-to-overwrite semantics.  Exists so the
+  resilience machinery (and its tests) runs on any box, orbax
+  installed or not.
+
+``backend="auto"`` (the default) picks orbax when importable and falls
+back to pickle otherwise; asking for ``"orbax"`` explicitly without the
+package raises a clear ImportError instead of the bare lazy-import
+traceback.
 
 Kept deliberately kwargs-first (no config system — SURVEY.md §5):
 trainers grow ``checkpoint_dir`` / ``checkpoint_every`` / ``resume``
 constructor knobs and everything else is defaulted.
+
+Every save passes through the ``"checkpoint.save"`` chaos probe
+(resilience/chaos.py), so fault-injection plans can make persistence
+fail exactly like a flaky filesystem would.
 """
 
 from __future__ import annotations
 
 import os
+import pickle
+import shutil
 from typing import Any
 
 import jax
 
+from distkeras_tpu.resilience import chaos
+
+BACKENDS = ("auto", "orbax", "pickle")
+
 
 class CheckpointManager:
-    """Thin wrapper over ``orbax.checkpoint.CheckpointManager``.
+    """Save/restore arbitrary pytrees (TrainState, stacked replica
+    states, ...) under integer step numbers.
 
-    Saves arbitrary pytrees (TrainState, stacked replica states, ...)
-    under integer step numbers.  Restores take a *template* pytree —
-    the live, correctly-sharded state — so restored arrays land with
-    the template's shardings (device-resident, mesh-aware).
+    Restores take a *template* pytree — the live, correctly-sharded
+    state — so restored arrays land with the template's shardings
+    (device-resident, mesh-aware).
+
+    ``backend``: ``"auto"`` / ``"orbax"`` / ``"pickle"`` (see module
+    docstring); the resolved choice is readable as ``self.backend``.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 save_interval_steps: int = 1, async_save: bool = True):
-        import orbax.checkpoint as ocp
-
-        self._ocp = ocp
+                 save_interval_steps: int = 1, async_save: bool = True,
+                 backend: str = "auto"):
+        if backend not in BACKENDS:
+            raise ValueError(
+                f"unknown checkpoint backend {backend!r}; known: {BACKENDS}")
         self.directory = os.path.abspath(directory)
+        ocp = None
+        if backend in ("auto", "orbax"):
+            try:
+                import orbax.checkpoint as ocp
+            except ImportError as e:
+                if backend == "orbax":
+                    raise ImportError(
+                        "checkpoint backend 'orbax' needs the "
+                        "orbax-checkpoint package (pip install "
+                        "orbax-checkpoint); for single-host runs "
+                        "without it, pass backend='pickle' (or leave "
+                        "backend='auto' to fall back automatically)"
+                    ) from e
+        if ocp is not None:
+            self._impl = _OrbaxBackend(
+                ocp, self.directory, max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                async_save=async_save)
+            self.backend = "orbax"
+        else:
+            self._impl = _PickleBackend(
+                self.directory, max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps)
+            self.backend = "pickle"
+
+    # ------------------------------------------------------------------ ops
+
+    def save(self, state: Any, step: int, force: bool = False) -> bool:
+        """Persist ``state`` under ``step``.  Async (orbax): returns
+        immediately.  Respects ``save_interval_steps`` unless ``force``.
+        Returns whether a save was actually started.
+        """
+        chaos.probe("checkpoint.save", step=step)
+        return self._impl.save(state, step, force)
+
+    def restore(self, template: Any, step: int | None = None) -> Any:
+        """Restore the checkpoint at ``step`` (default: latest).
+
+        ``template`` supplies structure, dtypes and shardings; restored
+        arrays are placed accordingly (sharded loads go straight to the
+        right devices — no host-side full-model materialization on the
+        orbax path).
+        """
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        return self._impl.restore(template, step)
+
+    def latest_step(self) -> int | None:
+        return self._impl.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._impl.all_steps())
+
+    def wait_until_finished(self) -> None:
+        """Block until outstanding async saves hit disk."""
+        self._impl.wait_until_finished()
+
+    def close(self) -> None:
+        self._impl.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _OrbaxBackend:
+    """Thin wrapper over ``orbax.checkpoint.CheckpointManager``."""
+
+    def __init__(self, ocp, directory, *, max_to_keep, save_interval_steps,
+                 async_save):
+        self._ocp = ocp
         self._mngr = ocp.CheckpointManager(
-            self.directory,
+            directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
@@ -45,51 +150,117 @@ class CheckpointManager:
             ),
         )
 
-    # ------------------------------------------------------------------ ops
-
-    def save(self, state: Any, step: int, force: bool = False) -> bool:
-        """Persist ``state`` under ``step``.  Async: returns immediately.
-
-        Respects ``save_interval_steps`` unless ``force``.  Returns
-        whether a save was actually started.
-        """
+    def save(self, state, step, force):
         return self._mngr.save(
             step, args=self._ocp.args.StandardSave(state), force=force)
 
-    def restore(self, template: Any, step: int | None = None) -> Any:
-        """Restore the checkpoint at ``step`` (default: latest).
-
-        ``template`` supplies structure, dtypes and shardings; restored
-        arrays are placed accordingly (sharded loads go straight to the
-        right devices — no host-side full-model materialization).
-        """
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(
-                f"no checkpoint found under {self.directory}")
+    def restore(self, template, step):
         abstract = jax.tree.map(_abstractify, template)
         return self._mngr.restore(
             step, args=self._ocp.args.StandardRestore(abstract))
 
-    def latest_step(self) -> int | None:
+    def latest_step(self):
         return self._mngr.latest_step()
 
-    def all_steps(self) -> list[int]:
-        return sorted(self._mngr.all_steps())
+    def all_steps(self):
+        return self._mngr.all_steps()
 
-    def wait_until_finished(self) -> None:
-        """Block until outstanding async saves hit disk."""
+    def wait_until_finished(self):
         self._mngr.wait_until_finished()
 
-    def close(self) -> None:
+    def close(self):
         self._mngr.close()
 
-    def __enter__(self):
-        return self
 
-    def __exit__(self, *exc):
-        self.close()
+class _PickleBackend:
+    """Pure-stdlib single-host checkpointing.
+
+    Same on-disk contract as orbax where the rest of the stack can see
+    it: integer-named step directories committed atomically (write to a
+    hidden tmp dir, then ``os.replace`` — a crash mid-write leaves no
+    integer-named dir, so a partial save is never restored), saves
+    refuse to overwrite an existing step, and ``max_to_keep`` garbage-
+    collects the oldest steps.  Saves are synchronous:
+    ``wait_until_finished`` is a no-op because ``save`` only returns
+    once the rename committed.
+
+    Single-host only: leaves are materialized via ``np.asarray``, which
+    would gather a multi-host sharded array; the manager's restore
+    re-places each leaf with the template leaf's sharding.
+    """
+
+    def __init__(self, directory, *, max_to_keep, save_interval_steps):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        self.save_interval_steps = save_interval_steps
+        if jax.process_count() > 1:
+            raise ValueError(
+                "the pickle checkpoint backend is single-host only "
+                "(leaves are materialized on this host); multi-host "
+                "runs need backend='orbax'")
+        os.makedirs(directory, exist_ok=True)
+
+    def save(self, state, step, force):
+        import numpy as np
+
+        if not force and self.save_interval_steps > 1 \
+                and step % self.save_interval_steps:
+            return False
+        final = os.path.join(self.directory, str(step))
+        if os.path.isdir(final):
+            raise ValueError(
+                f"checkpoint step {step} already exists under "
+                f"{self.directory} (steps are immutable once committed)")
+        host = jax.tree.map(
+            lambda x: np.asarray(x) if hasattr(x, "shape") else x, state)
+        tmp = os.path.join(self.directory, f".tmp.{step}.{os.getpid()}")
+        os.makedirs(tmp, exist_ok=True)
+        try:
+            with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+                pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, final)  # the commit point
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        self._gc()
+        return True
+
+    def _gc(self):
+        steps = sorted(self.all_steps())
+        for s in steps[:max(0, len(steps) - self.max_to_keep)]:
+            shutil.rmtree(os.path.join(self.directory, str(s)),
+                          ignore_errors=True)
+
+    def restore(self, template, step):
+        path = os.path.join(self.directory, str(step), "state.pkl")
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"no checkpoint for step {step} under {self.directory}")
+        with open(path, "rb") as f:
+            loaded = pickle.load(f)
+
+        def place(t, v):
+            if hasattr(v, "shape") and hasattr(t, "shape"):
+                return jax.device_put(v, getattr(t, "sharding", None))
+            return v
+
+        return jax.tree.map(place, template, loaded)
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return max(steps) if steps else None
+
+    def all_steps(self):
+        if not os.path.isdir(self.directory):
+            return []
+        return [int(e) for e in os.listdir(self.directory) if e.isdigit()]
+
+    def wait_until_finished(self):
+        pass  # saves are synchronous
+
+    def close(self):
+        pass
 
 
 def _abstractify(x):

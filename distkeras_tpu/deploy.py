@@ -68,16 +68,35 @@ class Job:
         """One launch command per host (feed to your ssh fan-out)."""
         return [self.command_for(h) for h in range(self.num_hosts)]
 
-    def run_local(self, check: bool = True) -> subprocess.CompletedProcess:
-        """Run the single-host case as a subprocess (dev workflow)."""
+    def run_local(self, check: bool = True,
+                  timeout: float | None = None) -> subprocess.CompletedProcess:
+        """Run the single-host case as a subprocess (dev workflow).
+
+        ``timeout``: seconds before the child is killed and
+        ``TimeoutError`` raised (None = wait forever).  A nonzero exit
+        propagates as ``RuntimeError`` naming the script and returncode
+        (``check=False`` restores the inspect-the-CompletedProcess
+        escape hatch) — a dev-loop job that failed must never read as
+        success.
+        """
         if self.num_hosts != 1:
             raise ValueError(
                 f"run_local is for num_hosts=1 jobs; this job has "
                 f"{self.num_hosts} hosts — use command_lines() with your "
                 "cluster's ssh fan-out")
-        return subprocess.run(
-            [sys.executable, self.script, *map(str, self.args)],
-            env={**os.environ, **self.env_for(0)}, check=check)
+        try:
+            proc = subprocess.run(
+                [sys.executable, self.script, *map(str, self.args)],
+                env={**os.environ, **self.env_for(0)}, timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            raise TimeoutError(
+                f"job {self.script!r} did not finish within "
+                f"{timeout}s (child killed)") from e
+        if check and proc.returncode != 0:
+            raise RuntimeError(
+                f"job {self.script!r} exited with returncode "
+                f"{proc.returncode}")
+        return proc
 
 
 def init_from_env() -> None:

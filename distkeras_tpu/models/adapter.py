@@ -96,9 +96,17 @@ class ModelAdapter:
     # ---------------------------------------------------------------- state
 
     def init_state(self) -> TrainState:
-        """Snapshot the Keras variables into a fresh TrainState."""
-        tv = [jnp.asarray(v.value) for v in self.model.trainable_variables]
-        ntv = [jnp.asarray(v.value) for v in self.model.non_trainable_variables]
+        """Snapshot the Keras variables into a fresh TrainState.
+
+        A real copy, not ``asarray``'s alias: the train loops donate
+        their state buffers, so an aliasing snapshot would consume the
+        Keras variables on the first step and a second ``train`` on the
+        same trainer (the Supervisor's retry path) would read deleted
+        arrays."""
+        tv = [jnp.array(v.value, copy=True)
+              for v in self.model.trainable_variables]
+        ntv = [jnp.array(v.value, copy=True)
+               for v in self.model.non_trainable_variables]
         return TrainState(
             tv=tv,
             ntv=ntv,

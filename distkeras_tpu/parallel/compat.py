@@ -1,0 +1,50 @@
+"""JAX version compatibility: the ONE import site for ``shard_map``.
+
+The manual-sharding API graduated from ``jax.experimental.shard_map``
+(kwargs ``check_rep`` / ``auto``) to top-level ``jax.shard_map``
+(kwargs ``check_vma`` / ``axis_names``).  Every module in this package
+imports the new-API surface from here; on an older jax the experimental
+implementation is adapted (``check_vma -> check_rep``;  ``axis_names``
+— the axes mapped manually — becomes its complement ``auto``, the axes
+left automatic).  Without this shim a jax 0.4.x install cannot even
+import the trainer family — the resilience gate runs nothing.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map  # jax >= 0.6
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+                  axis_names=None):
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+        if axis_names is not None:
+            kw["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+        return _shard_map(f, **kw)
+
+
+def keystr(path, simple: bool = False, separator: str = "") -> str:
+    """``jax.tree_util.keystr`` with the newer ``simple``/``separator``
+    kwargs, emulated on a jax whose keystr takes only the path."""
+    import jax
+
+    try:
+        return jax.tree_util.keystr(path, simple=simple,
+                                    separator=separator)
+    except TypeError:
+        if not simple:
+            return jax.tree_util.keystr(path)
+
+        def entry(k):
+            for attr in ("name", "key", "idx"):
+                if hasattr(k, attr):
+                    return str(getattr(k, attr))
+            return str(k)
+
+        return separator.join(entry(k) for k in path)
+
+
+__all__ = ["shard_map", "keystr"]

@@ -1,0 +1,35 @@
+"""Resilience subsystem: fault injection, supervised training, and
+serving admission control.
+
+Three layers (docs/resilience.md has the failure model):
+
+- :mod:`~distkeras_tpu.resilience.chaos` — deterministic, seedable
+  fault injection over named probe sites in the production code paths
+  (checkpoint saves, training rounds, serving steps, the speculative
+  draft).
+- :mod:`~distkeras_tpu.resilience.supervisor` — retry + backoff +
+  verified auto-resume around any trainer's ``train``, with a SIGTERM
+  preemption handler that forces a final synchronous checkpoint.
+- :mod:`~distkeras_tpu.resilience.admission` — request deadlines,
+  bounded-queue backpressure, and structured results for the serving
+  engines (wired into :mod:`distkeras_tpu.serving`).
+"""
+
+from distkeras_tpu.resilience import chaos
+from distkeras_tpu.resilience.admission import (EngineClosed, QueueFull,
+                                                 RequestResult)
+from distkeras_tpu.resilience.chaos import (FaultInjected, FaultPlan,
+                                             Preempted)
+from distkeras_tpu.resilience.supervisor import Attempt, Supervisor
+
+__all__ = [
+    "chaos",
+    "FaultPlan",
+    "FaultInjected",
+    "Preempted",
+    "Supervisor",
+    "Attempt",
+    "RequestResult",
+    "QueueFull",
+    "EngineClosed",
+]

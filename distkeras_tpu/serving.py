@@ -38,15 +38,27 @@ Design notes:
   cached for the engine's lifetime — drive the loop with a small fixed
   set of window sizes (e.g. always ``step(8)``), not a per-call-varying
   ``n``, or each new value pays a fresh compile.
+- Production admission control (docs/resilience.md) rides on top as
+  pure host bookkeeping: per-request ``ttl``/``deadline`` with lane
+  eviction and structured ``RequestResult``s, a bounded ``enqueue``
+  queue with ``QueueFull`` backpressure, a drain-then-``shutdown()``
+  lifecycle, and — on :class:`SpeculativeBatcher` — graceful
+  degradation to the plain decode path when the draft model faults.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from distkeras_tpu.resilience import chaos
+from distkeras_tpu.resilience.admission import (EngineClosed, QueueFull,
+                                                 RequestResult, _Pending)
 
 from distkeras_tpu.models.generate import (
     _decode_chunk,
@@ -71,6 +83,8 @@ class _Lane:
     tokens: list         # host-side transcript, prompt included
     done: bool = False
     eos: object = None   # per-request eos token (engine default)
+    deadline: float | None = None  # absolute clock() time; None = none
+    managed: bool = False  # admitted via enqueue(): auto-collected
 
 
 def _make_lane_admit(model_params, model_cfg, off=0, prefix_lane=None):
@@ -106,7 +120,16 @@ def _make_lane_admit(model_params, model_cfg, off=0, prefix_lane=None):
 class _LaneEngine:
     """Host-side lane machinery shared by the serving engines: the
     lane table, free/running/drain, and the per-step emission loop
-    (append to the transcript, stop at budget or the lane's eos)."""
+    (append to the transcript, stop at budget or the lane's eos).
+
+    Also the admission-control layer (resilience subsystem): request
+    deadlines/TTLs, a bounded FIFO queue with :class:`QueueFull`
+    backpressure, structured :class:`RequestResult` reporting, and the
+    drain-then-shutdown lifecycle.  All of it is host bookkeeping —
+    the compiled decode programs and their exact-parity contract are
+    untouched (an evicted lane just stops being read; its rows keep
+    burning compute until admission reseeds them, same as any done
+    lane)."""
 
     def free_lanes(self):
         return [i for i, s in enumerate(self._lane_state) if s is None]
@@ -144,6 +167,271 @@ class _LaneEngine:
                     break
             out[lane] = emitted
         return out
+
+    # ----------------------------------------------- admission control
+
+    def _init_admission(self, max_queue: int, clock) -> None:
+        if max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
+        self.max_queue = max_queue
+        self._clock = clock if clock is not None else time.monotonic
+        self._pending = collections.deque()
+        self._completed: dict[int, RequestResult] = {}
+        self._closed = False
+        self._admitting = False  # pump()-internal submit bypasses _closed
+        # The id under which the most recent bare submit() recorded (or
+        # will record) its RequestResult — how drain()-style callers
+        # that pass a ttl reach their structured timeout via poll/take
+        # instead of the pop-everything results().
+        self.last_request_id: int | None = None
+
+    def _deadline_of(self, ttl, deadline):
+        """Resolve submit/enqueue's ``ttl`` (seconds from now) /
+        ``deadline`` (absolute ``clock()`` time) pair."""
+        if ttl is not None and deadline is not None:
+            raise ValueError("pass ttl (relative) OR deadline "
+                             "(absolute), not both")
+        if ttl is not None:
+            return self._clock() + ttl
+        return deadline
+
+    def _check_open(self) -> None:
+        if self._closed and not self._admitting:
+            raise EngineClosed(
+                "engine is shutting down (begin_shutdown was called); "
+                "no new requests are admitted during drain")
+
+    def _finish(self, rid: int, tokens, status: str, prompt_len: int,
+                error: str | None = None):
+        self._completed[rid] = RequestResult(
+            request_id=rid, tokens=np.asarray(tokens, np.int32),
+            status=status, prompt_len=prompt_len, error=error)
+
+    def _expired_on_arrival(self, dl, prompt, p: int) -> bool:
+        """The ONE expired-on-arrival protocol for both engines'
+        ``submit``: an already-dead request never occupies a lane; a
+        caller-facing submit records the structured timeout under a
+        fresh id (exposed as ``last_request_id``), while internal
+        admission (enqueue/pump) declines silently — the caller records
+        under the request's own id."""
+        if dl is None or dl > self._clock():
+            return False
+        if not self._admitting:
+            rid = self._next_id
+            self._next_id += 1
+            self._finish(rid, prompt, "timeout", p)
+            self.last_request_id = rid
+        return True
+
+    def _admitted_id(self) -> int:
+        """Allocate the admitted request's id; caller-facing submits
+        expose it as ``last_request_id``."""
+        rid = self._next_id
+        self._next_id += 1
+        if not self._admitting:
+            self.last_request_id = rid
+        return rid
+
+    def _decline_full(self) -> None:
+        """Engine-full decline: no request was registered, so a stale
+        ``last_request_id`` must not masquerade as this request's."""
+        if not self._admitting:
+            self.last_request_id = None
+
+    def enqueue(self, prompt, max_new_tokens: int, ttl=None, deadline=None,
+                **submit_kw) -> int:
+        """Admission-controlled submit: returns a request id
+        immediately; the terminal :class:`RequestResult` arrives via
+        :meth:`poll` / :meth:`take` / :meth:`results` once the request
+        finishes, times out, or is cancelled by shutdown.
+
+        No free lane: the request waits in the bounded FIFO queue
+        (capacity ``max_queue``); past capacity, raises
+        :class:`QueueFull` — the backpressure signal.  An already-
+        expired deadline never occupies a lane or a queue slot: the
+        structured timeout result is recorded up front.
+
+        ``submit_kw`` forwards to this engine's ``submit`` (per-request
+        key / sampling overrides / eos_token); engine-specific
+        validation beyond the prompt/budget checks runs at admission
+        time, which for a queued request is a later ``step()``.
+        """
+        self._check_open()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        self._validate_budget(prompt.size, max_new_tokens)
+        dl = self._deadline_of(ttl, deadline)
+        rid = self._next_id
+        self._next_id += 1
+        if dl is not None and dl <= self._clock():
+            self._finish(rid, prompt, "timeout", prompt.size)
+            return rid
+        pend = _Pending(rid, prompt, int(max_new_tokens), dl, submit_kw)
+        # FIFO: queued requests get first claim on any free lane (and
+        # expired heads are dropped) before this one may jump in.
+        self.pump()
+        if self.free_lanes() and not self._pending:
+            # Immediate admission: validation errors raise to the
+            # caller here, synchronously.
+            if self._admit_pending(pend):
+                return rid
+            # A lane was free, so the only way submit declined is the
+            # deadline expiring between our check and its re-check.
+            self._finish(rid, prompt, "timeout", prompt.size)
+            return rid
+        if len(self._pending) >= self.max_queue:
+            raise QueueFull(
+                f"all {self.lanes} lanes busy and the admission queue "
+                f"holds {len(self._pending)}/{self.max_queue} requests; "
+                "shed load or raise max_queue")
+        self._pending.append(pend)
+        return rid
+
+    def _admit_pending(self, pend) -> bool:
+        self._admitting = True
+        try:
+            lane = self.submit(pend.prompt, pend.max_new,
+                               deadline=pend.deadline, **pend.submit_kw)
+        finally:
+            self._admitting = False
+        if lane is None:
+            return False
+        st = self._lane_state[lane]
+        # submit() allocated a fresh id; the request keeps the one its
+        # caller holds (ids stay unique — the fresh one is just unused).
+        st.request_id = pend.request_id
+        st.managed = True
+        return True
+
+    def pump(self) -> list[int]:
+        """Admit queued requests into free lanes (FIFO); queued
+        requests whose deadline expired are dropped with a structured
+        timeout — they never occupy a lane.  Runs automatically at the
+        start of every ``step()``; returns the admitted request ids."""
+        admitted = []
+        while self._pending:
+            pend = self._pending[0]
+            if (pend.deadline is not None
+                    and pend.deadline <= self._clock()):
+                self._pending.popleft()
+                self._finish(pend.request_id, pend.prompt, "timeout",
+                             pend.prompt.size)
+                continue
+            if not self.free_lanes():
+                break
+            self._pending.popleft()
+            try:
+                ok = self._admit_pending(pend)
+            except Exception as e:  # noqa: BLE001 — deferred validation
+                # Engine-specific validation that enqueue() could not
+                # run up front (e.g. the key-iff-sampling rule) fails
+                # at admission: the request must still reach a terminal
+                # structured result, not crash the decode loop.
+                self._finish(pend.request_id, pend.prompt, "error",
+                             pend.prompt.size, error=str(e))
+                continue
+            if ok:
+                admitted.append(pend.request_id)
+            else:
+                # Free lane + declined admission == the deadline
+                # expired between pump's check and submit's re-check.
+                self._finish(pend.request_id, pend.prompt, "timeout",
+                             pend.prompt.size)
+        return admitted
+
+    def _reap(self) -> None:
+        """Post-step bookkeeping: collect finished managed lanes and
+        evict deadline-expired running lanes (structured timeout with
+        the partial transcript).  Evicted/collected lanes free
+        immediately — the next pump()/submit() reuses them."""
+        now = None
+        for lane, st in enumerate(self._lane_state):
+            if st is None:
+                continue
+            if st.done:
+                if st.managed:
+                    self._finish(st.request_id, st.tokens, "ok",
+                                 st.prompt_len)
+                    self._lane_state[lane] = None
+                continue
+            if st.deadline is not None:
+                if now is None:
+                    now = self._clock()
+                if st.deadline <= now:
+                    self._finish(st.request_id, st.tokens, "timeout",
+                                 st.prompt_len)
+                    self._lane_state[lane] = None
+
+    # ------------------------------------------------------- results
+
+    def poll(self, request_id: int):
+        """The request's :class:`RequestResult`, or None if still
+        queued/decoding."""
+        return self._completed.get(request_id)
+
+    def take(self, request_id: int):
+        """Pop and return the request's result; raises KeyError if it
+        has not finished."""
+        return self._completed.pop(request_id)
+
+    def results(self) -> dict:
+        """Pop every completed result: ``{request_id: RequestResult}``."""
+        out = self._completed
+        self._completed = {}
+        return out
+
+    @property
+    def queued(self) -> int:
+        return len(self._pending)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # ------------------------------------------------------ lifecycle
+
+    def begin_shutdown(self) -> None:
+        """Stop admission (submit/enqueue raise :class:`EngineClosed`);
+        in-flight lanes and the queue keep decoding via ``step()``."""
+        self._closed = True
+
+    def shutdown(self, max_steps: int | None = None) -> dict:
+        """Drain-then-shutdown: stop admission, run the decode loop
+        until every queued and running request reaches a terminal state
+        (finish, eos, or deadline), and return the collected results.
+
+        ``max_steps`` bounds the drain; requests still unfinished when
+        it trips are cancelled (structured ``"cancelled"`` results,
+        partial transcripts for lanes already decoding).  Lanes that
+        were admitted with bare ``submit()`` and already finished are
+        left for their caller's ``drain()`` — only live work blocks
+        shutdown.
+        """
+        self.begin_shutdown()
+        steps = 0
+        while self.running() or self._pending:
+            if max_steps is not None and steps >= max_steps:
+                break
+            if not self.running() and not self.free_lanes():
+                # Queue blocked behind finished-but-undrained manual
+                # lanes: stepping cannot make progress.
+                break
+            self.step()
+            steps += 1
+        for pend in self._pending:
+            self._finish(pend.request_id, pend.prompt, "cancelled",
+                         pend.prompt.size)
+        self._pending.clear()
+        for lane, st in enumerate(self._lane_state):
+            if st is not None and not st.done:
+                self._finish(st.request_id, st.tokens, "cancelled",
+                             st.prompt_len)
+                self._lane_state[lane] = None
+        return self.results()
 
 
 class ContinuousBatcher(_LaneEngine):
@@ -186,7 +474,8 @@ class ContinuousBatcher(_LaneEngine):
                  min_p=None, eos_token=None, exact_top_k: bool = False,
                  prompt_buckets=(8, 32, 128, 512), prompt_cache=None,
                  kv_int8: bool = False,
-                 per_request_sampling: bool = False):
+                 per_request_sampling: bool = False,
+                 max_queue: int = 0, clock=None):
         # Windowed configs: the engine runs ROLLING lanes — each lane
         # decodes past max_len on the ring-buffer cache (the unbounded
         # streaming-chat shape), which needs rope (positions beyond
@@ -274,6 +563,11 @@ class ContinuousBatcher(_LaneEngine):
             {min(int(w), cap) for w in prompt_buckets} | {cap}))
         self._lane_state: list[_Lane | None] = [None] * lanes
         self._next_id = 0
+        # Admission control (resilience subsystem): ``max_queue`` bounds
+        # the enqueue() backlog (0 = no queue: enqueue needs a free
+        # lane); ``clock`` is the deadline clock (monotonic seconds;
+        # injectable for deterministic chaos tests).
+        self._init_admission(max_queue, clock)
 
         # Device state: one cache, per-lane next-position, per-lane
         # current token (the one the next step processes), per-lane key.
@@ -404,8 +698,28 @@ class ContinuousBatcher(_LaneEngine):
 
     # ------------------------------------------------------------ API
 
+    def _validate_budget(self, p: int, max_new_tokens: int) -> None:
+        if (not self._rolling
+                and self._off + p + max_new_tokens > self.cfg.max_len):
+            # Rolling engines have no total-length cap: lanes decode
+            # past max_len on the ring (the admission bucket check
+            # below still caps the PROMPT at the ring size — a longer
+            # prompt's chunk would wrap mid-write).
+            raise ValueError(
+                f"prefix ({self._off}) + prompt ({p}) + "
+                f"max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_len={self.cfg.max_len}")
+        warm = p - 1
+        if warm and next((w for w in self._buckets if w >= warm),
+                         None) is None:
+            raise ValueError(
+                f"prompt length {p} exceeds the largest admission "
+                f"bucket ({self._buckets[-1]} + 1); raise "
+                "prompt_buckets")
+
     def submit(self, prompt, max_new_tokens: int, key=None,
-               temperature=None, top_p=None, min_p=None, eos_token=None):
+               temperature=None, top_p=None, min_p=None, eos_token=None,
+               ttl=None, deadline=None):
         """Admit one request; returns its lane id, or None if the
         engine is full.  ``prompt``: 1-D int tokens; ``key``: per-
         request PRNG key (required iff THIS request samples).
@@ -416,7 +730,19 @@ class ContinuousBatcher(_LaneEngine):
         host-side bookkeeping and works on every engine).  Pass
         ``top_p=1.0`` / ``min_p=0.0`` (the explicit no-op values) for
         an unfiltered request on an engine whose default filters.
+
+        ``ttl`` (seconds from now) / ``deadline`` (absolute ``clock()``
+        time): the request's deadline.  A request that is already
+        expired never occupies a lane — its structured timeout result
+        is recorded (see :meth:`results`) and None is returned; one
+        that expires mid-decode is evicted at the next ``step()`` the
+        same way.  Deadline-carrying requests report through
+        ``poll``/``take``/``results``, not ``drain``; this request's id
+        is exposed as ``self.last_request_id`` (the queue-level
+        :meth:`enqueue` API wraps all of this and returns the request
+        id directly).
         """
+        self._check_open()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = prompt.size
         if p < 1:
@@ -452,33 +778,26 @@ class ContinuousBatcher(_LaneEngine):
             raise ValueError(
                 "per-request top_p/min_p need a sampling temperature "
                 f"(effective temperature is {eff_t})")
-        if (not self._rolling
-                and self._off + p + max_new_tokens > self.cfg.max_len):
-            # Rolling engines have no total-length cap: lanes decode
-            # past max_len on the ring (the admission bucket check
-            # below still caps the PROMPT at the ring size — a longer
-            # prompt's chunk would wrap mid-write).
-            raise ValueError(
-                f"prefix ({self._off}) + prompt ({p}) + "
-                f"max_new_tokens ({max_new_tokens}) exceeds "
-                f"max_len={self.cfg.max_len}")
+        self._validate_budget(p, max_new_tokens)
         if (key is None) == (eff_t > 0):
             raise ValueError(
                 "pass a per-request key iff this request samples "
                 f"(effective temperature={eff_t})")
+        dl = self._deadline_of(ttl, deadline)
+        if self._expired_on_arrival(dl, prompt, p):
+            # The acceptance contract: an already-dead request never
+            # occupies a lane; its timeout is a structured result.
+            return None
         free = self.free_lanes()
         if not free:
+            self._decline_full()
             return None
         lane = free[0]
+        chaos.probe("serving.admit")
 
         warm = p - 1
         if warm:
-            width = next((w for w in self._buckets if w >= warm), None)
-            if width is None:
-                raise ValueError(
-                    f"prompt length {p} exceeds the largest admission "
-                    f"bucket ({self._buckets[-1]} + 1); raise "
-                    "prompt_buckets")
+            width = next(w for w in self._buckets if w >= warm)
             rows = np.zeros((1, width), np.int32)
             rows[0, :warm] = prompt[:-1]
             self.cache = self._admit(
@@ -503,10 +822,10 @@ class ContinuousBatcher(_LaneEngine):
                 (self.min_p or 0.0) if min_p is None else min_p))
 
         self._lane_state[lane] = _Lane(
-            request_id=self._next_id, prompt_len=p,
+            request_id=self._admitted_id(), prompt_len=p,
             max_new=max_new_tokens, key=key, tokens=list(prompt),
-            eos=self.eos_token if eos_token is None else eos_token)
-        self._next_id += 1
+            eos=self.eos_token if eos_token is None else eos_token,
+            deadline=dl)
         return lane
 
     def step(self, n: int = 1):
@@ -523,18 +842,25 @@ class ContinuousBatcher(_LaneEngine):
         """
         if n < 1:
             raise ValueError(f"n must be >= 1, got {n}")
+        self.pump()
         # Idle engine (every lane empty or finished-but-undrained):
         # nothing can emit, so skip the device round-trip entirely
         # instead of burning a full decode window.
         if all(s is None or s.done for s in self._lane_state):
             return {}
+        chaos.probe("serving.step")
         if n not in self._steps:
             self._steps[n] = self._make_step(n)
         self.cache, self.cur, self.pos, toks = self._steps[n](
             self.cache, self.cur, self.pos, self.keys,
             self.temps, self.tps, self.mps)
         toks = np.asarray(toks)
-        return self._emit(lambda lane: toks[lane].tolist())
+        out = self._emit(lambda lane: toks[lane].tolist())
+        # Deadline granularity is one step window: tokens emitted in
+        # the window that straddles the deadline are kept in the
+        # partial result.
+        self._reap()
+        return out
 
 
 class SpeculativeBatcher(_LaneEngine):
@@ -572,7 +898,8 @@ class SpeculativeBatcher(_LaneEngine):
     def __init__(self, params, draft_params, cfg: TransformerConfig,
                  draft_cfg: TransformerConfig, lanes: int = 8,
                  n_draft: int = 4, temperature: float = 0.0,
-                 eos_token=None, prompt_buckets=(8, 32, 128, 512)):
+                 eos_token=None, prompt_buckets=(8, 32, 128, 512),
+                 max_queue: int = 0, clock=None):
         if cfg.attention_window is not None or draft_cfg.attention_window:
             raise ValueError(
                 "SpeculativeBatcher v1 supports full-cache configs "
@@ -609,6 +936,19 @@ class SpeculativeBatcher(_LaneEngine):
             | {self._cap}))
         self._lane_state: list[_Lane | None] = [None] * lanes
         self._next_id = 0
+        self._init_admission(max_queue, clock)
+        # Graceful degradation: when the draft half of the step faults
+        # (chaos-injected, or a real dispatch failure caught with the
+        # engine state intact), the engine permanently switches to a
+        # plain target-only decode step — requests still complete,
+        # just without the speculative speedup.  Greedy engines keep
+        # exact solo-generate parity through the switch (greedy
+        # speculative == greedy generate by construction); sampled
+        # engines keep drawing valid samples but on a different PRNG
+        # stream than the solo speculative rollout.
+        self._degraded = False
+        self.degraded_error = None
+        self._fallback = None
 
         self.tcache = init_cache(cfg, lanes)
         self.dcache = init_cache(draft_cfg, lanes)
@@ -716,11 +1056,22 @@ class SpeculativeBatcher(_LaneEngine):
 
     # -------------------------------------------------------------- API
 
+    def _validate_budget(self, p: int, max_new_tokens: int) -> None:
+        if p + max_new_tokens - 1 > self._cap:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) + "
+                f"n_draft ({self.n_draft}) exceeds "
+                f"max_len={min(self.cfg.max_len, self.draft_cfg.max_len)}"
+                " (the verify chunk needs n_draft + 1 slots of slack)")
+
     def submit(self, prompt, max_new_tokens: int, key=None,
-               eos_token=None):
+               eos_token=None, ttl=None, deadline=None):
         """Admit one request; returns its lane id, or None if full.
         ``key``: per-request PRNG key (required iff the engine
-        samples, i.e. ``temperature > 0``)."""
+        samples, i.e. ``temperature > 0``).  ``ttl``/``deadline``:
+        request deadline, same contract as
+        :meth:`ContinuousBatcher.submit`."""
+        self._check_open()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = prompt.size
         if p < 1:
@@ -732,21 +1083,21 @@ class SpeculativeBatcher(_LaneEngine):
             raise ValueError(
                 "pass a per-request key iff the engine samples "
                 f"(temperature={self.temperature})")
-        if p + max_new_tokens - 1 > self._cap:
-            raise ValueError(
-                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) + "
-                f"n_draft ({self.n_draft}) exceeds "
-                f"max_len={min(self.cfg.max_len, self.draft_cfg.max_len)}"
-                " (the verify chunk needs n_draft + 1 slots of slack)")
+        self._validate_budget(p, max_new_tokens)
         if eos_token is not None and not (
                 0 <= eos_token < self.cfg.vocab_size):
             raise ValueError(
                 f"eos_token {eos_token} outside vocab [0, "
                 f"{self.cfg.vocab_size})")
+        dl = self._deadline_of(ttl, deadline)
+        if self._expired_on_arrival(dl, prompt, p):
+            return None
         free = self.free_lanes()
         if not free:
+            self._decline_full()
             return None
         lane = free[0]
+        chaos.probe("serving.admit")
         warm = p - 1
         if warm:
             # The budget check above bounds warm < cap, and _buckets
@@ -768,21 +1119,106 @@ class SpeculativeBatcher(_LaneEngine):
             self.keys = self.keys.at[lane].set(key)
         self.iters = self.iters.at[lane].set(0)
         self._lane_state[lane] = _Lane(
-            request_id=self._next_id, prompt_len=p,
+            request_id=self._admitted_id(), prompt_len=p,
             max_new=max_new_tokens, key=key, tokens=list(prompt),
-            eos=self.eos_token if eos_token is None else eos_token)
-        self._next_id += 1
+            eos=self.eos_token if eos_token is None else eos_token,
+            deadline=dl)
         return lane
 
+    # ------------------------------------------------- degraded mode
+
+    @property
+    def degraded(self) -> bool:
+        """True once the engine fell back to the plain decode path."""
+        return self._degraded
+
+    def degrade(self, error=None) -> None:
+        """Permanently switch to the target-only fallback decode step
+        (see the constructor's degradation note).  Called automatically
+        when the draft half of a step faults; callable directly by an
+        operator who knows the draft model is bad."""
+        self._degraded = True
+        if error is not None and self.degraded_error is None:
+            self.degraded_error = error
+
+    def _note_draft_fault(self, e: BaseException) -> None:
+        intact = not any(
+            getattr(leaf, "is_deleted", lambda: False)()
+            for leaf in jax.tree.leaves(
+                (self.tcache, self.cur, self.pos, self.keys)))
+        if not intact:
+            raise RuntimeError(
+                "draft fault surfaced after the speculative step "
+                "consumed its donated state; the fallback path has "
+                "nothing valid to decode from") from e
+        self.degrade(e)
+
+    def _make_fallback(self):
+        """Plain target-only decode step over the SAME engine state
+        (tcache/cur/pos): one token per lane per call, frontier clamped
+        at the budget-safe cap exactly like the speculative step."""
+        cfg = self.cfg
+        temperature = self.temperature
+        cap = jnp.int32(self._cap)
+
+        def pick(k, row, q):
+            return jax.random.categorical(jax.random.fold_in(k, q), row)
+
+        def one(tcache, cur, pos, keys):
+            logits, tcache = _decode_chunk(self.params, tcache,
+                                           cur[:, None], pos, cfg)
+            logits = logits[:, 0]
+            if temperature > 0:
+                nxt = jax.vmap(pick)(keys, logits / temperature, pos)
+            else:
+                nxt = logits.argmax(axis=-1)
+            nxt = nxt.astype(jnp.int32)
+            adv = (pos < cap).astype(jnp.int32)
+            new_pos = jnp.minimum(pos + 1, cap)
+            new_cur = jnp.where(adv > 0, nxt, cur)
+            return tcache, new_cur, new_pos, nxt, adv
+
+        return jax.jit(one, donate_argnums=0)
+
     def step(self):
-        """One draft+verify round for every lane; returns
+        """One decode round for every lane; returns
         ``{lane: [tokens...]}`` — up to ``n_draft + 1`` tokens per
-        lane per call."""
+        lane per call (exactly 1 once the engine is degraded)."""
+        self.pump()
         if all(s is None or s.done for s in self._lane_state):
             return {}
-        (self.tcache, self.dcache, self.prev, self.cur, self.pos,
-         self.iters, win, adv) = self._step(
-            self.tcache, self.dcache, self.prev, self.cur, self.pos,
-            self.keys, self.iters)
-        win, adv = np.asarray(win), np.asarray(adv)
-        return self._emit(lambda lane: win[lane, :adv[lane]].tolist())
+        chaos.probe("serving.step")
+        if not self._degraded:
+            try:
+                chaos.probe("serving.draft")
+                (tcache, dcache, prev, cur, pos, iters, win,
+                 adv) = self._step(
+                    self.tcache, self.dcache, self.prev, self.cur,
+                    self.pos, self.keys, self.iters)
+                # Force async dispatch errors to surface INSIDE the
+                # try, before the engine state is rebound: a fault
+                # arriving here finds self.* still naming the donated
+                # (now consumed) inputs, and _note_draft_fault reports
+                # the unrecoverable case with a clear error instead of
+                # leaving poisoned state behind.
+                win, adv = np.asarray(win), np.asarray(adv)
+            except Exception as e:  # noqa: BLE001 — degrade, not die
+                self._note_draft_fault(e)
+            else:
+                (self.tcache, self.dcache, self.prev, self.cur,
+                 self.pos, self.iters) = (tcache, dcache, prev, cur,
+                                          pos, iters)
+                out = self._emit(
+                    lambda lane: win[lane, :adv[lane]].tolist())
+                self._reap()
+                return out
+        # Degraded: plain target decode — requests still complete.
+        if self._fallback is None:
+            self._fallback = self._make_fallback()
+        self.tcache, self.cur, self.pos, nxt, adv = self._fallback(
+            self.tcache, self.cur, self.pos, self.keys)
+        nxt, adv = np.asarray(nxt), np.asarray(adv)
+        out = self._emit(
+            lambda lane: [int(nxt[lane])] if adv[lane] else [])
+        self._reap()
+        return out

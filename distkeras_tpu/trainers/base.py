@@ -17,6 +17,8 @@ import numpy as np
 
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.adapter import ModelAdapter
+from distkeras_tpu.resilience import chaos
+from distkeras_tpu.resilience.chaos import Preempted
 
 
 class CheckpointingBase:
@@ -34,11 +36,18 @@ class CheckpointingBase:
     def _setup_checkpointing(self, *, checkpoint_dir: str | None,
                              checkpoint_every: int, max_checkpoints: int,
                              resume: bool, shuffle: bool,
-                             seed: int | None) -> None:
+                             seed: int | None,
+                             backend: str = "auto") -> None:
         self.checkpoint_every = checkpoint_every
         self.resume = resume
         self.checkpoint_dir = checkpoint_dir
         self.max_checkpoints = max_checkpoints
+        self.checkpoint_backend = backend
+        # Set by a resilience.Supervisor (or any orchestrator): when
+        # this Event is set, the next round boundary forces a final
+        # synchronous checkpoint and raises Preempted — the graceful
+        # half of a preemption.
+        self.preempt_event = None
         self._ckpt = None
         self._last_saved_round = 0
         if resume and shuffle and seed is None:
@@ -61,7 +70,8 @@ class CheckpointingBase:
         # Opened per run and closed on exit so orbax's async machinery
         # doesn't outlive the training it serves.
         self._ckpt = CheckpointManager(
-            self.checkpoint_dir, max_to_keep=self.max_checkpoints)
+            self.checkpoint_dir, max_to_keep=self.max_checkpoints,
+            backend=self.checkpoint_backend)
         if not self.resume and self._ckpt.latest_step() is not None:
             self._ckpt.close()
             self._ckpt = None
@@ -97,6 +107,21 @@ class CheckpointingBase:
         buffers into the next step, so an in-flight async write must not
         alias them.  States at dist-keras scale write in milliseconds.
         """
+        chaos.probe("train.round", step=round_idx)
+        if self.preempt_event is not None and self.preempt_event.is_set():
+            # Graceful preemption (SIGTERM via a Supervisor, or any
+            # orchestrator flipping the event): persist THIS round's
+            # state synchronously, then stop.  The resumed run replays
+            # from here bit-for-bit — data order is round-indexed and
+            # every RNG stream is keyed on the round counter.
+            if self._ckpt is not None and round_idx != self._last_saved_round:
+                self._ckpt.save(pytree, round_idx, force=True)
+                self._ckpt.wait_until_finished()
+                self._last_saved_round = round_idx
+            raise Preempted(
+                f"preempted at round {round_idx}"
+                + (" (state checkpointed)" if self._ckpt is not None
+                   else " (no checkpoint_dir: round lost)"))
         if self._ckpt is None or round_idx == self._last_saved_round:
             return  # (final save right after a periodic one: already durable)
         periodic = self.checkpoint_every and round_idx % self.checkpoint_every == 0
@@ -116,6 +141,7 @@ class Trainer(CheckpointingBase):
                  shuffle: bool = False, seed: int | None = None,
                  checkpoint_dir: str | None = None, checkpoint_every: int = 0,
                  max_checkpoints: int = 3, resume: bool = False,
+                 checkpoint_backend: str = "auto",
                  preprocess=None, metrics=(), eval_every: int = 0):
         self.adapter = ModelAdapter(
             keras_model, loss=loss, optimizer=worker_optimizer,
@@ -143,7 +169,7 @@ class Trainer(CheckpointingBase):
         self._setup_checkpointing(
             checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
             max_checkpoints=max_checkpoints, resume=resume, shuffle=shuffle,
-            seed=seed)
+            seed=seed, backend=checkpoint_backend)
 
     # -- subclass hook -----------------------------------------------------
     def _fit(self, dataset: Dataset):  # pragma: no cover

@@ -26,7 +26,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from distkeras_tpu.parallel.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.data.dataset import Dataset

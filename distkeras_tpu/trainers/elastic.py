@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from distkeras_tpu.parallel.compat import shard_map
 
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.adapter import TrainState

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
+from distkeras_tpu.parallel.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.data.dataset import Dataset
@@ -122,6 +122,7 @@ class LMTrainer(CheckpointingBase):
                  profile_dir: str | None = None, profile_steps: int = 3,
                  checkpoint_dir: str | None = None, checkpoint_every: int = 0,
                  max_checkpoints: int = 3, resume: bool = False,
+                 checkpoint_backend: str = "auto",
                  ema_decay: float | None = None):
         self.cfg = cfg
         if not callable(learning_rate) and learning_rate <= 0:
@@ -141,9 +142,10 @@ class LMTrainer(CheckpointingBase):
             # (decaying a normalization gain toward 0 fights the
             # parameterization, not overfitting).
             def decay_mask(params):
+                from distkeras_tpu.parallel.compat import keystr
+
                 def leaf(path, _):
-                    name = jax.tree_util.keystr(path, simple=True,
-                                                separator="/")
+                    name = keystr(path, simple=True, separator="/")
                     return not name.endswith("_scale")
                 return jax.tree_util.tree_map_with_path(leaf, params)
 
@@ -205,7 +207,7 @@ class LMTrainer(CheckpointingBase):
         self._setup_checkpointing(
             checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
             max_checkpoints=max_checkpoints, resume=resume, shuffle=shuffle,
-            seed=seed)
+            seed=seed, backend=checkpoint_backend)
 
         missing = [a for a in AXES if a not in self.mesh.shape]
         if missing:

@@ -73,7 +73,9 @@ MULTIPROCESS = {
     "test_deploy::test_four_process_smoke",
     "test_deploy::test_two_process_adag_matches_single_process",
     "test_deploy::test_two_process_checkpoint_save_and_resume",
+    "test_deploy::test_two_process_device_data_adag_matches_single",
     "test_deploy::test_two_process_downpour_matches_single_process",
+    "test_deploy::test_two_process_eval_dataset_matches_single",
     "test_deploy::test_two_process_lm_trainer_matches_single_process",
     "test_deploy::test_two_process_model_axis_crosses_boundary",
     "test_deploy::test_two_process_packed_training_matches_single",

@@ -53,6 +53,23 @@ def test_run_local_rejects_multihost():
         Job(script="t.py", num_hosts=2).run_local()
 
 
+def test_run_local_propagates_nonzero_returncode(tmp_path):
+    script = tmp_path / "fail.py"
+    script.write_text("import sys\nsys.exit(3)\n")
+    with pytest.raises(RuntimeError, match="returncode 3"):
+        Job(script=str(script)).run_local()
+    # check=False restores the inspect-the-proc escape hatch
+    proc = Job(script=str(script)).run_local(check=False)
+    assert proc.returncode == 3
+
+
+def test_run_local_timeout_kills_child(tmp_path):
+    script = tmp_path / "hang.py"
+    script.write_text("import time\ntime.sleep(600)\n")
+    with pytest.raises(TimeoutError, match="did not finish"):
+        Job(script=str(script)).run_local(timeout=1.0)
+
+
 def test_init_from_env_noop_single_host(monkeypatch):
     from distkeras_tpu import deploy
 
