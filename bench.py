@@ -62,15 +62,19 @@ def main():
     # few minutes to come back before giving up).
     err = _probe_with_retries()
     if err is not None:
-        # Keep the documented one-line key set; null value signals "no
-        # measurement" to contract-parsing consumers.  ``last_green``
-        # carries the most recent PRIOR green measurement (clearly
-        # labeled; ``value`` stays null) so the artifact holds evidence
-        # through a tunnel outage instead of only "null" while the real
+        # A dead accelerator tunnel is an ENVIRONMENT outage, not a
+        # regression in this repo: emit a structured skip record and
+        # exit 0 so the driver's bench step records "skipped" instead
+        # of a failure (BENCH_r05: the rc=1 poisoned the whole run).
+        # Keys keep the documented one-line contract; null value
+        # signals "no measurement" to contract-parsing consumers, and
+        # ``last_green`` carries the most recent PRIOR green
+        # measurement (clearly labeled) so the artifact holds evidence
+        # through the outage instead of only nulls while the real
         # numbers live in BASELINE.md prose.
         line = {"metric": "cifar_cnn_train_throughput",
                 "value": None, "unit": "samples/sec/chip",
-                "vs_baseline": None, "error": err}
+                "vs_baseline": None, "status": "skipped", "error": err}
         from bench_suite import read_last_green
 
         prior = read_last_green("cifar_cnn_train_throughput")
@@ -78,7 +82,7 @@ def main():
             line["last_green"] = {
                 "note": "prior green measurement, NOT this run", **prior}
         print(json.dumps(line))
-        sys.exit(1)
+        sys.exit(0)
 
     from bench_suite import bench_cifar_cnn, peak_flops, update_last_green
 

@@ -60,9 +60,12 @@ from distkeras_tpu.utils.serialization import (
     load_lm,
 )
 from distkeras_tpu.models.adapter import ModelAdapter, TrainState
+from distkeras_tpu.parallel import collectives
+from distkeras_tpu.parallel.collectives import zero1_optimizer
 from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
 from distkeras_tpu.parallel.sharding import (ShardingPlan, dp_plan,
-                                              fsdp_plan, tp_plan)
+                                              fsdp_plan, tp_plan,
+                                              zero1_plan)
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.data.packing import pack_documents, packing_efficiency
 from distkeras_tpu.data.tokenizer import BPETokenizer
@@ -112,6 +115,9 @@ __all__ = [
     "dp_plan",
     "fsdp_plan",
     "tp_plan",
+    "zero1_plan",
+    "zero1_optimizer",
+    "collectives",
     "Dataset",
     "pack_documents",
     "packing_efficiency",

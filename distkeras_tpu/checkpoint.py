@@ -11,12 +11,15 @@ onto the mesh.
 Two backends behind one :class:`CheckpointManager` surface:
 
 - ``"orbax"`` — the production path: async, multi-host, sharded saves
-  via ``orbax.checkpoint``.
+  via ``orbax.checkpoint`` (a ZeRO-1/FSDP-scattered optimizer state is
+  written shard-native — no host-side reassembly — and restored
+  straight onto the template's devices).
 - ``"pickle"`` — a pure-stdlib single-host fallback: synchronous
   atomic writes (tmp dir + ``os.replace``), the same integer-step
-  directory layout and refuse-to-overwrite semantics.  Exists so the
-  resilience machinery (and its tests) runs on any box, orbax
-  installed or not.
+  directory layout and refuse-to-overwrite semantics.  Scattered
+  leaves *gather on save* into one host array and re-scatter on
+  restore via the template's shardings.  Exists so the resilience
+  machinery (and its tests) runs on any box, orbax installed or not.
 
 ``backend="auto"`` (the default) picks orbax when importable and falls
 back to pickle otherwise; asking for ``"orbax"`` explicitly without the
@@ -211,8 +214,22 @@ class _PickleBackend:
             raise ValueError(
                 f"checkpoint step {step} already exists under "
                 f"{self.directory} (steps are immutable once committed)")
-        host = jax.tree.map(
-            lambda x: np.asarray(x) if hasattr(x, "shape") else x, state)
+
+        def to_host(x):
+            # Gather-on-save: a ZeRO-1/FSDP-scattered leaf reassembles
+            # into one host array (every shard is addressable on this
+            # single host — the multi-process guard in __init__ holds);
+            # restore re-scatters it via the template leaf's sharding.
+            if isinstance(x, jax.Array) and len(x.sharding.device_set) > 1:
+                if not x.is_fully_addressable:  # pragma: no cover
+                    raise ValueError(
+                        "pickle checkpoint backend cannot gather a leaf "
+                        "spanning non-addressable devices; use "
+                        "backend='orbax' for multi-host sharded state")
+                return np.asarray(jax.device_get(x))
+            return np.asarray(x) if hasattr(x, "shape") else x
+
+        host = jax.tree.map(to_host, state)
         tmp = os.path.join(self.directory, f".tmp.{step}.{os.getpid()}")
         os.makedirs(tmp, exist_ok=True)
         try:

@@ -11,6 +11,33 @@ from __future__ import annotations
 
 import optax
 
+# Every optimizer name this module resolves has a PER-LEAF ELEMENTWISE
+# update rule: the step taken for element i depends only on element i's
+# gradient/moment history (plus replicated scalars like the step count
+# or a global-norm clip factor, which survive sharding as cheap scalar
+# psums).  That property is what makes the ZeRO-1 sharded weight update
+# (parallel/collectives.zero1_optimizer) *math-identical*: slicing the
+# flattened view across replicas commutes with the update.  Transforms
+# that mix elements within a leaf — LARS/LAMB per-layer trust ratios,
+# Shampoo-style preconditioners — are NOT in this set and would
+# silently diverge under zero1.
+ZERO1_ELEMENTWISE = frozenset(
+    {"sgd", "adam", "adamw", "nadam", "adagrad", "adadelta", "rmsprop"})
+
+
+def zero1_compatible(spec) -> bool | None:
+    """Whether ``spec`` is known-safe under the ZeRO-1 sharded update.
+
+    Returns ``True`` for resolvable names in :data:`ZERO1_ELEMENTWISE`
+    (all of them today), ``False`` for known-unsafe names (none yet),
+    and ``None`` for anything this module cannot inspect — a prebuilt
+    optax transform — meaning "caller must vouch for elementwise
+    update math" (the trainers warn).
+    """
+    if isinstance(spec, str):
+        return spec.lower() in ZERO1_ELEMENTWISE
+    return None
+
 
 def resolve_optimizer(spec, learning_rate: float | None = None
                       ) -> optax.GradientTransformation:

@@ -1,6 +1,13 @@
+from distkeras_tpu.parallel import collectives
+from distkeras_tpu.parallel.collectives import (Zero1Layout, all_gather,
+                                                 reduce_scatter,
+                                                 zero1_optimizer)
 from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh, local_device_count
-from distkeras_tpu.parallel.sharding import (ShardingPlan, dp_plan,
-                                              fsdp_plan, tp_plan)
+from distkeras_tpu.parallel.sharding import (ShardingPlan, Zero1Plan,
+                                              dp_plan, fsdp_plan, tp_plan,
+                                              zero1_plan)
 
 __all__ = ["MeshSpec", "make_mesh", "local_device_count", "ShardingPlan",
-           "dp_plan", "fsdp_plan", "tp_plan"]
+           "dp_plan", "fsdp_plan", "tp_plan", "zero1_plan", "Zero1Plan",
+           "collectives", "Zero1Layout", "reduce_scatter", "all_gather",
+           "zero1_optimizer"]

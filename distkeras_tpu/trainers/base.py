@@ -19,6 +19,7 @@ from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.adapter import ModelAdapter
 from distkeras_tpu.resilience import chaos
 from distkeras_tpu.resilience.chaos import Preempted
+from distkeras_tpu.utils.profiling import StepTimer
 
 
 class CheckpointingBase:
@@ -164,6 +165,11 @@ class Trainer(CheckpointingBase):
         self.seed = seed
         self.training_time: float = 0.0
         self.history: list[float] = []
+        # Per-run phase observability (utils/profiling.StepTimer): the
+        # distributed trainers populate "h2d" (host staging + transfer
+        # dispatch) and "step" (jitted dispatch) so an input-bound run
+        # reads differently from a compute-bound one without a profile.
+        self.step_timer = StepTimer()
         # Checkpoint/resume (SURVEY.md §5: the reference has none; here
         # any trainer can persist its full training state via orbax).
         self._setup_checkpointing(

@@ -33,7 +33,8 @@ from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.parallel.mesh import (MeshSpec, equal_across_hosts,
                                           make_mesh, per_host_rows,
                                           global_batch as mesh_global_batch)
-from distkeras_tpu.parallel.sharding import ShardingPlan, dp_plan, fsdp_plan
+from distkeras_tpu.parallel.sharding import (ShardingPlan, dp_plan,
+                                              fsdp_plan, zero1_plan)
 from distkeras_tpu.trainers.base import Trainer
 
 
@@ -52,6 +53,15 @@ class DistributedTrainer(Trainer):
     optimizer state scatter over the data axis (ZeRO-3) instead of
     replicating — identical training math, ~num_workers x less
     parameter memory per device.
+
+    ``zero1=True`` shards only the *weight update*: parameters stay
+    replicated (forward/backward untouched), the optimizer state
+    scatters over the data axis, and each round's exchange becomes
+    reduce-scatter(grads) -> per-replica shard update ->
+    all-gather(update), in ~``zero1_bucket_mb`` fusion buckets
+    (parallel/collectives.py).  Identical math at unchanged
+    communication volume, ~num_workers x less optimizer memory and
+    update compute per device; see docs/zero1.md for zero1 vs fsdp.
     """
 
     _supports_device_data = False
@@ -61,6 +71,7 @@ class DistributedTrainer(Trainer):
                  batch_size: int = 32, num_epoch: int = 1,
                  num_workers: int | None = None, mesh=None,
                  plan: ShardingPlan | None = None, fsdp: bool = False,
+                 zero1: bool = False, zero1_bucket_mb: float | None = None,
                  device_data: bool = False, **kw):
         super().__init__(keras_model, loss=loss,
                          worker_optimizer=worker_optimizer,
@@ -74,9 +85,21 @@ class DistributedTrainer(Trainer):
                 "DOWNPOUR/Averaging/Ensemble), SingleTrainer, and "
                 "LMTrainer")
         self.device_data = device_data
-        if fsdp and plan is not None:
-            raise ValueError("pass either plan= or fsdp=True, not both")
-        self.plan = plan or (fsdp_plan() if fsdp else dp_plan())
+        if sum((fsdp, zero1, plan is not None)) > 1:
+            raise ValueError(
+                "pass only one of plan=, fsdp=True, zero1=True — they are "
+                "alternative placement policies for the same state")
+        if zero1_bucket_mb is not None and not zero1:
+            raise ValueError(
+                "zero1_bucket_mb only applies with zero1=True (the "
+                "plan=zero1_plan(...) spelling carries its own bucket_mb)")
+        self.plan = plan or (fsdp_plan() if fsdp
+                             else zero1_plan(zero1_bucket_mb) if zero1
+                             else dp_plan())
+        # plan=zero1_plan() is the explicit spelling of zero1=True: the
+        # plan's sharded opt-state layout only exists if the optimizer
+        # is wrapped to produce it.
+        zero1 = zero1 or bool(getattr(self.plan, "zero1", False))
         if mesh is not None:
             self.mesh = mesh
         else:
@@ -89,6 +112,16 @@ class DistributedTrainer(Trainer):
                     "on-device anyway")
             self.mesh = make_mesh(MeshSpec(data=n), devices=devices[:n])
         self.num_workers = int(self.mesh.shape["data"])
+        self.zero1 = zero1
+        if zero1:
+            from distkeras_tpu.parallel.collectives import zero1_enable
+
+            # Wrap AFTER the adapter resolved the optimizer: the wrapper
+            # is a drop-in GradientTransformation, so init_state and
+            # every accum/train step builder pick it up unchanged.
+            self.adapter.optimizer = zero1_enable(
+                self.adapter.optimizer, self.mesh, spec=worker_optimizer,
+                bucket_mb=self.plan.bucket_mb)
 
     # ------------------------------------------------------------ helpers
 
@@ -152,8 +185,10 @@ class ADAG(DistributedTrainer):
                 for xs, ys in dataset.batches(
                         feed_bs, features_col=self.features_col,
                         label_col=self.label_col, window=w):
-                    yield (self._global_batch(xs, batch_sh),
-                           self._global_batch(ys, batch_sh))
+                    with self.step_timer.phase("h2d"):
+                        args = (self._global_batch(xs, batch_sh),
+                                self._global_batch(ys, batch_sh))
+                    yield args
 
         return self._run_rounds(state, step, stream(), feed_bs * w,
                                 dataset)
@@ -168,7 +203,8 @@ class ADAG(DistributedTrainer):
             rnd += 1
             if rnd <= start:
                 continue
-            state, loss = step(state, *args)
+            with self.step_timer.phase("step"):
+                state, loss = step(state, *args)
             losses.append(loss)
             self._checkpoint(state, rnd)
             self._eval_hook(state, rnd)
@@ -223,7 +259,9 @@ class ADAG(DistributedTrainer):
                 for i in range(0, n - (n % rows), rows):
                     idx = np.arange(i, i + rows, dtype=np.int32).reshape(
                         w, global_bs)
-                    yield (X, Y, jax.device_put(idx, idx_sh))
+                    with self.step_timer.phase("h2d"):
+                        idx_dev = jax.device_put(idx, idx_sh)
+                    yield (X, Y, idx_dev)
 
         return self._run_rounds(state, step, index_blocks(), rows,
                                 dataset)
@@ -323,8 +361,10 @@ class ADAG(DistributedTrainer):
                     # device_put cannot target non-addressable devices;
                     # every host holds the identical block, so assemble
                     # the replicated global array from the local copy.
-                    yield (X, Y, jax.make_array_from_process_local_data(
-                        rep, idx, idx.shape))
+                    with self.step_timer.phase("h2d"):
+                        idx_dev = jax.make_array_from_process_local_data(
+                            rep, idx, idx.shape)
+                    yield (X, Y, idx_dev)
 
         return self._run_rounds(state, step, index_blocks(), feed_bs * w,
                                 dataset)

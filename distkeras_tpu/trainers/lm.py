@@ -36,6 +36,7 @@ from distkeras_tpu.parallel.mesh import (AXES, make_mesh,
 from distkeras_tpu.parallel.ring import make_ring_attention
 from distkeras_tpu.parallel.sharding import ShardingPlan
 from distkeras_tpu.trainers.base import CheckpointingBase
+from distkeras_tpu.utils.profiling import StepTimer
 
 
 _OPTS = {
@@ -92,6 +93,17 @@ class LMTrainer(CheckpointingBase):
     unchanged train step inside the same jitted program.  Data order
     is bit-for-bit the streaming path's (parity-tested).
 
+    ``zero1=True``: cross-replica sharded weight update (ZeRO-1,
+    docs/zero1.md).  Parameters stay replicated — forward/backward are
+    untouched — but the optimizer state scatters over the ``data`` axis
+    and the step becomes reduce-scatter(grads) -> each replica updates
+    its shard -> all-gather(update), in ~``zero1_bucket_mb`` fusion
+    buckets (parallel/collectives.py).  Math-identical at unchanged
+    communication volume; per-device optimizer memory (adam moments,
+    the EMA shadow) and update FLOPs drop ~data-axis x.  Pure-DP meshes
+    only; ``fsdp=True`` (ZeRO-3) is the alternative when parameter
+    memory itself must shard.
+
     ``ema_decay``: maintain a Polyak/EMA average of the weights inside
     the optimizer state (decay per optimizer step); after ``train``,
     ``self.ema_params`` holds the servable averaged tree.  Composes
@@ -115,6 +127,7 @@ class LMTrainer(CheckpointingBase):
                  batch_size: int = 8,
                  num_epoch: int = 1, mesh=None, rules=None,
                  microbatches: int | None = None, fsdp: bool = False,
+                 zero1: bool = False, zero1_bucket_mb: float | None = None,
                  device_data: bool = False,
                  grad_accum: int = 1, grad_clip_norm: float | None = None,
                  tokens_col: str = "tokens", seed: int = 0,
@@ -204,6 +217,9 @@ class LMTrainer(CheckpointingBase):
         # MoE aux), so exp(loss) is honest perplexity.
         self.eval_history: list[tuple[int, dict]] = []
         self.training_time: float = 0.0
+        # Same phase observability as the Keras trainer family: "h2d"
+        # = host staging + transfer dispatch, "step" = jitted dispatch.
+        self.step_timer = StepTimer()
         self._setup_checkpointing(
             checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
             max_checkpoints=max_checkpoints, resume=resume, shuffle=shuffle,
@@ -246,6 +262,29 @@ class LMTrainer(CheckpointingBase):
                 "microbatches only applies with a pipeline mesh axis > 1 "
                 f"(mesh has pipeline={n_pipe})")
         self.microbatches = microbatches or (2 * n_pipe if n_pipe > 1 else 1)
+
+        self.zero1 = zero1
+        if zero1_bucket_mb is not None and not zero1:
+            raise ValueError(
+                "zero1_bucket_mb only applies with zero1=True")
+        if zero1:
+            if fsdp:
+                raise ValueError(
+                    "zero1=True (sharded weight update) and fsdp=True "
+                    "(ZeRO-3) are exclusive: fsdp already scatters the "
+                    "optimizer state along with the parameters")
+            from distkeras_tpu.parallel.collectives import (
+                DEFAULT_BUCKET_MB, zero1_enable)
+
+            self._zero1_bucket_mb = (DEFAULT_BUCKET_MB
+                                     if zero1_bucket_mb is None
+                                     else zero1_bucket_mb)
+            # Wrap LAST, outside clip/EMA/weight-decay chains: the whole
+            # chain then runs on shard views (the EMA shadow and adam
+            # moments scatter too — the memory win covers them all).
+            self.optimizer = zero1_enable(
+                self.optimizer, self.mesh, spec=optimizer,
+                bucket_mb=self._zero1_bucket_mb)
 
         # segments (packed sequences) ride EVERY trunk: the default
         # flash attention, the ring (seq-axis) path — make_ring_attention
@@ -352,9 +391,20 @@ class LMTrainer(CheckpointingBase):
         """Sharding trees for (params, opt_state): subtrees of the
         optimizer state mirroring the params structure (adam mu/nu,
         momentum buffers) take the params' shardings; everything else
-        (step counters) is replicated."""
+        (step counters) is replicated.
+
+        Under ``zero1`` the optimizer state instead holds ``[n, cols]``
+        shard views and takes the shared shard-view sharding rule
+        (``collectives.zero1_state_shardings``).
+        """
         psh = self.plan.tree_shardings(self.mesh, params)
         rep = NamedSharding(self.mesh, P())
+        if self.zero1:
+            from distkeras_tpu.parallel.collectives import (
+                zero1_state_shardings)
+
+            return psh, zero1_state_shardings(params, opt_state,
+                                              self.mesh)
         p_def = jax.tree.structure(params)
 
         def params_like(x):
@@ -636,7 +686,8 @@ class LMTrainer(CheckpointingBase):
                                          dtype=np.int32)
                         idx = (flat.reshape(self.grad_accum, sub)
                                if self.grad_accum > 1 else flat)
-                        step_args = (X_dev, self._replicated(idx))
+                        with self.step_timer.phase("h2d"):
+                            step_args = (X_dev, self._replicated(idx))
                     else:
                         block = np.asarray(tokens[i:i + rows_per_step],
                                            np.int32)
@@ -654,17 +705,21 @@ class LMTrainer(CheckpointingBase):
                             block = block.reshape(self.grad_accum,
                                                   global_bs // n_proc,
                                                   block.shape[1])
-                        step_args = (self._global_batch(block, step_sh),)
+                        with self.step_timer.phase("h2d"):
+                            step_args = (self._global_batch(block,
+                                                            step_sh),)
                     if self.profile_dir and rnd == prof_start:
                         jax.profiler.start_trace(self.profile_dir)
                         profiling = True
                     rng = (jax.random.fold_in(drop_base, rnd)
                            if dropping else None)
-                    if self.device_data:
-                        carry, loss = step(carry, *step_args, rng, seg_dev)
-                    else:
-                        carry, loss = step(carry, *step_args, rng,
-                                           seg_batch)
+                    with self.step_timer.phase("step"):
+                        if self.device_data:
+                            carry, loss = step(carry, *step_args, rng,
+                                               seg_dev)
+                        else:
+                            carry, loss = step(carry, *step_args, rng,
+                                               seg_batch)
                     if (profiling
                             and rnd >= prof_start - 1 + self.profile_steps):
                         jax.block_until_ready(loss)  # flush async device work
@@ -703,6 +758,15 @@ class LMTrainer(CheckpointingBase):
         params, opt_state = carry
         if self._ema:
             self._ema_params = opt_state[1]
+            if self.zero1:
+                # The shadow rode the optimizer state as scattered
+                # shard views; hand the user back a params-layout tree.
+                from distkeras_tpu.parallel.collectives import Zero1Layout
+
+                layout = Zero1Layout.for_tree(
+                    params, int(self.mesh.shape["data"]),
+                    self._zero1_bucket_mb)
+                self._ema_params = layout.unview(self._ema_params)
         jax.block_until_ready(jax.tree.leaves(params)[0])
         self.history = [float(l) for l in losses]
         self.training_time = time.perf_counter() - t0
@@ -750,6 +814,13 @@ class LoRATrainer(LMTrainer):
                 "adapter-masked optimizer state cannot shadow the "
                 "frozen base; serve the merged tree train() returns "
                 "(or EMA-average adapters outside the trainer)")
+        if kw.get("zero1"):
+            raise ValueError(
+                "zero1 is not supported on LoRATrainer: the masked "
+                "packed (adapters, base) state keeps moments only for "
+                "the ~1000x-smaller adapter leaves, so there is nothing "
+                "worth sharding — and the frozen base must stay whole "
+                "for the in-step merge")
         super().__init__(cfg, **kw)
         self.optimizer = optax.masked(self.optimizer, lora_mask)
         self._base_host = base_params

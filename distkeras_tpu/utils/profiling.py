@@ -57,12 +57,50 @@ class StepTimer:
     Device work is async: individual step dispatches return immediately,
     so per-call timing lies.  The timer therefore measures *rounds*
     (sync → work → sync) and divides by the step count you report.
+
+    **Named phase counters** (``phase``/``phase_s``/``phase_stats``)
+    accumulate host wall time per phase across the run — the
+    distributed trainers record ``"h2d"`` (host-side batch staging +
+    transfer dispatch) and ``"step"`` (the jitted
+    reduce-scatter+update+gather dispatch), so an input-bound run is
+    distinguishable from a compute-bound one without a profiler.  The
+    *device-side* split of a step — reduce vs update vs gather — is by
+    design not host-observable (overlap means those regions interleave
+    on the timeline); the ZeRO-1 update tags them with
+    ``jax.named_scope`` (``zero1/reduce_scatter``, ``zero1/update``,
+    ``zero1/all_gather``) so :func:`trace` profiles show the overlap,
+    and ``scripts/bench_suite.py zero1_update`` measures the update
+    phase as a number.
     """
 
     def __init__(self):
         self.rounds: list[tuple[float, int]] = []  # (seconds, n_steps)
+        self.phases: dict[str, tuple[float, int]] = {}  # name -> (s, calls)
         self._t0: float | None = None
         self._n = 0
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Accumulate host wall time under ``name`` (re-entrant safe to
+        nest *different* names; never syncs the device — wrap dispatch
+        sites, then ``finalize`` closes the round with one barrier)."""
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            dt = time.perf_counter() - t0
+            s, c = self.phases.get(name, (0.0, 0))
+            self.phases[name] = (s + dt, c + 1)
+
+    def phase_s(self, name: str) -> float:
+        """Total seconds accumulated under ``name`` (0.0 if unused)."""
+        return self.phases.get(name, (0.0, 0))[0]
+
+    def phase_stats(self) -> dict:
+        """``{name: {"total_s", "calls", "mean_s"}}`` for every phase."""
+        return {name: {"total_s": s, "calls": c,
+                       "mean_s": s / c if c else 0.0}
+                for name, (s, c) in self.phases.items()}
 
     @contextlib.contextmanager
     def round(self, n_steps: int = 0):

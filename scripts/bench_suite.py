@@ -649,6 +649,96 @@ def bench_cifar_cnn_resident():
     return batch * steps / dt, dt / steps, step_flops
 
 
+def bench_zero1_update(batch_unused=None, iters=30):
+    """The weight-update phase in isolation: replicated update vs the
+    ZeRO-1 sharded update (docs/zero1.md), over a data axis spanning
+    every visible device.
+
+    Training-step benchmarks hide the update behind the forward/backward;
+    this one feeds a fixed synthetic gradient of the flagship short
+    transformer config to adamw directly, so the measured wall is
+    exactly exchange + update math — the thing ZeRO-1 shards.  Reports
+    per-device optimizer-state bytes for both layouts (from the sharded
+    state's addressable shards — the ~num_workers x memory claim as a
+    measured number) and the update-time pair.  On a single-device
+    backend the two paths coincide (ratio ~1): the win needs a real
+    data axis.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.parallel.collectives import (zero1_optimizer,
+                                                    zero1_state_shardings)
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshSpec(data=n_dev))
+    cfg = tfm.TransformerConfig(
+        vocab_size=32768, d_model=512, n_heads=4, n_layers=4, d_ff=2048,
+        max_len=1025, dtype="bfloat16")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    grads = jax.tree.map(lambda p: p * 1e-3, params)
+    opt = optax.adamw(3e-4)
+    rep = NamedSharding(mesh, P())
+    params = jax.device_put(params, jax.tree.map(lambda _: rep, params))
+    grads = jax.device_put(grads, jax.tree.map(lambda _: rep, grads))
+
+    def bytes_per_device(state):
+        return sum(l.addressable_shards[0].data.nbytes
+                   for l in jax.tree.leaves(state)
+                   if hasattr(l, "addressable_shards"))
+
+    def measure(optimizer, state_shardings):
+        state = jax.jit(optimizer.init,
+                        out_shardings=state_shardings)(params)
+        per_dev = bytes_per_device(state)
+
+        def upd(g, s, p):
+            u, s2 = optimizer.update(g, s, p)
+            return optax.apply_updates(p, u), s2
+
+        psh = jax.tree.map(lambda _: rep, params)
+        step = jax.jit(upd, donate_argnums=(1, 2),
+                       in_shardings=(psh, state_shardings, psh),
+                       out_shardings=(psh, state_shardings))
+        # The step donates its params operand; work on a copy so the
+        # shared tree survives for the other layout's measurement.
+        p = jax.tree.map(jnp.copy, params)
+        for _ in range(3):
+            p, state = step(grads, state, p)
+        jax.block_until_ready(p)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, state = step(grads, state, p)
+        jax.block_until_ready(p)
+        return (time.perf_counter() - t0) / iters, per_dev
+
+    # Replicated baseline: every state leaf whole on every device.
+    opt_shapes = jax.eval_shape(opt.init, params)
+    rep_sh = jax.tree.map(lambda _: rep, opt_shapes)
+    rep_s, rep_bytes = measure(opt, rep_sh)
+
+    z = zero1_optimizer(opt, mesh)
+    z_sh = zero1_state_shardings(params, jax.eval_shape(z.init, params),
+                                 mesh)
+    z_s, z_bytes = measure(z, z_sh)
+
+    n_params = sum(int(np.prod(np.shape(l)))
+                   for l in jax.tree.leaves(params))
+    return 1.0 / z_s, z_s, 0.0, {
+        "n_devices": n_dev, "n_params": n_params,
+        "update_ms_replicated": round(rep_s * 1e3, 3),
+        "update_ms_zero1": round(z_s * 1e3, 3),
+        "update_speedup": round(rep_s / z_s, 3),
+        "opt_bytes_per_device_replicated": rep_bytes,
+        "opt_bytes_per_device_zero1": z_bytes,
+        "opt_memory_ratio": round(rep_bytes / max(z_bytes, 1), 2),
+    }
+
+
 def bench_lm_e2e(device_data):
     """End-to-end ``LMTrainer.train()`` throughput over real host rows,
     streaming vs ``device_data=True`` — the LM flagship's input-plane
@@ -733,6 +823,7 @@ BENCHES = {
     "lora_finetune": (bench_lora_finetune, "tokens/sec/chip"),
     "lm_e2e_stream": (bench_lm_e2e(False), "tokens/sec/chip"),
     "lm_e2e_device_data": (bench_lm_e2e(True), "tokens/sec/chip"),
+    "zero1_update": (bench_zero1_update, "updates/sec"),
 }
 
 

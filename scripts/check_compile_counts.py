@@ -1,0 +1,192 @@
+"""Recompile guard: assert jit compile counts against a checked-in budget.
+
+Silent shape-bucket regressions — a trainer that starts recompiling per
+round because a batch shape stopped being static, a serving engine
+whose admission path grows an extra program per prompt length — show up
+as *throughput* losses long after the PR that caused them.  This guard
+catches them at review time: it runs a fixed small session of each
+subsystem (a plain and a ZeRO-1 ``ADAG`` round loop, an ``LMTrainer``
+run, and a ``ContinuousBatcher`` serve session with two prompt buckets)
+on the deterministic 8-device CPU mesh, counts actual backend compiles
+via ``jax.monitoring``'s ``/jax/core/compile/backend_compile_duration``
+event, and compares against ``scripts/compile_budget.json``.
+
+Usage::
+
+    python scripts/check_compile_counts.py           # check (rc=1 over budget)
+    python scripts/check_compile_counts.py --update  # rewrite the budget
+
+A session exceeding its budget fails; a session compiling *less* than
+budget prints a note (ratchet the budget down with ``--update``).
+Budgets are exact for this container's pinned jax; across jax upgrades
+re-record with ``--update`` and review the diff.
+"""
+
+import json
+import os
+import sys
+
+# Deterministic substrate BEFORE jax initializes: the same 8-device CPU
+# mesh the test suite uses (tests/conftest.py), so budgets are stable
+# regardless of what accelerator is attached.
+os.environ["KERAS_BACKEND"] = "jax"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "compile_budget.json")
+
+_COMPILES = {"n": 0}
+
+
+def _install_counter():
+    import jax.monitoring
+
+    def on_duration(event, duration, **kw):
+        if event == "/jax/core/compile/backend_compile_duration":
+            _COMPILES["n"] += 1
+
+    jax.monitoring.register_event_duration_secs_listener(on_duration)
+
+
+class _count:
+    """Context manager: number of backend compiles inside the block."""
+
+    def __enter__(self):
+        self.start = _COMPILES["n"]
+        return self
+
+    def __exit__(self, *exc):
+        self.n = _COMPILES["n"] - self.start
+
+
+def session_adag(zero1: bool):
+    """Two ADAG rounds; every round after the first must hit the cache
+    (one accum-step program; shapes are static by construction)."""
+    import numpy as np
+
+    import distkeras_tpu as dk
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 8)).astype(np.float32)
+    y = rng.integers(0, 4, 128).astype(np.int32)
+    ds = dk.Dataset({"features": x, "label": y})
+    import keras
+
+    model = keras.Sequential([keras.layers.Input((8,)),
+                              keras.layers.Dense(16, activation="relu"),
+                              keras.layers.Dense(4)])
+    t = dk.ADAG(model, loss="sparse_categorical_crossentropy",
+                worker_optimizer="adam", learning_rate=0.05,
+                batch_size=4, num_epoch=2, communication_window=2,
+                zero1=zero1)
+    t.train(ds)
+    assert len(t.history) == 4, t.history
+
+
+def session_lm():
+    """Four LMTrainer optimizer steps, one compiled step program."""
+    import numpy as np
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=16)
+    rows = np.random.default_rng(0).integers(
+        0, 64, (32, 17)).astype(np.int32)
+    t = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=8, num_epoch=1)
+    t.train(rows)
+    assert len(t.history) == 4, t.history
+
+
+def session_serving():
+    """ContinuousBatcher session touching two prompt buckets: expected
+    programs = one admission per touched bucket + the decode step
+    (+ cache init).  A third bucket's worth of compiles appearing here
+    means admission bucketing regressed."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.serving import ContinuousBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32, rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    eng = ContinuousBatcher(params, cfg, lanes=2, prompt_buckets=(8, 16))
+    rng = np.random.default_rng(0)
+    lanes = [eng.submit(rng.integers(0, 64, (5,)).astype(np.int32), 6),
+             eng.submit(rng.integers(0, 64, (12,)).astype(np.int32), 6)]
+    for lane in lanes:
+        while lane in eng.running():
+            eng.step()
+        eng.drain(lane)
+    # Same-bucket re-admission must be compile-free.
+    lane = eng.submit(rng.integers(0, 64, (7,)).astype(np.int32), 4)
+    while lane in eng.running():
+        eng.step()
+    eng.drain(lane)
+
+
+SESSIONS = {
+    "adag": lambda: session_adag(zero1=False),
+    "adag_zero1": lambda: session_adag(zero1=True),
+    "lm_trainer": session_lm,
+    "serving": session_serving,
+}
+
+
+def main(argv):
+    update = "--update" in argv
+    _install_counter()
+
+    measured = {}
+    for name, fn in SESSIONS.items():
+        with _count() as c:
+            fn()
+        measured[name] = c.n
+        print(f"{name}: {c.n} compiles", file=sys.stderr)
+
+    if update:
+        with open(BUDGET_PATH, "w") as f:
+            json.dump({"comment": "backend compiles per session on the "
+                                  "8-device CPU mesh; re-record with "
+                                  "--update on jax upgrades",
+                       "budgets": measured}, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {BUDGET_PATH}: {measured}")
+        return 0
+
+    try:
+        with open(BUDGET_PATH) as f:
+            budgets = json.load(f)["budgets"]
+    except (OSError, ValueError, KeyError):
+        print(f"no readable budget at {BUDGET_PATH}; run with --update "
+              "to record one", file=sys.stderr)
+        return 1
+
+    rc = 0
+    for name, n in measured.items():
+        budget = budgets.get(name)
+        if budget is None:
+            print(f"FAIL {name}: no budget recorded (run --update)")
+            rc = 1
+        elif n > budget:
+            print(f"FAIL {name}: {n} compiles > budget {budget} — a "
+                  "shape bucket regressed (something recompiles per "
+                  "round/request)")
+            rc = 1
+        elif n < budget:
+            print(f"ok   {name}: {n} compiles (budget {budget} is stale "
+                  "— consider --update to ratchet down)")
+        else:
+            print(f"ok   {name}: {n} compiles == budget")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

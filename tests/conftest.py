@@ -59,11 +59,14 @@ def mlp():
 # subsystem); the full suite (~25 min) stays the merge gate.  The SLOW
 # set was measured with `pytest --durations=0` (call time >= 4 s on one
 # core); refresh it the same way when tests move.  Deliberate
-# exception when refreshing: test_sharded_decode::
+# exceptions when refreshing: test_sharded_decode::
 # test_generate_sampled_tp_sharded_matches_single stays UNmarked even
 # though it exceeds the threshold — it is the fast gate's one
 # sharded-decode representative (the README promises the gate covers
-# every subsystem).  MULTIPROCESS tests
+# every subsystem) — and test_zero1::test_adag_zero1_matches_replicated
+# / test_zero1::test_lm_zero1_matches_dp stay UNmarked as the fast
+# gate's ZeRO-1 parity representatives for the two trainer families
+# (the sharded-update acceptance contract).  MULTIPROCESS tests
 # spawn OS subprocesses (multi-host runtime, crash recovery, the driver
 # dryrun) — they are also slow, and worth selecting on their own when
 # debugging the distributed runtime: `pytest -m multiprocess`.
@@ -212,6 +215,9 @@ SLOW = MULTIPROCESS | {
     "test_transformer::test_z_loss_trains_and_shrinks_normalizer",
     "test_zoo_and_entry::test_cifar_cnn_forward",
     "test_zoo_and_entry::test_graft_entry_single",
+    "test_zero1::test_lm_zero1_checkpoint_resume",
+    "test_zero1::test_lm_zero1_clip_ema_matches_dp",
+    "test_zero1::test_lm_zero1_grad_accum_matches_dp",
 }
 
 
