@@ -1,0 +1,29 @@
+"""Static analysis: machine-check the invariants the docs only claim.
+
+Two layers over one findings model (``findings.py``):
+
+* :mod:`~distkeras_tpu.analysis.ir_lint` — trace the trainers' and
+  serving engines' REAL compiled step functions (each subsystem exposes
+  them via ``traced_for_analysis()``) and audit the closed jaxpr plus
+  the post-SPMD compiled HLO: per-step collective census against
+  ``scripts/comm_budget.json``, dtype policy, donation coverage,
+  host callbacks inside jit, PRNG key reuse.
+* :mod:`~distkeras_tpu.analysis.source_lint` — an AST rule engine over
+  the package source with JAX-specific rules (wall-clock/np.random in
+  traced functions, host syncs in hot loops, import-time jnp compute,
+  axis-name typos, undonated step jits, ...).
+
+Both honor the ``# dkt: ignore[rule]`` suppression syntax and are wired
+into CI through ``scripts/graph_lint.py`` and the tier-1 tests
+(``tests/test_graph_lint.py`` / ``tests/test_budget_guards.py``); see
+docs/graph_lint.md for the rule catalogue and the budget-update
+workflow.
+"""
+
+from distkeras_tpu.analysis.findings import Finding, format_findings
+from distkeras_tpu.analysis.ir_lint import (CollectiveOp, TraceSpec,
+                                             comm_census, lint_trace)
+from distkeras_tpu.analysis.source_lint import lint_paths, lint_source
+
+__all__ = ["Finding", "format_findings", "TraceSpec", "CollectiveOp",
+           "comm_census", "lint_trace", "lint_source", "lint_paths"]

@@ -1,0 +1,90 @@
+"""The findings model both lint layers share.
+
+A finding is (rule id, severity, location, message, fix hint).  The
+source layer locates findings at ``path:line``; the IR layer locates
+them at the trace-target name (there is no one source line for a
+compiled program).  Suppression is per-line for source findings —
+``# dkt: ignore[rule-a,rule-b]`` (or a bare ``# dkt: ignore`` for every
+rule) on the flagged line — and per-target for IR findings (the
+``suppress=`` tuple on :class:`~distkeras_tpu.analysis.ir_lint.TraceSpec`).
+Suppressed findings are still *returned* (marked) so tooling can count
+them; only unsuppressed ones gate CI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+# error: a correctness/semantics violation.  warn: a performance or
+# hygiene hazard.  info: census/annotation output, never gating.
+SEVERITIES = ("error", "warn", "info")
+
+_IGNORE_RE = re.compile(r"#\s*dkt:\s*ignore(?:\[([\w ,\-]*)\])?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str               # file path, or the IR trace-target name
+    line: int | None
+    message: str
+    hint: str = ""
+    suppressed: bool = False
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"severity must be one of {SEVERITIES}, got "
+                f"{self.severity!r}")
+
+    @property
+    def gating(self) -> bool:
+        """Does this finding fail CI?  Unsuppressed error/warn only."""
+        return not self.suppressed and self.severity != "info"
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        sup = " (suppressed)" if self.suppressed else ""
+        hint = f" — {self.hint}" if self.hint else ""
+        return f"{loc}: {self.severity} [{self.rule}]{sup} {self.message}{hint}"
+
+
+def suppressed_rules(line_text: str) -> frozenset | None:
+    """Rules a ``# dkt: ignore[...]`` comment on this line suppresses.
+
+    Returns None when the line carries no ignore comment, an empty
+    frozenset for the bare ``# dkt: ignore`` (suppress every rule), or
+    the named rule set.  The scan is textual — a string literal
+    containing the marker would also match, which is harmless (the
+    syntax is ours) and keeps the check independent of the tokenizer.
+    """
+    m = _IGNORE_RE.search(line_text)
+    if m is None:
+        return None
+    if m.group(1) is None:
+        return frozenset()
+    return frozenset(r.strip() for r in m.group(1).split(",") if r.strip())
+
+
+def apply_suppressions(finding: Finding, line_text: str) -> Finding:
+    """Mark ``finding`` suppressed if ``line_text`` carries a matching
+    ignore comment (bare ignores match every rule)."""
+    rules = suppressed_rules(line_text)
+    if rules is None:
+        return finding
+    if rules and finding.rule not in rules:
+        return finding
+    return dataclasses.replace(finding, suppressed=True)
+
+
+def format_findings(findings) -> str:
+    lines = [f.format() for f in findings]
+    gating = sum(f.gating for f in findings)
+    lines.append(f"{len(lines)} finding(s), {gating} gating")
+    return "\n".join(lines)
+
+
+__all__ = ["Finding", "SEVERITIES", "suppressed_rules",
+           "apply_suppressions", "format_findings"]

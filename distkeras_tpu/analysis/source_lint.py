@@ -1,0 +1,314 @@
+"""Source lint: an AST rule engine with JAX-specific rules.
+
+The IR lint sees what XLA compiles; this layer catches the hazards
+that never reach a jaxpr — host work smuggled into traced functions,
+synchronization in hot loops, compute at import time.  Rules:
+
+=================  =====  ==================================================
+rule id            sev    fires on
+=================  =====  ==================================================
+jit-wallclock      error  ``time.time/perf_counter/monotonic`` or
+                          ``datetime.now`` inside a traced function (the
+                          value freezes at trace time — every later step
+                          replays the first call's clock)
+jit-np-random      error  ``np.random`` inside a traced function (host
+                          randomness freezes at trace time; use
+                          ``jax.random`` with an explicit key)
+hot-sync           warn   ``.block_until_ready()`` / ``jax.device_get`` in a
+                          for/while body of a trainer or serving module —
+                          a device sync per iteration on the hot path
+import-time-jnp    warn   a ``jnp.*``/``jax.numpy`` call at module scope:
+                          device compute (and backend init) at import time
+mutable-default    error  mutable default argument (list/dict/set) on a
+                          public function
+jit-no-donate      warn   ``jax.jit(step_like_fn)`` with no
+                          ``donate_argnums``: a state-carrying step that
+                          copies its carry every round
+axis-name          error  a mesh-axis string in ``P(...)`` or an
+                          ``axis_name=`` argument that is not one of the
+                          canonical ``parallel.mesh.AXES`` (typos silently
+                          replicate)
+loop-jit           warn   ``jax.jit(...)`` lexically inside a for/while
+                          body — a fresh jit wrapper (and cache entry) per
+                          iteration
+=================  =====  ==================================================
+
+Traced functions are found structurally: defs decorated with
+``jax.jit``/``partial(jax.jit, ...)``, defs passed by name to
+``jax.jit`` / ``shard_map`` / ``jax.lax.scan`` / ``jax.vmap`` /
+``jax.grad`` / ``jax.value_and_grad`` / ``jax.checkpoint`` /
+``jax.remat``, and every def nested inside one.  Suppress per line
+with ``# dkt: ignore[rule]`` (see findings.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from distkeras_tpu.analysis.findings import Finding, apply_suppressions
+from distkeras_tpu.parallel.mesh import AXES
+
+_TRACING_ENTRYPOINTS = {
+    "jit", "scan", "shard_map", "vmap", "pmap", "grad",
+    "value_and_grad", "checkpoint", "remat", "while_loop", "fori_loop",
+    "cond", "switch", "custom_jvp", "custom_vjp",
+}
+_WALLCLOCK = {("time", "time"), ("time", "perf_counter"),
+              ("time", "monotonic"), ("time", "process_time"),
+              ("datetime", "now"), ("datetime", "utcnow")}
+_SYNC_CALLS = {"block_until_ready", "device_get"}
+_HOT_PATH_DIRS = (os.path.join("distkeras_tpu", "trainers"),)
+_HOT_PATH_FILES = ("serving.py",)
+_STEP_NAME_HINT = ("step", "train", "update")
+
+
+def _attr_chain(node) -> list[str]:
+    """``a.b.c`` -> ["a", "b", "c"]; non-chains -> []."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def _call_name(call: ast.Call) -> str:
+    chain = _attr_chain(call.func)
+    return chain[-1] if chain else ""
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.lines = source.splitlines()
+        self.findings: list[Finding] = []
+        self.traced: set[ast.AST] = set()
+        self._parents: dict[ast.AST, ast.AST] = {}
+
+    # ---------------------------------------------------------- plumbing
+
+    def run(self, tree: ast.Module) -> list[Finding]:
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self._collect_traced(tree)
+        self.visit(tree)
+        return self.findings
+
+    def add(self, rule: str, severity: str, node: ast.AST, message: str,
+            hint: str = ""):
+        line = getattr(node, "lineno", None)
+        f = Finding(rule=rule, severity=severity, path=self.path,
+                    line=line, message=message, hint=hint)
+        if line is not None and line - 1 < len(self.lines):
+            f = apply_suppressions(f, self.lines[line - 1])
+        self.findings.append(f)
+
+    def _enclosing_defs(self, node) -> Iterable[ast.AST]:
+        cur = node
+        while cur in self._parents:
+            cur = self._parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                yield cur
+
+    def _in_traced(self, node) -> bool:
+        return any(d in self.traced for d in self._enclosing_defs(node))
+
+    def _in_loop(self, node) -> bool:
+        cur = node
+        while cur in self._parents:
+            parent = self._parents[cur]
+            if isinstance(parent, (ast.For, ast.While)) and (
+                    cur in parent.body or cur in parent.orelse):
+                return True
+            if isinstance(parent, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.Lambda)):
+                return False
+            cur = parent
+        return False
+
+    def _collect_traced(self, tree: ast.Module):
+        defs: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        roots: set[ast.AST] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    name = (_attr_chain(target) or [""])[-1]
+                    if name in ("jit", "partial"):
+                        names = {name} | {
+                            (_attr_chain(a) or [""])[-1]
+                            for a in getattr(dec, "args", [])}
+                        if "jit" in names:
+                            roots.add(node)
+            if isinstance(node, ast.Call) and (
+                    _call_name(node) in _TRACING_ENTRYPOINTS):
+                for arg in list(node.args) + [k.value for k in
+                                              node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        roots.add(arg)
+                    elif isinstance(arg, ast.Name):
+                        roots.update(defs.get(arg.id, ()))
+        # A def nested inside a traced def is traced too.
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                if node in roots or any(d in roots
+                                        for d in self._enclosing_defs(node)):
+                    self.traced.add(node)
+
+    # ------------------------------------------------------------- rules
+
+    def visit_Call(self, node: ast.Call):
+        chain = _attr_chain(node.func)
+        name = chain[-1] if chain else ""
+
+        if self._in_traced(node):
+            if len(chain) >= 2 and (chain[-2], name) in _WALLCLOCK:
+                self.add("jit-wallclock", "error", node,
+                         f"wall-clock call `{'.'.join(chain)}` inside a "
+                         "traced function",
+                         "the value is baked in at trace time; pass "
+                         "host timestamps in as arguments")
+            if "random" in chain and chain[0] in ("np", "numpy"):
+                self.add("jit-np-random", "error", node,
+                         f"host RNG `{'.'.join(chain)}` inside a traced "
+                         "function",
+                         "the draw happens once at trace time; use "
+                         "jax.random with an explicit key argument")
+
+        if name in _SYNC_CALLS and self._hot_path() and self._in_loop(node):
+            self.add("hot-sync", "warn", node,
+                     f"`{name}` inside a loop on a trainer/serving hot "
+                     "path",
+                     "a device sync per iteration serializes dispatch; "
+                     "sync once after the loop, or justify with a "
+                     "dkt: ignore")
+
+        if name == "jit" and chain[:1] in (["jax"], ["jit"]):
+            if self._in_loop(node):
+                self.add("loop-jit", "warn", node,
+                         "jax.jit called inside a loop body",
+                         "each iteration builds a fresh jit wrapper; "
+                         "hoist the jit out of the loop and reuse it")
+            kw = {k.arg for k in node.keywords}
+            target = node.args[0] if node.args else None
+            tname = ""
+            if isinstance(target, (ast.Name, ast.Attribute)):
+                tname = (_attr_chain(target) or [""])[-1]
+            if (tname and any(h in tname.lower() for h in _STEP_NAME_HINT)
+                    and "donate_argnums" not in kw
+                    and "donate_argnames" not in kw):
+                self.add("jit-no-donate", "warn", node,
+                         f"jax.jit({tname}) without donate_argnums",
+                         "a state-carrying step that does not donate "
+                         "its carry holds two copies of the state "
+                         "alive every round; donate the carry argument")
+
+        if name in ("P", "PartitionSpec"):
+            for arg in node.args:
+                self._check_axis_value(arg)
+        for k in node.keywords:
+            if k.arg in ("axis_name", "axis") and isinstance(
+                    k.value, ast.Constant) and isinstance(
+                        k.value.value, str):
+                self._check_axis_value(k.value)
+
+        self.generic_visit(node)
+
+    def _check_axis_value(self, node):
+        values = [node]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            values = list(node.elts)
+        for v in values:
+            if (isinstance(v, ast.Constant) and isinstance(v.value, str)
+                    and v.value not in AXES):
+                self.add("axis-name", "error", v,
+                         f"axis name {v.value!r} is not one of the "
+                         f"canonical mesh axes {AXES}",
+                         "a typo here silently replicates instead of "
+                         "sharding; use parallel.mesh.AXES names")
+
+    def _hot_path(self) -> bool:
+        norm = self.path.replace(os.sep, "/")
+        return (any(d.replace(os.sep, "/") in norm
+                    for d in _HOT_PATH_DIRS)
+                or any(norm.endswith(f) for f in _HOT_PATH_FILES))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        if not node.name.startswith("_"):
+            for default in (node.args.defaults
+                            + [d for d in node.args.kw_defaults if d]):
+                bad = isinstance(default, (ast.List, ast.Dict, ast.Set))
+                if (isinstance(default, ast.Call)
+                        and _call_name(default) in ("list", "dict", "set")):
+                    bad = True
+                if bad:
+                    self.add("mutable-default", "error", default,
+                             f"mutable default argument on public "
+                             f"function `{node.name}`",
+                             "the default is shared across calls; "
+                             "default to None and build inside")
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Module(self, node: ast.Module):
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Import,
+                                 ast.ImportFrom)):
+                continue
+            for call in ast.walk(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                # Skip calls nested inside defs/lambdas under this stmt
+                # (e.g. a module-level dict of lambdas is lazy).
+                if any(isinstance(d, (ast.FunctionDef, ast.Lambda))
+                       for d in self._enclosing_defs(call)):
+                    continue
+                chain = _attr_chain(call.func)
+                if chain[:1] == ["jnp"] or chain[:2] == ["jax", "numpy"]:
+                    self.add("import-time-jnp", "warn", call,
+                             f"`{'.'.join(chain)}` call at module "
+                             "import time",
+                             "device compute (and backend init) on "
+                             "import; build constants lazily or as "
+                             "plain numpy")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str = "<string>") -> list[Finding]:
+    """Lint one python source string."""
+    tree = ast.parse(source, filename=path)
+    return _Linter(path, source).run(tree)
+
+
+def lint_paths(paths: Iterable[str]) -> list[Finding]:
+    """Lint files/directories (``.py`` files, recursively)."""
+    findings: list[Finding] = []
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        else:
+            files.append(p)
+    for f in sorted(files):
+        with open(f, encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), path=f))
+    return findings
+
+
+__all__ = ["lint_source", "lint_paths"]

@@ -1,0 +1,120 @@
+"""The repo's standard IR-lint trace targets.
+
+One definition shared by ``scripts/graph_lint.py`` and the tier-1
+budget tests: a fixed tiny model per trainer family on the 8-device
+CPU mesh, reached through each subsystem's ``traced_for_analysis()``
+hook so the lint audits the REAL jitted step programs.  Model shapes
+are chosen with every parameter-leaf size divisible by the data-axis
+size, so the ZeRO-1 bucket layout is pad-free and the parity check is
+exact.
+
+Builders import keras/transformer lazily — importing this module must
+stay free of backend initialization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from distkeras_tpu.analysis.ir_lint import TraceSpec
+
+# (zero1 target, its replicated-DP partner) — the pairs the parity
+# check runs on.
+ZERO1_PARITY_PAIRS = (
+    ("adag_zero1/accum_step", "adag_dp/accum_step"),
+    ("lmtrainer_zero1/train_step", "lmtrainer_dp/train_step"),
+)
+
+
+def _lm_cfg():
+    from distkeras_tpu.models import transformer as tfm
+
+    # All leaf sizes divide by 8: embedding 64x32, pos 16x32, attn
+    # 32x32, mlp 32x64/64x32, norms 32.
+    return tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, max_len=16)
+
+
+def _mlp_trainer(zero1: bool):
+    import keras
+
+    import distkeras_tpu as dk
+
+    # 8 -> 16 -> 8: kernels 8x16 / 16x8, biases 16 / 8 — every leaf
+    # size a multiple of the 8-wide data axis.
+    model = keras.Sequential([keras.layers.Input((8,)),
+                              keras.layers.Dense(16, activation="relu"),
+                              keras.layers.Dense(8)])
+    return dk.ADAG(model, loss="sparse_categorical_crossentropy",
+                   worker_optimizer="adam", learning_rate=0.05,
+                   batch_size=4, communication_window=2, zero1=zero1)
+
+
+def _mlp_dataset():
+    import numpy as np
+
+    import distkeras_tpu as dk
+
+    rng = np.random.default_rng(0)
+    return dk.Dataset({
+        "features": rng.normal(size=(64, 8)).astype(np.float32),
+        "label": rng.integers(0, 8, 64).astype(np.int32)})
+
+
+def adag_targets() -> list[TraceSpec]:
+    ds = _mlp_dataset()
+    specs = (_mlp_trainer(zero1=False).traced_for_analysis(ds)
+             + _mlp_trainer(zero1=True).traced_for_analysis(ds))
+    return _pair(specs)
+
+
+def lm_targets() -> list[TraceSpec]:
+    import distkeras_tpu as dk
+
+    cfg = _lm_cfg()
+    specs = []
+    for kw in ({}, {"zero1": True}, {"fsdp": True}):
+        t = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=8, **kw)
+        specs += t.traced_for_analysis()
+    return _pair(specs)
+
+
+def serving_targets() -> list[TraceSpec]:
+    import jax
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import transformer as tfm
+
+    cfg = _lm_cfg()
+    params = tfm.init_params(jax.random.key(0), cfg)
+    cb = dk.ContinuousBatcher(params, cfg, lanes=2,
+                              per_request_sampling=True,
+                              prompt_buckets=(8,))
+    draft = tfm.TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
+                                  n_layers=1, d_ff=32, max_len=16)
+    dparams = tfm.init_params(jax.random.key(1), draft)
+    sb = dk.SpeculativeBatcher(params, dparams, cfg, draft, lanes=2,
+                               n_draft=2, temperature=0.7)
+    return cb.traced_for_analysis() + sb.traced_for_analysis()
+
+
+def _pair(specs: list[TraceSpec]) -> list[TraceSpec]:
+    """Attach the declared parity partners to the zero1 specs."""
+    names = {s.name for s in specs}
+    out = []
+    for s in specs:
+        for z1, dp in ZERO1_PARITY_PAIRS:
+            if s.name == z1 and dp in names:
+                s = dataclasses.replace(s, zero1_parity_with=dp)
+        out.append(s)
+    return out
+
+
+def default_targets() -> list[TraceSpec]:
+    """Every standard target: both trainer families (DP / zero1 /
+    fsdp) plus both serving engines' decode steps."""
+    return adag_targets() + lm_targets() + serving_targets()
+
+
+__all__ = ["ZERO1_PARITY_PAIRS", "adag_targets", "lm_targets",
+           "serving_targets", "default_targets"]

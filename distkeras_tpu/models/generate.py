@@ -659,15 +659,31 @@ def top_k_mask(logits, k: int, exact: bool = False):
     return jnp.where(logits < kth, -jnp.inf, logits)
 
 
-def _validate_unit_interval(name, p):
-    """Range-check a sampling filter value when it is concretely
-    scalar (python/numpy scalars and 0-d arrays outside jit); per-row
-    arrays and tracers pass through — THEIR values are validated by
-    the caller (the serving engine's submit/constructor)."""
-    if isinstance(p, jax.core.Tracer) or np.ndim(p) != 0:
+def _validate_unit_interval(name, p, zero_ok: bool = False):
+    """Range-check a sampling filter value whenever it is CONCRETE —
+    scalars and per-row arrays alike; only tracers pass through (their
+    values are validated by the caller: the serving engine's
+    submit/constructor, generate's argument checks).
+
+    Round-6 fix: non-scalar concrete values used to skip validation
+    entirely, so a direct ``top_p_mask``/``min_p_mask`` caller with an
+    out-of-range array (e.g. a negative min_p) got silent NaN masking
+    instead of an error.  ``zero_ok`` admits 0.0 in per-row ARRAYS
+    only — the serving engines' explicit "no min-p filter" slot value
+    (log 0 = -inf keeps every token); a scalar 0.0 stays an error (the
+    scalar no-op spelling is None), and top_p keeps the open lower
+    bound everywhere (a 0.0 nucleus would mask every token).
+    """
+    if isinstance(p, jax.core.Tracer):
         return
-    if not 0.0 < float(p) <= 1.0:
-        raise ValueError(f"{name} must be in (0, 1], got {p}")
+    vals = np.asarray(p)
+    zero_ok = zero_ok and vals.ndim > 0
+    lo_ok = (vals >= 0.0) if zero_ok else (vals > 0.0)
+    if not np.all(lo_ok & (vals <= 1.0)):
+        lo = "[0, 1]" if zero_ok else "(0, 1]"
+        raise ValueError(
+            f"{name} must be in {lo}, got "
+            f"{p if np.ndim(p) == 0 else vals}")
 
 
 def min_p_mask(logits, min_p):
@@ -681,9 +697,11 @@ def min_p_mask(logits, min_p):
 
     ``min_p`` may be a per-row ``[B, 1]`` array (the serving engine's
     per-request path); a row of 0.0 is a no-op (log 0 = -inf keeps
-    everything) — array values are validated by the caller.
+    everything).  Concrete values are range-checked here (arrays
+    [0, 1]; scalars (0, 1] — the scalar no-op spelling is None);
+    traced values are validated by the caller.
     """
-    _validate_unit_interval("min_p", min_p)
+    _validate_unit_interval("min_p", min_p, zero_ok=True)
     # log p_i - log p_max >= log(min_p), computed on logits directly
     # (the softmax normalizer cancels in the difference).
     gap = logits - logits.max(axis=-1, keepdims=True)
@@ -698,8 +716,9 @@ def top_p_mask(logits, p: float):
     always kept (exclusive mass 0 < p) — static shapes throughout.
 
     ``p`` may be a per-row ``[B, 1]`` array (the serving engine's
-    per-request path); a row of 1.0 is a no-op — array values are
-    validated by the caller.
+    per-request path); a row of 1.0 is a no-op.  Concrete values —
+    scalar or array — are range-checked here ((0, 1]); traced values
+    are validated by the caller.
     """
     _validate_unit_interval("top_p", p)
     sl = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
@@ -851,7 +870,11 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
     approximate-threshold mask by default (round-3 change — see
     top_k_mask: exact lax.top_k costs more than the rest of the decode
     step at large vocab); ``exact_top_k=True`` restores the exact
-    support.
+    support.  ``top_p=1.0`` / ``min_p=0.0`` are the explicit "no
+    filter" values (identical to None, and legal even on greedy
+    calls; round-6 change) — the same contract as the serving
+    engines' ``submit``, so parameters accepted by a served request
+    replay solo exactly.
 
     ``prompt_cache=(cache, cached_len)`` reuses a prefilled prefix —
     the system-prompt pattern: ``prefill`` the shared prefix once (at
@@ -898,7 +921,13 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
                                  rolling_ok=prompt_lengths is None)
     if temperature > 0 and key is None:
         raise ValueError("temperature sampling needs an explicit PRNG key")
-    if ((top_k is not None or top_p is not None or min_p is not None)
+    # The explicit no-op values — top_p=1.0 / min_p=0.0, the serving
+    # engines' "no filter" spellings — stay legal on greedy calls too,
+    # so replaying a served request's parameters solo never rejects
+    # what submit() accepted (round-6 parity contract).
+    if ((top_k is not None
+         or (top_p is not None and top_p < 1.0)
+         or (min_p is not None and min_p > 0.0))
             and temperature <= 0):
         raise ValueError(
             "top_k/top_p/min_p filter a sampling distribution; they "
@@ -909,8 +938,9 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
             f"top_k must be in [1, vocab_size={cfg.vocab_size}], got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    if min_p is not None and not 0.0 < min_p <= 1.0:
-        raise ValueError(f"min_p must be in (0, 1], got {min_p}")
+    if min_p is not None and not 0.0 <= min_p <= 1.0:
+        # 0.0 is the explicit "no min-p filter" value (like submit()).
+        raise ValueError(f"min_p must be in [0, 1], got {min_p}")
     cached_len = 0
     if prompt_cache is not None:
         if prompt_lengths is not None:
@@ -986,9 +1016,14 @@ def generate(params, prompt, cfg: TransformerConfig, max_new_tokens: int,
             scaled = logits / temperature
             if top_k is not None:
                 scaled = top_k_mask(scaled, top_k, exact=exact_top_k)
-            if top_p is not None:
+            # top_p >= 1.0 is "no nucleus filter", matching the serving
+            # engines (round-6 parity fix): the sorted cumsum can
+            # float-overshoot 1.0 and drop an underflowed tail token
+            # that an unfiltered draw could sample, so 1.0 must mean
+            # bypass everywhere or solo and served runs diverge.
+            if top_p is not None and top_p < 1.0:
                 scaled = top_p_mask(scaled, top_p)
-            if min_p is not None:
+            if min_p is not None and min_p > 0.0:
                 scaled = min_p_mask(scaled, min_p)
             nxt = jax.random.categorical(sub, scaled, axis=-1)
         else:

@@ -502,12 +502,17 @@ class ContinuousBatcher(_LaneEngine):
             raise ValueError(
                 f"shared prefix length {prompt_cache[1]} must leave "
                 f"room under max_len={cfg.max_len}")
-        if (temperature <= 0 and (top_k or top_p or min_p)
+        if (temperature <= 0
+                and (top_k
+                     or (top_p is not None and top_p < 1.0)
+                     or (min_p is not None and min_p > 0.0))
                 and not per_request_sampling):
             # With per-request sampling the constructor values are only
             # DEFAULTS; a filter default alongside a greedy default
             # temperature is legal (it applies to requests that
-            # override the temperature).
+            # override the temperature).  The explicit no-op values
+            # (top_p=1.0 / min_p=0.0) are legal everywhere — the same
+            # round-6 contract as generate and submit().
             raise ValueError(
                 "top_k/top_p/min_p need temperature > 0 (greedy always "
                 "takes the argmax)")
@@ -520,14 +525,10 @@ class ContinuousBatcher(_LaneEngine):
                 f"temperature must be >= 0, got {temperature}")
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        # Per-request engines accept min_p=0.0 as the default too —
-        # the same explicit "no filter" value submit() documents.
-        if min_p is not None and not 0.0 < min_p <= 1.0 and not (
-                per_request_sampling and min_p == 0.0):
-            raise ValueError(
-                f"min_p must be in "
-                f"{'[0, 1]' if per_request_sampling else '(0, 1]'}, "
-                f"got {min_p}")
+        # min_p=0.0 is the explicit "no filter" value on EVERY engine
+        # mode (round-6: same contract as generate and submit()).
+        if min_p is not None and not 0.0 <= min_p <= 1.0:
+            raise ValueError(f"min_p must be in [0, 1], got {min_p}")
         if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
             raise ValueError(
                 f"eos_token {eos_token} outside vocab [0, "
@@ -641,9 +642,15 @@ class ContinuousBatcher(_LaneEngine):
                 scaled = logits / temperature
                 if top_k is not None:
                     scaled = top_k_mask(scaled, top_k, exact=exact_top_k)
-                if top_p is not None:
+                # top_p >= 1.0 bypasses the mask, like the per-request
+                # path and generate's scalar path (round-6 parity fix):
+                # the sorted cumsum can float-overshoot 1.0 and mask an
+                # underflowed tail token "no filter" could sample.
+                if top_p is not None and top_p < 1.0:
                     scaled = top_p_mask(scaled, top_p)
-                if min_p is not None:
+                # min_p 0.0 likewise means "no filter" (and the scalar
+                # mask rejects a concrete 0.0 outright).
+                if min_p is not None and min_p > 0.0:
                     scaled = min_p_mask(scaled, min_p)
                 nxt = jax.vmap(pick)(keys, scaled, pos)
             else:
@@ -730,6 +737,10 @@ class ContinuousBatcher(_LaneEngine):
         host-side bookkeeping and works on every engine).  Pass
         ``top_p=1.0`` / ``min_p=0.0`` (the explicit no-op values) for
         an unfiltered request on an engine whose default filters.
+        ``top_p=1.0`` means "no nucleus filter" EVERYWHERE — here,
+        the engine scalar path, and solo ``generate`` all bypass the
+        mask at >= 1.0 (round-6 parity fix), so a request copying its
+        solo call's ``top_p=1.0`` replays that run exactly.
 
         ``ttl`` (seconds from now) / ``deadline`` (absolute ``clock()``
         time): the request's deadline.  A request that is already
@@ -828,6 +839,23 @@ class ContinuousBatcher(_LaneEngine):
             deadline=dl)
         return lane
 
+    def traced_for_analysis(self):
+        """Trace targets for the IR lint (analysis/ir_lint.py): the
+        jitted single-token decode step over the engine's live lane
+        state.  Nothing executes — the lint traces and lowers only."""
+        from distkeras_tpu.analysis.ir_lint import TraceSpec
+
+        if 1 not in self._steps:
+            self._steps[1] = self._make_step(1)
+        mode = ("per_request" if self.per_request_sampling
+                else "sampled" if self.temperature > 0 else "greedy")
+        return [TraceSpec(
+            name=f"continuousbatcher_{mode}/decode_step",
+            fn=self._steps[1],
+            args=(self.cache, self.cur, self.pos, self.keys,
+                  self.temps, self.tps, self.mps),
+            donate_argnums=(0,))]
+
     def step(self, n: int = 1):
         """Advance every lane ``n`` tokens in ONE device round-trip;
         returns ``{lane: [tokens...]}`` for lanes that emitted.
@@ -913,6 +941,19 @@ class SpeculativeBatcher(_LaneEngine):
                 f"{cfg.vocab_size} — the models must share a tokenizer")
         if n_draft < 1:
             raise ValueError(f"n_draft must be >= 1, got {n_draft}")
+        # Eager impossibility check: _cap = min(max_len) - n_draft - 1
+        # is the largest prompt+generation budget any request can use;
+        # _cap <= 0 means NO request can ever be admitted, so fail at
+        # construction naming the real culprits instead of letting
+        # every submit() blame the prompt.
+        if min(cfg.max_len, draft_cfg.max_len) <= n_draft + 1:
+            raise ValueError(
+                f"n_draft={n_draft} leaves no decode budget: the verify "
+                f"chunk needs n_draft + 1 cache slots of slack, but "
+                f"min(max_len)={min(cfg.max_len, draft_cfg.max_len)} "
+                f"(target {cfg.max_len}, draft {draft_cfg.max_len}) <= "
+                f"n_draft + 1 = {n_draft + 1}; lower n_draft or raise "
+                "max_len")
         if lanes < 1:
             raise ValueError(f"lanes must be >= 1, got {lanes}")
         if temperature < 0:
@@ -1055,6 +1096,19 @@ class SpeculativeBatcher(_LaneEngine):
         self._admit_d = _make_lane_admit(self.draft_params, draft_cfg)
 
     # -------------------------------------------------------------- API
+
+    def traced_for_analysis(self):
+        """Trace targets for the IR lint: the jitted speculative
+        draft+verify step over the engine's live lane state."""
+        from distkeras_tpu.analysis.ir_lint import TraceSpec
+
+        mode = "sampled" if self.temperature > 0 else "greedy"
+        return [TraceSpec(
+            name=f"speculativebatcher_{mode}/step",
+            fn=self._step,
+            args=(self.tcache, self.dcache, self.prev, self.cur,
+                  self.pos, self.keys, self.iters),
+            donate_argnums=(0, 1))]
 
     def _validate_budget(self, p: int, max_new_tokens: int) -> None:
         if p + max_new_tokens - 1 > self._cap:

@@ -156,6 +156,74 @@ class ADAG(DistributedTrainer):
         super().__init__(keras_model, **kw)
         self.communication_window = communication_window
 
+    def _jit_accum_step(self, state_sh, batch_sh):
+        """THE jitted accumulation step of the streaming path — built
+        here once so ``_fit`` and :meth:`traced_for_analysis` can never
+        drift apart (the IR lint must audit the program that trains)."""
+        return jax.jit(
+            self.adapter.make_accum_train_step(self.communication_window),
+            in_shardings=(state_sh, batch_sh, batch_sh),
+            out_shardings=(state_sh, NamedSharding(self.mesh, P())),
+            donate_argnums=0,
+        )
+
+    def _jit_indexed_accum_step(self, state_sh, repl, idx_sh):
+        """THE jitted step of the single-process device-resident data
+        plane — shared by ``_fit_device_data`` and
+        :meth:`traced_for_analysis` (same never-drift contract as
+        :meth:`_jit_accum_step`)."""
+        return jax.jit(
+            self.adapter.make_indexed_accum_train_step(
+                self.communication_window),
+            in_shardings=(state_sh, repl, repl, idx_sh),
+            out_shardings=(state_sh, repl),
+            donate_argnums=0,
+        )
+
+    def traced_for_analysis(self, dataset: Dataset):
+        """Trace targets for the IR lint (analysis/ir_lint.py): the
+        REAL jitted step this configuration would train with —
+        streaming, or the device-resident indexed step under
+        ``device_data=True`` (single-process form; the multi-host
+        device_data program is a distinct shard_map build not yet
+        covered) — plus example argument shapes derived from
+        ``dataset`` exactly as the feed loop would shape them.
+        Nothing executes and nothing is materialized (state is
+        ``eval_shape`` structs) — the lint only traces/lowers."""
+        from distkeras_tpu.analysis.ir_lint import TraceSpec
+
+        w = self.communication_window
+        state = jax.eval_shape(self.adapter.init_state)
+        state_sh = self.plan.state_shardings(self.mesh, state,
+                                             self.adapter.tv_paths)
+        X = dataset[self.features_col]
+        Y = dataset[self.label_col]
+        name = type(self).__name__.lower()
+        variant = "zero1" if self.zero1 else "dp"
+        pbytes = int(sum(np.prod(v.shape) * v.dtype.itemsize
+                         for v in jax.tree.leaves(state.tv)))
+        global_bs = self.batch_size * self.num_workers
+        if self.device_data:
+            repl = NamedSharding(self.mesh, P())
+            idx_sh = NamedSharding(self.mesh, P(None, "data"))
+            step = self._jit_indexed_accum_step(state_sh, repl, idx_sh)
+            args = (state,
+                    jax.ShapeDtypeStruct(X.shape, X.dtype),
+                    jax.ShapeDtypeStruct(Y.shape, Y.dtype),
+                    jax.ShapeDtypeStruct((w, global_bs), np.int32))
+            variant += "_device_data"
+        else:
+            batch_sh = self._batch_sharding(leading_window=True)
+            step = self._jit_accum_step(state_sh, batch_sh)
+            args = (state,
+                    jax.ShapeDtypeStruct((w, global_bs) + X.shape[1:],
+                                         X.dtype),
+                    jax.ShapeDtypeStruct((w, global_bs) + Y.shape[1:],
+                                         Y.dtype))
+        return [TraceSpec(name=f"{name}_{variant}/accum_step", fn=step,
+                          args=args, donate_argnums=(0,),
+                          params_bytes=pbytes)]
+
     def _fit(self, dataset: Dataset):
         if self.device_data:
             return self._fit_device_data(dataset)
@@ -164,12 +232,7 @@ class ADAG(DistributedTrainer):
         state, state_sh = self._shard_state(state)
         batch_sh = self._batch_sharding(leading_window=True)
 
-        step = jax.jit(
-            self.adapter.make_accum_train_step(w),
-            in_shardings=(state_sh, batch_sh, batch_sh),
-            out_shardings=(state_sh, NamedSharding(self.mesh, P())),
-            donate_argnums=0,
-        )
+        step = self._jit_accum_step(state_sh, batch_sh)
 
         # Global batch = num_workers * batch_size rows per microbatch;
         # one jitted call consumes `window` microbatches.  Each process
@@ -242,12 +305,7 @@ class ADAG(DistributedTrainer):
         repl = NamedSharding(self.mesh, P())
         idx_sh = NamedSharding(self.mesh, P(None, "data"))
 
-        step = jax.jit(
-            self.adapter.make_indexed_accum_train_step(w),
-            in_shardings=(state_sh, repl, repl, idx_sh),
-            out_shardings=(state_sh, repl),
-            donate_argnums=0,
-        )
+        step = self._jit_indexed_accum_step(state_sh, repl, idx_sh)
         X = jax.device_put(dataset[self.features_col], repl)
         Y = jax.device_put(dataset[self.label_col], repl)
         global_bs = self.batch_size * self.num_workers

@@ -45,6 +45,23 @@ _OPTS = {
     "sgd": optax.sgd,
 }
 
+# Staging more than this fraction of reported device memory fails fast
+# (the rest of the step still needs activations/params/moments).
+_STAGING_FRACTION = 0.8
+# With no backend memory report (CPU), only an absurd estimate warns.
+_STAGING_SANITY_BYTES = 8 << 30
+
+
+def _device_bytes_limit():
+    """Per-device memory budget in bytes, or None when the backend
+    does not report one (CPU test meshes).  Module-level so tests can
+    monkeypatch a tiny budget to exercise the staging guard."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    return (stats or {}).get("bytes_limit")
+
 
 def _with_ema(opt, decay: float):
     """Wrap an optax transform so its state carries a Polyak/EMA shadow
@@ -351,6 +368,41 @@ class LMTrainer(CheckpointingBase):
     # slab assembly for the whole trainer family).
     _global_batch = staticmethod(mesh_global_batch)
 
+    def _guard_staged_bytes(self, n_rows: int, width: int,
+                            with_segments: bool) -> None:
+        """Fail fast when ``device_data=True`` would stage more HBM
+        than the devices have, instead of surfacing as a raw XLA
+        allocation error deep inside ``_global_batch`` (round-6 fix).
+
+        The staged stream is int32 ``[rows, seq+1]`` sharded over the
+        ``data`` axis (doubled when segments ride along), so each
+        device persists ``rows * width * 4 / local_devices`` bytes for
+        the whole run.  Backends that report a budget
+        (``memory_stats``) get a hard error above
+        ``_STAGING_FRACTION``; budget-less backends only warn past an
+        absolute sanity bound.
+        """
+        n_local = int(self.mesh.shape["data"]) // jax.process_count()
+        per_dev = (n_rows * width * 4 * (2 if with_segments else 1)
+                   // max(n_local, 1))
+        limit = _device_bytes_limit()
+        msg = (f"device_data=True would stage "
+               f"{per_dev / 2**20:.1f} MiB of token rows per device"
+               + (" (segments included)" if with_segments else ""))
+        if limit is not None and per_dev > _STAGING_FRACTION * limit:
+            raise ValueError(
+                f"{msg}, over {int(_STAGING_FRACTION * 100)}% of the "
+                f"{limit / 2**20:.1f} MiB device budget — train with "
+                "device_data=False (the streaming fallback), shard the "
+                "corpus across more hosts, or trim the dataset")
+        if limit is None and per_dev > _STAGING_SANITY_BYTES:
+            import warnings
+
+            warnings.warn(
+                f"{msg}; this backend reports no memory budget, but "
+                "that figure rarely fits — device_data=False streams "
+                "from host instead", stacklevel=3)
+
     def _stage_stream(self, rows, steps):
         """Host token rows (consumption order) -> ONE device-resident
         int32 array sharded over the ``data`` axis, laid out so each
@@ -413,6 +465,120 @@ class LMTrainer(CheckpointingBase):
         osh = jax.tree.map(lambda x: psh if params_like(x) else rep,
                            opt_state, is_leaf=params_like)
         return psh, osh
+
+    def _jit_train_step(self, psh, osh):
+        """Build THE jitted optimizer step for this configuration —
+        ``train`` and :meth:`traced_for_analysis` share this one
+        construction so the IR lint audits the program that trains,
+        never a reimplementation.  Returns ``(step, step_sh, tok_sh)``
+        (the fed block's and the flat token rows' shardings)."""
+        tok_sh = NamedSharding(self.mesh, P("data", None))
+        # With accumulation the fed block is [accum, B, S+1]: the
+        # microbatch axis leads, batch still shards over data.
+        step_sh = (tok_sh if self.grad_accum == 1
+                   else NamedSharding(self.mesh, P(None, "data", None)))
+        rep = NamedSharding(self.mesh, P())
+        jit_kw = {}
+        if int(self.mesh.shape["pipeline"]) == 1:
+            # Pin the carry layout so XLA keeps the plan's placement
+            # (scattered params under FSDP, Megatron splits under TP)
+            # across steps instead of resharding at its own whim.
+            # The pipelined trunk is exempt: its manual shard_map
+            # governs placement internally.  rng and segment slots
+            # are always present positionally (None when unused —
+            # an empty pytree binds no sharding).
+            if self.device_data:
+                # The staged stream shares the token sharding: both
+                # are [rows, S+1] split over the data axis.
+                in_sh = ((psh, osh), tok_sh, rep, rep, tok_sh)
+            else:
+                in_sh = ((psh, osh), step_sh, rep, step_sh)
+            jit_kw = dict(in_shardings=in_sh,
+                          out_shardings=((psh, osh), rep))
+        if self.device_data:
+            # HBM-resident data plane: the staged stream stays on
+            # device; each step ships only a replicated [accum, sub]
+            # index block and a shard_map gathers every device's
+            # rows from its OWN shard (a plain take on the sharded
+            # array would all-gather the dataset each step).  The
+            # gather fuses into the same XLA program as the step.
+            inner = self._step_builder(self.optimizer)
+            accum = self.grad_accum
+
+            def local_take(xb, idx):
+                g = jnp.take(xb, idx.reshape(-1), axis=0)
+                return g.reshape(idx.shape + xb.shape[1:])
+
+            gather = shard_map(
+                local_take, mesh=self.mesh,
+                in_specs=(P("data", None), P()),
+                out_specs=(P(None, "data", None) if accum > 1
+                           else P("data", None)),
+                check_vma=False)
+
+            def dd_step(carry, X, idx, rng, Seg):
+                tok = gather(X, idx)
+                seg = None if Seg is None else gather(Seg, idx)
+                return inner(carry, tok, rng, seg)
+
+            step = jax.jit(dd_step, donate_argnums=0, **jit_kw)
+        else:
+            step = jax.jit(self._step_builder(self.optimizer),
+                           donate_argnums=0, **jit_kw)
+        return step, step_sh, tok_sh
+
+    def traced_for_analysis(self, seq_len: int | None = None,
+                            n_rows: int | None = None):
+        """Trace targets for the IR lint (analysis/ir_lint.py): the
+        jitted train step this configuration executes, with example
+        argument shapes for one optimizer round (``seq_len`` defaults
+        to ``cfg.max_len``).  Under ``device_data=True`` the staged
+        stream's aval depends on the corpus size — pass
+        ``n_rows=len(tokens)`` to trace the exact program a concrete
+        ``train(tokens)`` call compiles (default: one step's rows).
+        Nothing executes and nothing is materialized — state is shape
+        structs (``jax.eval_shape``), so a production-size trainer can
+        be linted without touching HBM; the lint only traces and
+        lowers."""
+        from distkeras_tpu.analysis.ir_lint import TraceSpec
+
+        seq = self.cfg.max_len if seq_len is None else seq_len
+        params = jax.eval_shape(
+            lambda: tfm.init_params(jax.random.key(self.seed),
+                                    self.cfg))
+        opt_state = jax.eval_shape(self.optimizer.init, params)
+        psh, osh = self._state_shardings(params, opt_state)
+        step, _, _ = self._jit_train_step(psh, osh)
+        rng = (jax.random.key(self.seed + 0x5eed)
+               if self.cfg.dropout > 0 else None)
+        name = type(self).__name__.lower()
+        variant = ("zero1" if self.zero1
+                   else "fsdp" if self.fsdp else "dp")
+        pbytes = int(sum(np.prod(v.shape) * v.dtype.itemsize
+                         for v in jax.tree.leaves(params)))
+        # Shapes are the GLOBAL avals the jitted step consumes — the
+        # same for every process count (multi-process hosts each feed
+        # a block that _global_batch assembles into these).
+        if self.device_data:
+            n_data = int(self.mesh.shape["data"])
+            sub = self.batch_size // n_data
+            rows_per_step = self.batch_size * self.grad_accum
+            rows = (rows_per_step if n_rows is None
+                    else n_rows - n_rows % rows_per_step)
+            X = jax.ShapeDtypeStruct((rows, seq + 1), jnp.int32)
+            idx = jax.ShapeDtypeStruct(
+                (self.grad_accum, sub) if self.grad_accum > 1
+                else (sub,), jnp.int32)
+            args = ((params, opt_state), X, idx, rng, None)
+        else:
+            shape = ((self.grad_accum, self.batch_size, seq + 1)
+                     if self.grad_accum > 1
+                     else (self.batch_size, seq + 1))
+            args = ((params, opt_state),
+                    jax.ShapeDtypeStruct(shape, jnp.int32), rng, None)
+        return [TraceSpec(name=f"{name}_{variant}/train_step", fn=step,
+                          args=args, donate_argnums=(0,),
+                          params_bytes=pbytes)]
 
     def train(self, dataset: Dataset | np.ndarray, params=None,
               eval_tokens: np.ndarray | None = None,
@@ -530,61 +696,8 @@ class LMTrainer(CheckpointingBase):
             psh, osh = self._state_shardings(params, opt_shapes)
             opt_state = jax.jit(self.optimizer.init,
                                 out_shardings=osh)(params)
-            tok_sh = NamedSharding(self.mesh, P("data", None))
-            # With accumulation the fed block is [accum, B, S+1]: the
-            # microbatch axis leads, batch still shards over data.
-            step_sh = (tok_sh if self.grad_accum == 1
-                       else NamedSharding(self.mesh, P(None, "data", None)))
-            rep = NamedSharding(self.mesh, P())
+            step, step_sh, tok_sh = self._jit_train_step(psh, osh)
             dropping = self.cfg.dropout > 0
-            jit_kw = {}
-            if int(self.mesh.shape["pipeline"]) == 1:
-                # Pin the carry layout so XLA keeps the plan's placement
-                # (scattered params under FSDP, Megatron splits under TP)
-                # across steps instead of resharding at its own whim.
-                # The pipelined trunk is exempt: its manual shard_map
-                # governs placement internally.  rng and segment slots
-                # are always present positionally (None when unused —
-                # an empty pytree binds no sharding).
-                if self.device_data:
-                    # The staged stream shares the token sharding: both
-                    # are [rows, S+1] split over the data axis.
-                    in_sh = ((psh, osh), tok_sh, rep, rep, tok_sh)
-                else:
-                    in_sh = ((psh, osh), step_sh, rep, step_sh)
-                jit_kw = dict(in_shardings=in_sh,
-                              out_shardings=((psh, osh), rep))
-            if self.device_data:
-                # HBM-resident data plane: the staged stream stays on
-                # device; each step ships only a replicated [accum, sub]
-                # index block and a shard_map gathers every device's
-                # rows from its OWN shard (a plain take on the sharded
-                # array would all-gather the dataset each step).  The
-                # gather fuses into the same XLA program as the step.
-                inner = self._step_builder(self.optimizer)
-                sub = global_bs // n_data
-                accum = self.grad_accum
-
-                def local_take(xb, idx):
-                    g = jnp.take(xb, idx.reshape(-1), axis=0)
-                    return g.reshape(idx.shape + xb.shape[1:])
-
-                gather = shard_map(
-                    local_take, mesh=self.mesh,
-                    in_specs=(P("data", None), P()),
-                    out_specs=(P(None, "data", None) if accum > 1
-                               else P("data", None)),
-                    check_vma=False)
-
-                def dd_step(carry, X, idx, rng, Seg):
-                    tok = gather(X, idx)
-                    seg = None if Seg is None else gather(Seg, idx)
-                    return inner(carry, tok, rng, seg)
-
-                step = jax.jit(dd_step, donate_argnums=0, **jit_kw)
-            else:
-                step = jax.jit(self._step_builder(self.optimizer),
-                               donate_argnums=0, **jit_kw)
             # Dropout stream keyed on the optimizer round: resume from a
             # checkpoint replays the identical mask sequence.
             drop_base = (jax.random.key(self.seed + 0x5eed)
@@ -663,6 +776,8 @@ class LMTrainer(CheckpointingBase):
             X_dev = seg_dev = None
             if self.device_data:
                 steps_pe = n_rows // rows_per_step
+                self._guard_staged_bytes(n_rows, tokens.shape[1],
+                                         segments is not None)
                 X_dev = self._stage_stream(tokens[:n_rows], steps_pe)
                 if segments is not None:
                     seg_dev = self._stage_stream(segments[:n_rows],
@@ -722,7 +837,9 @@ class LMTrainer(CheckpointingBase):
                                                seg_batch)
                     if (profiling
                             and rnd >= prof_start - 1 + self.profile_steps):
-                        jax.block_until_ready(loss)  # flush async device work
+                        # Flush async device work ONCE, when the profile
+                        # window closes — not a per-iteration sync.
+                        jax.block_until_ready(loss)  # dkt: ignore[hot-sync]
                         jax.profiler.stop_trace()
                         profiling = False
                     losses.append(loss)

@@ -20,6 +20,14 @@ A session exceeding its budget fails; a session compiling *less* than
 budget prints a note (ratchet the budget down with ``--update``).
 Budgets are exact for this container's pinned jax; across jax upgrades
 re-record with ``--update`` and review the diff.
+
+NOTE: sessions run sequentially in ONE process, so later sessions'
+budgets are *deltas on a warm jit cache* (e.g. ``lm_zero1`` measures
+2, not ~20, because ``lm_trainer`` already compiled the shared
+programs) — deterministic for the fixed SESSIONS order, but editing,
+reordering, or inserting a session shifts every later budget.  After
+any such change re-record with ``--update`` and review the whole
+diff, not just the session you touched.
 """
 
 import json
@@ -63,9 +71,12 @@ class _count:
         self.n = _COMPILES["n"] - self.start
 
 
-def session_adag(zero1: bool):
+def session_adag(zero1: bool = False, device_data: bool = False):
     """Two ADAG rounds; every round after the first must hit the cache
-    (one accum-step program; shapes are static by construction)."""
+    (one accum-step program; shapes are static by construction).
+    ``device_data`` exercises the HBM-staged indexed path instead —
+    its per-round traffic is one index block, so extra programs mean
+    the staged plane regressed."""
     import numpy as np
 
     import distkeras_tpu as dk
@@ -82,13 +93,15 @@ def session_adag(zero1: bool):
     t = dk.ADAG(model, loss="sparse_categorical_crossentropy",
                 worker_optimizer="adam", learning_rate=0.05,
                 batch_size=4, num_epoch=2, communication_window=2,
-                zero1=zero1)
+                zero1=zero1, device_data=device_data)
     t.train(ds)
     assert len(t.history) == 4, t.history
 
 
-def session_lm():
-    """Four LMTrainer optimizer steps, one compiled step program."""
+def session_lm(zero1: bool = False, device_data: bool = False):
+    """Four LMTrainer optimizer steps, one compiled step program
+    (zero1: the sharded update must not add per-round programs;
+    device_data: nor must the staged-stream gather)."""
     import numpy as np
 
     import distkeras_tpu as dk
@@ -98,7 +111,8 @@ def session_lm():
                                 n_layers=2, d_ff=64, max_len=16)
     rows = np.random.default_rng(0).integers(
         0, 64, (32, 17)).astype(np.int32)
-    t = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=8, num_epoch=1)
+    t = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=8, num_epoch=1,
+                     zero1=zero1, device_data=device_data)
     t.train(rows)
     assert len(t.history) == 4, t.history
 
@@ -132,11 +146,41 @@ def session_serving():
     eng.drain(lane)
 
 
+def session_speculative():
+    """SpeculativeBatcher session: expected programs = target+draft
+    admission (one bucket) + the fused draft/verify step; a second
+    request in the same bucket must be compile-free."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.serving import SpeculativeBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32)
+    draft = tfm.TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
+                                  n_layers=1, d_ff=32, max_len=32)
+    eng = SpeculativeBatcher(
+        tfm.init_params(jax.random.key(0), cfg),
+        tfm.init_params(jax.random.key(1), draft),
+        cfg, draft, lanes=2, n_draft=2, prompt_buckets=(8,))
+    rng = np.random.default_rng(0)
+    for _ in range(2):  # same bucket twice: re-admission compile-free
+        lane = eng.submit(rng.integers(0, 64, (5,)).astype(np.int32), 6)
+        while lane in eng.running():
+            eng.step()
+        eng.drain(lane)
+
+
 SESSIONS = {
-    "adag": lambda: session_adag(zero1=False),
+    "adag": lambda: session_adag(),
     "adag_zero1": lambda: session_adag(zero1=True),
-    "lm_trainer": session_lm,
+    "adag_device_data": lambda: session_adag(device_data=True),
+    "lm_trainer": lambda: session_lm(),
+    "lm_zero1": lambda: session_lm(zero1=True),
+    "lm_device_data": lambda: session_lm(device_data=True),
     "serving": session_serving,
+    "speculative": session_speculative,
 }
 
 

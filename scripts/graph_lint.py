@@ -1,0 +1,108 @@
+"""Graph lint CLI: source lint + IR lint + comm budgets, for CI.
+
+Runs, in order:
+
+1. the **source lint** (analysis/source_lint.py) over ``distkeras_tpu/``;
+2. the **IR lint** (analysis/ir_lint.py) over the standard trace
+   targets (analysis/targets.py) — every trainer family's and serving
+   engine's real jitted step on the deterministic 8-device CPU mesh:
+   dtype policy, host callbacks, PRNG reuse, donation coverage;
+3. the **collective census** of each compiled step against
+   ``scripts/comm_budget.json``, plus the ZeRO-1 parity proof
+   (RS+AG == the gradient all-reduce it replaces, bytes measured
+   from the declared exchange and the DP partner's compiled HLO).
+
+Exit 0 iff there are zero unsuppressed error/warn findings.  Usage::
+
+    python scripts/graph_lint.py                  # full run (CI)
+    python scripts/graph_lint.py --source-only    # AST rules only, fast
+    python scripts/graph_lint.py --ir-only        # IR rules + budgets
+    python scripts/graph_lint.py --update-budgets # re-record the census
+    python scripts/graph_lint.py -v               # also print censuses
+
+See docs/graph_lint.md for the rule catalogue and the
+``# dkt: ignore[rule]`` suppression syntax.
+"""
+
+import argparse
+import os
+import sys
+
+# Deterministic substrate BEFORE jax initializes — the same 8-device
+# CPU mesh the test suite uses, so censuses and budgets are stable no
+# matter what accelerator is attached.
+os.environ["KERAS_BACKEND"] = "jax"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BUDGET_PATH = os.path.join(REPO, "scripts", "comm_budget.json")
+
+
+def run_source(findings):
+    from distkeras_tpu.analysis.source_lint import lint_paths
+
+    findings += lint_paths([os.path.join(REPO, "distkeras_tpu")])
+
+
+def run_ir(findings, update: bool, verbose: bool):
+    from distkeras_tpu.analysis import ir_lint
+    from distkeras_tpu.analysis.targets import default_targets
+
+    specs = default_targets()
+    censuses, measured = {}, {}
+    for spec in specs:
+        fs, census = ir_lint.lint_trace(spec)
+        findings += fs
+        censuses[spec.name] = census
+        measured[spec.name] = ir_lint.census_to_budget(census)
+        if verbose:
+            print(f"-- {spec.name}: "
+                  f"{measured[spec.name]['wire_total']} wire B")
+            for c in census:
+                print(f"     {c.as_json()}")
+
+    for spec in specs:
+        if spec.zero1_parity_with:
+            findings += ir_lint.check_zero1_parity(
+                spec, censuses[spec.zero1_parity_with])
+
+    if update:
+        ir_lint.save_budgets(BUDGET_PATH, measured)
+        print(f"wrote {BUDGET_PATH} ({len(measured)} targets)")
+        return
+    try:
+        budgets = ir_lint.load_budgets(BUDGET_PATH)
+    except (OSError, ValueError, KeyError):
+        print(f"no readable budget at {BUDGET_PATH}; run "
+              "--update-budgets to record one", file=sys.stderr)
+        budgets = {}
+    for name, census in censuses.items():
+        findings += ir_lint.check_budget(name, census, budgets)
+
+
+def main(argv):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--source-only", action="store_true")
+    ap.add_argument("--ir-only", action="store_true")
+    ap.add_argument("--update-budgets", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    from distkeras_tpu.analysis.findings import format_findings
+
+    findings = []
+    if not args.ir_only:
+        run_source(findings)
+    if not args.source_only:
+        run_ir(findings, update=args.update_budgets,
+               verbose=args.verbose)
+    print(format_findings(findings))
+    return 1 if any(f.gating for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,142 @@
+"""Budget guards in tier-1: the IR lint over the REAL trainer/serving
+step programs, the collective census vs scripts/comm_budget.json, the
+ZeRO-1 parity proof, and the compile-count guard — so a budget
+regression fails the fast gate, not a reviewer's eyeball.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distkeras_tpu.analysis import ir_lint
+from distkeras_tpu.analysis.targets import (ZERO1_PARITY_PAIRS,
+                                             default_targets)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def linted():
+    """(spec, findings, census) per standard target — traced, lowered
+    and compiled ONCE for the whole module."""
+    out = {}
+    for spec in default_targets():
+        findings, census = ir_lint.lint_trace(spec)
+        out[spec.name] = (spec, findings, census)
+    return out
+
+
+def test_standard_targets_cover_every_family(linted):
+    names = set(linted)
+    for required in ("adag_dp/accum_step", "adag_zero1/accum_step",
+                     "lmtrainer_dp/train_step",
+                     "lmtrainer_zero1/train_step",
+                     "lmtrainer_fsdp/train_step",
+                     "continuousbatcher_per_request/decode_step",
+                     "speculativebatcher_sampled/step"):
+        assert required in names, names
+
+
+def test_ir_lint_clean_on_real_programs(linted):
+    gating = [f.format() for (_, fs, _) in linted.values()
+              for f in fs if f.gating]
+    assert not gating, gating
+
+
+def test_comm_budget_matches_recorded(linted):
+    budgets = ir_lint.load_budgets(
+        os.path.join(ROOT, "scripts", "comm_budget.json"))
+    drift = []
+    for name, (_, _, census) in linted.items():
+        drift += [f.format()
+                  for f in ir_lint.check_budget(name, census, budgets)]
+    assert not drift, drift
+
+
+def test_adag_zero1_compiled_wire_equals_dp(linted):
+    """On the MLP flagship the parity holds at the COMPILED level
+    outright: total per-device wire bytes of the zero1 step (RS-
+    canonicalized AR + explicit AG) == the replicated-DP step's
+    all-reduces, to the byte."""
+    dp = ir_lint.census_wire_total(linted["adag_dp/accum_step"][2])
+    z1 = ir_lint.census_wire_total(linted["adag_zero1/accum_step"][2])
+    assert dp == z1 > 0
+
+
+def test_zero1_parity_proof_for_both_families(linted):
+    """The acceptance check: for ADAG and LMTrainer, the zero1 step's
+    DECLARED exchange is pad-free (RS == AG == parameter bytes), hence
+    by the ring identity RS+AG moves exactly the gradient all-reduce's
+    wire bytes — asserted against each DP partner's compiled census."""
+    for z1_name, dp_name in ZERO1_PARITY_PAIRS:
+        spec = linted[z1_name][0]
+        findings = ir_lint.check_zero1_parity(spec, linted[dp_name][2])
+        gating = [f.format() for f in findings if f.gating]
+        assert not gating, (z1_name, gating)
+
+
+def test_declared_exchange_measures_param_bytes(linted):
+    for z1_name, _dp in ZERO1_PARITY_PAIRS:
+        spec = linted[z1_name][0]
+        decl = ir_lint.declared_zero1_exchange(spec)
+        assert decl["rs_bytes"] == decl["ag_bytes"] == spec.params_bytes
+
+
+def test_lm_dp_tied_embedding_redundancy_is_surfaced(linted):
+    """The parity machinery's side discovery, pinned so it stays
+    visible: replicated-DP LM compiles a redundant all-reduce for the
+    tied embedding's two gradient contributions (reported as info,
+    non-gating)."""
+    spec = linted["lmtrainer_zero1/train_step"][0]
+    findings = ir_lint.check_zero1_parity(
+        spec, linted["lmtrainer_dp/train_step"][2])
+    assert any(f.rule == "comm-redundant-ar" and not f.gating
+               for f in findings)
+
+
+def test_serving_steps_have_no_collectives(linted):
+    """The unsharded decode steps must stay collective-free — a
+    collective appearing here means the engine started resharding
+    per token."""
+    for name in ("continuousbatcher_per_request/decode_step",
+                 "speculativebatcher_sampled/step"):
+        assert linted[name][2] == []
+
+
+def test_compile_count_guard_passes():
+    """The recompile guard (scripts/check_compile_counts.py) over all
+    eight sessions — zero1/device_data trainers and the speculative
+    engine included — as a subprocess with its own deterministic
+    mesh."""
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "scripts", "check_compile_counts.py")],
+        capture_output=True, text=True, timeout=540,
+        cwd=ROOT)
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+
+
+def test_graph_lint_cli_source_only_runs_clean():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "graph_lint.py"),
+         "--source-only"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT)
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+
+
+def test_adag_device_data_hook_covers_indexed_step():
+    """device_data trainers hand the lint their REAL indexed-step
+    program (single-process form), not the streaming one."""
+    from distkeras_tpu.analysis.targets import (_mlp_dataset,
+                                                 _mlp_trainer)
+
+    t = _mlp_trainer(zero1=False)
+    t.device_data = True  # _supports_device_data on ADAG
+    spec = t.traced_for_analysis(_mlp_dataset())[0]
+    assert spec.name == "adag_dp_device_data/accum_step"
+    # Four args: state, staged X, staged Y, index block.
+    assert len(spec.args) == 4
+    findings, _ = ir_lint.lint_trace(spec, compile_census=False)
+    assert not [f.format() for f in findings if f.gating]

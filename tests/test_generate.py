@@ -1208,3 +1208,53 @@ def test_kv_int8_gqa_decode_close_to_fp(rng):
         base = np.abs(np.asarray(full_logits[:, pos])).max()
         np.testing.assert_allclose(logits, full_logits[:, pos],
                                    atol=0.05 * base, rtol=0.1)
+
+
+def test_mask_validation_rejects_concrete_arrays_out_of_range():
+    """Round-6 fix: _validate_unit_interval used to skip ALL non-scalar
+    values, so a direct mask caller with a bad concrete array got
+    silent NaN masking; now concrete arrays are range-checked (min_p
+    arrays may carry 0.0, the serving engines' explicit no-op slot)."""
+    from distkeras_tpu.models.generate import min_p_mask, top_p_mask
+
+    logits = jnp.zeros((2, 4))
+    with pytest.raises(ValueError, match="min_p"):
+        min_p_mask(logits, np.asarray([[-0.2], [0.5]]))
+    with pytest.raises(ValueError, match="top_p"):
+        top_p_mask(logits, np.asarray([[0.0], [0.5]]))
+    with pytest.raises(ValueError, match="top_p"):
+        top_p_mask(logits, np.asarray([[1.5], [0.5]]))
+    # The engines' no-op slot values stay legal in arrays...
+    out = np.asarray(min_p_mask(logits, np.asarray([[0.0], [0.5]])))
+    assert np.isfinite(out[0]).all()
+    np.asarray(top_p_mask(logits, np.asarray([[1.0], [0.5]])))
+    # ...and traced values still pass through to the caller's checks.
+    jax.jit(lambda l, p: top_p_mask(l, p))(
+        logits, jnp.asarray([[0.9], [0.5]]))
+
+
+def test_generate_top_p_one_equals_no_filter(rng):
+    """Round-6 parity fix: top_p=1.0 bypasses the nucleus mask exactly
+    like top_p=None (the serving engines' contract), so a request
+    copying its solo call's top_p=1.0 cannot diverge in the float
+    corner where the sorted cumsum overshoots 1.0."""
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 5)), jnp.int32)
+    k = jax.random.key(7)
+    one = generate(params, prompt, CFG, 6, temperature=0.9, top_p=1.0,
+                   key=k)
+    none = generate(params, prompt, CFG, 6, temperature=0.9, key=k)
+    np.testing.assert_array_equal(np.asarray(one), np.asarray(none))
+    # min_p=0.0 is the matching explicit no-op, and both no-op values
+    # are legal on greedy calls too (submit() accepts them there).
+    zero = generate(params, prompt, CFG, 6, temperature=0.9,
+                    min_p=0.0, key=k)
+    np.testing.assert_array_equal(np.asarray(zero), np.asarray(none))
+    greedy = generate(params, prompt, CFG, 6)
+    noop = generate(params, prompt, CFG, 6, top_p=1.0, min_p=0.0)
+    np.testing.assert_array_equal(np.asarray(noop), np.asarray(greedy))
+    with pytest.raises(ValueError, match="temperature"):
+        generate(params, prompt, CFG, 6, top_p=0.9)  # real filter
+    with pytest.raises(ValueError, match="min_p"):
+        generate(params, prompt, CFG, 6, temperature=0.9, min_p=-0.1,
+                 key=k)

@@ -1,0 +1,476 @@
+"""Graph lint: every AST and IR rule, positive + negative, plus the
+``# dkt: ignore`` suppression syntax and the census parser.
+
+Heavier checks against the REAL trainer/serving programs (comm budget,
+ZeRO-1 parity, compile counts) live in tests/test_budget_guards.py.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.analysis.findings import (Finding, apply_suppressions,
+                                              suppressed_rules)
+from distkeras_tpu.analysis.ir_lint import (CollectiveOp, TraceSpec,
+                                             check_budget,
+                                             census_to_budget,
+                                             check_zero1_parity,
+                                             comm_census, lint_trace)
+from distkeras_tpu.analysis.source_lint import lint_source
+
+
+def rules_of(findings, only_gating=False):
+    return {f.rule for f in findings if f.gating or not only_gating}
+
+
+def lint(src, path="distkeras_tpu/models/foo.py"):
+    return lint_source(textwrap.dedent(src), path=path)
+
+
+# ------------------------------------------------------------- AST rules
+
+
+def test_jit_wallclock_positive_and_negative():
+    pos = lint("""
+        import time, jax
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            return x * t
+    """)
+    assert "jit-wallclock" in rules_of(pos)
+    neg = lint("""
+        import time, jax
+
+        def host_logger(x):
+            return time.time()
+    """)
+    assert "jit-wallclock" not in rules_of(neg)
+
+
+def test_jit_np_random_positive_and_negative():
+    pos = lint("""
+        import jax
+        import numpy as np
+
+        def step(x):
+            return x + np.random.rand()
+
+        f = jax.jit(step)
+    """)
+    assert "jit-np-random" in rules_of(pos)
+    neg = lint("""
+        import numpy as np
+
+        def make_batch(n):
+            return np.random.rand(n)
+    """)
+    assert "jit-np-random" not in rules_of(neg)
+
+
+def test_traced_detection_reaches_nested_defs():
+    pos = lint("""
+        import time, jax
+
+        @jax.jit
+        def outer(x):
+            def inner(y):
+                return y + time.time()
+            return inner(x)
+    """)
+    assert "jit-wallclock" in rules_of(pos)
+
+
+def test_hot_sync_positive_and_negative():
+    src = """
+        import jax
+
+        def run(losses):
+            for l in losses:
+                jax.device_get(l)
+    """
+    assert "hot-sync" in rules_of(
+        lint(src, path="distkeras_tpu/trainers/foo.py"))
+    # Same code off the hot paths: no finding.
+    assert "hot-sync" not in rules_of(
+        lint(src, path="distkeras_tpu/data/foo.py"))
+    # Hot path but not in a loop: no finding.
+    assert "hot-sync" not in rules_of(lint("""
+        import jax
+
+        def run(loss):
+            jax.device_get(loss)
+    """, path="distkeras_tpu/trainers/foo.py"))
+
+
+def test_import_time_jnp_positive_and_negative():
+    pos = lint("""
+        import jax.numpy as jnp
+
+        TABLE = jnp.arange(1024)
+    """)
+    assert "import-time-jnp" in rules_of(pos)
+    neg = lint("""
+        import jax.numpy as jnp
+
+        def table():
+            return jnp.arange(1024)
+    """)
+    assert "import-time-jnp" not in rules_of(neg)
+
+
+def test_mutable_default_positive_and_negative():
+    pos = lint("""
+        def submit(prompt, hooks=[]):
+            return hooks
+    """)
+    assert "mutable-default" in rules_of(pos)
+    neg = lint("""
+        def submit(prompt, hooks=None):
+            return hooks or []
+
+        def _private(prompt, hooks=[]):
+            return hooks
+    """)
+    assert "mutable-default" not in rules_of(neg)
+
+
+def test_jit_no_donate_positive_and_negative():
+    pos = lint("""
+        import jax
+
+        def make(train_step):
+            return jax.jit(train_step)
+    """)
+    assert "jit-no-donate" in rules_of(pos)
+    neg = lint("""
+        import jax
+
+        def make(train_step, loss_fn):
+            a = jax.jit(train_step, donate_argnums=0)
+            b = jax.jit(loss_fn)
+            return a, b
+    """)
+    assert "jit-no-donate" not in rules_of(neg)
+
+
+def test_axis_name_positive_and_negative():
+    pos = lint("""
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P("dta", None)
+    """)
+    assert "axis-name" in rules_of(pos)
+    neg = lint("""
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P("data", ("model", "seq"))
+    """)
+    assert "axis-name" not in rules_of(neg)
+
+
+def test_loop_jit_positive_and_negative():
+    pos = lint("""
+        import jax
+
+        def compile_all(fns):
+            out = []
+            for f in fns:
+                out.append(jax.jit(f))
+            return out
+    """)
+    assert "loop-jit" in rules_of(pos)
+    neg = lint("""
+        import jax
+
+        def compile_one(f):
+            return jax.jit(f, donate_argnums=0)
+    """)
+    assert "loop-jit" not in rules_of(neg)
+
+
+# ----------------------------------------------------------- suppression
+
+
+def test_suppression_comment_parsing():
+    assert suppressed_rules("x = 1") is None
+    assert suppressed_rules("x = 1  # dkt: ignore") == frozenset()
+    assert suppressed_rules("x = 1  # dkt: ignore[a-b, c]") == {"a-b", "c"}
+
+
+def test_suppression_matching_rule():
+    f = Finding(rule="hot-sync", severity="warn", path="p", line=1,
+                message="m")
+    assert apply_suppressions(f, "foo()  # dkt: ignore[hot-sync]").suppressed
+    assert apply_suppressions(f, "foo()  # dkt: ignore").suppressed
+    assert not apply_suppressions(f, "foo()  # dkt: ignore[other]").suppressed
+    assert not apply_suppressions(f, "foo()").suppressed
+
+
+def test_source_suppression_end_to_end():
+    src = """
+        import time, jax
+
+        @jax.jit
+        def step(x):
+            return x * time.time()  # dkt: ignore[jit-wallclock]
+    """
+    findings = lint(src)
+    assert [f for f in findings if f.rule == "jit-wallclock"]
+    assert not [f for f in findings if f.gating]
+
+
+def test_ir_suppression_via_spec():
+    def f(x):
+        a = jax.random.normal(x, (4,))
+        b = jax.random.normal(x, (4,))
+        return a + b
+
+    spec = TraceSpec(name="t", fn=jax.jit(f),
+                     args=(jax.random.key(0),),
+                     suppress=("prng-reuse",))
+    findings, _ = lint_trace(spec, compile_census=False)
+    hits = [f for f in findings if f.rule == "prng-reuse"]
+    assert hits and all(f.suppressed for f in hits)
+
+
+# -------------------------------------------------------------- IR rules
+
+
+def _ir(fn, *args, donate=(), **jit_kw):
+    spec = TraceSpec(name="t",
+                     fn=jax.jit(fn, donate_argnums=donate, **jit_kw),
+                     args=args, donate_argnums=donate)
+    findings, _ = lint_trace(spec, compile_census=False)
+    return findings
+
+
+def test_dtype_f64_positive_and_negative():
+    with jax.experimental.enable_x64():
+        pos = _ir(lambda x: jnp.asarray(x, jnp.float64) * 2.0,
+                  jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert "dtype-f64" in rules_of(pos)
+    neg = _ir(lambda x: x * 2.0, jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert "dtype-f64" not in rules_of(neg)
+
+
+def test_dtype_upcast_positive_and_negative():
+    # Upcast escaping into elementwise math: silent, flagged.
+    pos = _ir(lambda x: x.astype(jnp.float32) * 2.0,
+              jax.ShapeDtypeStruct((4,), jnp.bfloat16))
+    assert "dtype-upcast" in rules_of(pos)
+    # f32 ACCUMULATION of a bf16 value (sum's internal promotion) is
+    # the standard intentional upcast — exempt.
+    neg = _ir(lambda x: x.astype(jnp.bfloat16).sum(),
+              jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert "dtype-upcast" not in rules_of(neg)
+
+
+def test_host_callback_positive_and_negative():
+    def pos_fn(x):
+        jax.debug.print("x={x}", x=x)
+        return x + 1
+
+    assert "host-callback" in rules_of(
+        _ir(pos_fn, jax.ShapeDtypeStruct((), jnp.float32)))
+    assert "host-callback" not in rules_of(
+        _ir(lambda x: x + 1, jax.ShapeDtypeStruct((), jnp.float32)))
+
+
+def test_prng_reuse_positive_and_negative():
+    def pos_fn(key):
+        a = jax.random.normal(key, (4,))
+        b = jax.random.categorical(key, jnp.zeros((8,)))
+        return a.sum() + b
+
+    assert "prng-reuse" in rules_of(_ir(pos_fn, jax.random.key(0)))
+
+    def neg_fn(key):
+        k1, k2 = jax.random.split(key)
+        return (jax.random.normal(k1, (4,)).sum()
+                + jax.random.categorical(k2, jnp.zeros((8,))))
+
+    assert "prng-reuse" not in rules_of(_ir(neg_fn, jax.random.key(0)))
+
+
+def test_prng_loop_invariant_reuse():
+    def pos_fn(key, xs):
+        def body(c, x):
+            return c + jax.random.categorical(key, x), None
+
+        out, _ = jax.lax.scan(body, 0.0, xs)
+        return out
+
+    xs = jax.ShapeDtypeStruct((3, 8), jnp.float32)
+    assert "prng-reuse" in rules_of(_ir(pos_fn, jax.random.key(0), xs))
+
+    def neg_fn(key, xs):
+        def body(c, ix):
+            i, x = ix
+            k = jax.random.fold_in(key, i)
+            return c + jax.random.categorical(k, x), None
+
+        out, _ = jax.lax.scan(body, 0.0, (jnp.arange(3), xs))
+        return out
+
+    assert "prng-reuse" not in rules_of(
+        _ir(neg_fn, jax.random.key(0), xs))
+
+    def neg_presplit(key, xs):
+        # The textbook pattern: scan OVER pre-split keys — each
+        # iteration's key varies, nothing is loop-invariant.
+        ks = jax.random.split(key, 3)
+
+        def body(c, kx):
+            k, x = kx
+            return c + jax.random.categorical(k, x), None
+
+        out, _ = jax.lax.scan(body, 0.0, (ks, xs))
+        return out
+
+    assert "prng-reuse" not in rules_of(
+        _ir(neg_presplit, jax.random.key(0), xs))
+
+
+def test_prng_cond_branches_are_exclusive():
+    def neg_fn(pred, key):
+        # Only one branch runs: consuming the key once in EACH branch
+        # is exactly one consumption at runtime.
+        return jax.lax.cond(
+            pred,
+            lambda k: jax.random.normal(k, (4,)),
+            lambda k: jax.random.uniform(k, (4,)),
+            key)
+
+    assert "prng-reuse" not in rules_of(
+        _ir(neg_fn, jax.ShapeDtypeStruct((), jnp.bool_),
+            jax.random.key(0)))
+
+    def pos_fn(pred, key):
+        # Consumed before the cond AND inside a branch: real reuse.
+        a = jax.random.normal(key, (4,))
+        b = jax.lax.cond(
+            pred,
+            lambda k: jax.random.normal(k, (4,)),
+            lambda k: jnp.zeros((4,)),
+            key)
+        return a + b
+
+    assert "prng-reuse" in rules_of(
+        _ir(pos_fn, jax.ShapeDtypeStruct((), jnp.bool_),
+            jax.random.key(0)))
+
+
+def test_donation_unused_positive_and_negative():
+    pos = _ir(lambda x: (x * 2.0).sum(),
+              jax.ShapeDtypeStruct((8,), jnp.float32), donate=(0,))
+    assert "donation-unused" in rules_of(pos)
+    neg = _ir(lambda x: x * 2.0,
+              jax.ShapeDtypeStruct((8,), jnp.float32), donate=(0,))
+    assert "donation-unused" not in rules_of(neg)
+
+
+def test_donation_read_positive_and_negative():
+    pos = _ir(lambda x: (x, x + 1.0),
+              jax.ShapeDtypeStruct((8,), jnp.float32), donate=(0,))
+    assert "donation-read" in rules_of(pos)
+    neg = _ir(lambda x: (x + 1.0, x.sum()),
+              jax.ShapeDtypeStruct((8,), jnp.float32), donate=(0,))
+    assert "donation-read" not in rules_of(neg)
+
+
+# ------------------------------------------------------- census + budget
+
+
+_SYNTH_HLO = """\
+HloModule synth
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %a, f32[] %b)
+}
+
+%fused_computation.1 (p0: f32[128], p1: s32[]) -> f32[16] {
+  %p0 = f32[128]{0} parameter(0)
+  %p1 = s32[] parameter(1)
+  ROOT %ds = f32[16]{0} dynamic-slice(f32[128]{0} %p0, s32[] %p1), dynamic_slice_sizes={16}
+}
+
+ENTRY %main.1 (g: f32[128], x: f32[1,16], y: f32[128], l: f32[]) -> f32[16] {
+  %g = f32[128]{0} parameter(0)
+  %x = f32[1,16]{1,0} parameter(1)
+  %y = f32[128]{0} parameter(2)
+  %l = f32[] parameter(3)
+  %all-reduce = f32[128]{0} all-reduce(f32[128]{0} %g), channel_id=1, replica_groups=[1,8]<=[8], to_apply=%add
+  %pid = s32[] partition-id()
+  %use = f32[16]{0} fusion(f32[128]{0} %all-reduce, s32[] %pid), kind=kLoop, calls=%fused_computation.1
+  %all-reduce.1 = f32[] all-reduce(f32[] %l), channel_id=2, replica_groups=[1,8]<=[8], to_apply=%add
+  %b = f32[16]{0} broadcast(f32[] %all-reduce.1), dimensions={}
+  %all-gather = f32[8,16]{1,0} all-gather(f32[1,16]{1,0} %x), channel_id=3, replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %reduce-scatter = f32[16]{0} reduce-scatter(f32[128]{0} %y), channel_id=4, replica_groups=[1,8]<=[8], dimensions={0}, to_apply=%add
+  ROOT %out = f32[16]{0} add(f32[16]{0} %use, f32[16]{0} %b)
+}
+"""
+
+
+def test_comm_census_parses_and_canonicalizes():
+    census = {(c.op, c.canonical): c
+              for c in comm_census(_SYNTH_HLO, default_group=8)}
+    # The gradient AR's only consumer slices 1/8 of it -> canonical RS.
+    ar_rs = census[("all-reduce", "reduce-scatter")]
+    assert ar_rs.payload_bytes == 512 and ar_rs.wire_bytes == 448.0
+    # The loss AR's consumer broadcasts (no slice) -> stays AR.
+    ar = census[("all-reduce", "all-reduce")]
+    assert ar.payload_bytes == 4
+    ag = census[("all-gather", "all-gather")]
+    assert ag.payload_bytes == 512 and ag.wire_bytes == 448.0
+    rs = census[("reduce-scatter", "reduce-scatter")]
+    # Payload = the full pre-scatter operand, not the 1/8 result.
+    assert rs.payload_bytes == 512 and rs.wire_bytes == 448.0
+
+
+def test_budget_check_positive_and_negative():
+    census = comm_census(_SYNTH_HLO, default_group=8)
+    good = {"t": census_to_budget(census)}
+    assert check_budget("t", census, good) == []
+    drifted = {"t": {"collectives": [], "wire_total": 0}}
+    bad = check_budget("t", census, drifted)
+    assert [f for f in bad if f.rule == "comm-budget" and f.gating]
+    missing = check_budget("other", census, good)
+    assert [f for f in missing if f.rule == "comm-budget"]
+
+
+def test_zero1_parity_needs_reference_bytes():
+    spec = TraceSpec(name="z", fn=jax.jit(lambda x: x), args=(1.0,))
+    findings = check_zero1_parity(spec, [])
+    assert "zero1-parity" in rules_of(findings)
+
+
+def test_zero1_parity_detects_missing_exchange():
+    # A step with NO declared zero1 exchange must fail parity loudly.
+    spec = TraceSpec(name="z", fn=jax.jit(lambda x: x * 2.0),
+                     args=(jnp.ones((8,)),), params_bytes=32)
+    dp_census = [CollectiveOp(op="all-reduce", canonical="all-reduce",
+                              payload_bytes=32, group_size=8)]
+    findings = check_zero1_parity(spec, dp_census)
+    assert "zero1-parity" in rules_of(findings)
+
+
+# ----------------------------------------------------- repo runs clean
+
+
+def test_source_lint_clean_on_repo():
+    import os
+
+    from distkeras_tpu.analysis.source_lint import lint_paths
+
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "distkeras_tpu")
+    findings = lint_paths([root])
+    gating = [f.format() for f in findings if f.gating]
+    assert not gating, gating

@@ -471,3 +471,23 @@ def test_lm_device_data_packed_segments(devices, rng):
         return t.history
 
     np.testing.assert_allclose(run(device_data=True), run(), rtol=1e-6)
+
+
+def test_device_data_staging_guard_raises_with_figure(rng, monkeypatch):
+    """Round-6 fix: when the staged token stream cannot fit device
+    memory, device_data=True fails fast with the MiB figure and the
+    streaming fallback named — not a raw XLA allocation error deep in
+    _global_batch.  CPU reports no budget, so the test injects one."""
+    from distkeras_tpu.trainers import lm as lm_mod
+
+    monkeypatch.setattr(lm_mod, "_device_bytes_limit", lambda: 256)
+    t = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16,
+                     device_data=True)
+    with pytest.raises(ValueError, match=r"MiB.*device_data=False"):
+        t.train(tokens(rng))
+    # A budget that fits stages normally (guard stays quiet).
+    monkeypatch.setattr(lm_mod, "_device_bytes_limit", lambda: 1 << 30)
+    t2 = dk.LMTrainer(CFG, learning_rate=1e-2, batch_size=16,
+                      device_data=True)
+    t2.train(tokens(rng))
+    assert len(t2.history) == 4

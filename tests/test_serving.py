@@ -637,3 +637,48 @@ def test_speculative_batcher_sampled_matches_solo(params, rng):
                                 lanes=1, n_draft=2)
     with pytest.raises(ValueError, match="key iff"):
         greedy.submit(pa, 4, key=ka)      # greedy engine with key
+
+
+def test_speculative_impossible_config_rejected_eagerly(params):
+    """Round-6 fix: a n_draft/max_len combination that can never admit
+    any request fails at CONSTRUCTION, naming n_draft and max_len —
+    not at every submit() with an error blaming the prompt."""
+    import dataclasses
+
+    from distkeras_tpu.serving import SpeculativeBatcher
+
+    draft_cfg = tfm.TransformerConfig(vocab_size=64, d_model=16,
+                                      n_heads=2, n_layers=1, d_ff=32,
+                                      max_len=4, rope=True)
+    draft = tfm.init_params(jax.random.key(9), draft_cfg)
+    # min(max_len) = 4 <= n_draft + 1 = 5: no request can ever fit.
+    with pytest.raises(ValueError, match=r"n_draft=4.*max_len"):
+        SpeculativeBatcher(params, draft, CFG, draft_cfg, n_draft=4)
+    # The boundary case (cap == 1) constructs and admits a 1-token
+    # prompt with one new token.
+    ok_draft_cfg = dataclasses.replace(draft_cfg, max_len=6)
+    ok_draft = tfm.init_params(jax.random.key(9), ok_draft_cfg)
+    eng = SpeculativeBatcher(params, ok_draft, CFG, ok_draft_cfg,
+                             n_draft=4, lanes=1)
+    assert eng.submit(np.asarray([3], np.int32), 1) == 0
+
+
+def test_engine_top_p_one_matches_unfiltered_solo(params, rng):
+    """Round-6 parity fix: a scalar-path engine built with top_p=1.0
+    decodes exactly like solo generate with NO nucleus filter (and
+    like generate(top_p=1.0), which now bypasses the mask too)."""
+    # The no-op values are legal on every engine mode — scalar sampled,
+    # scalar greedy (they turn nothing ON), and per-request (already).
+    ContinuousBatcher(params, CFG, lanes=1, temperature=0.9, min_p=0.0)
+    ContinuousBatcher(params, CFG, lanes=1, top_p=1.0, min_p=0.0)
+    eng = ContinuousBatcher(params, CFG, lanes=1, temperature=0.9,
+                            top_p=1.0, prompt_buckets=(8,))
+    prompt = rng.integers(0, 64, (5,)).astype(np.int32)
+    k = jax.random.key(3)
+    lane = eng.submit(prompt, 6, key=k)
+    out = run_to_done(eng, lane)
+    unfiltered = solo(params, prompt, 6, temperature=0.9, key=k)
+    explicit = solo(params, prompt, 6, temperature=0.9, top_p=1.0,
+                    key=k)
+    np.testing.assert_array_equal(out, unfiltered)
+    np.testing.assert_array_equal(out, explicit)
