@@ -59,6 +59,7 @@ from distkeras_tpu.utils.serialization import (
     save_lm,
     load_lm,
 )
+from distkeras_tpu import obs
 from distkeras_tpu.models.adapter import ModelAdapter, TrainState
 from distkeras_tpu.parallel import collectives
 from distkeras_tpu.parallel.collectives import zero1_optimizer
@@ -118,6 +119,7 @@ __all__ = [
     "zero1_plan",
     "zero1_optimizer",
     "collectives",
+    "obs",
     "Dataset",
     "pack_documents",
     "packing_efficiency",

@@ -685,12 +685,20 @@ def check_zero1_parity(z1_spec: TraceSpec, dp_census) -> list[Finding]:
             "may need tuning for this model, or DP stopped "
             "all-reducing some leaves")
     elif dp_grad > P:
-        add("comm-redundant-ar", "info",
+        # Promoted info -> warn (PR 4): the one known instance — the
+        # tied embedding's two gradient contributions all-reduced
+        # separately in replicated-DP LMTrainer — is fixed (the
+        # shard_map-local gradient construction sums them before the
+        # exchange, trainers/lm.py), so any reappearance is a
+        # regression and gates CI.
+        add("comm-redundant-ar", "warn",
             f"replicated-DP compiles {dp_grad} all-reduce bytes for "
             f"{P} parameter bytes ({dp_grad - P} redundant)",
             "usually tied weights whose gradient contributions XLA "
-            "reduces separately instead of summing locally first; "
-            "zero1's declared exchange does not inherit this")
+            "reduces separately instead of summing locally first "
+            "(sum them before the exchange, as LMTrainer's "
+            "_dp_local_value_and_grad does); zero1's declared "
+            "exchange does not inherit this")
     return findings
 
 

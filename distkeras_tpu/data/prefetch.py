@@ -16,6 +16,8 @@ import queue
 import threading
 from typing import Iterable, Iterator
 
+from distkeras_tpu import obs
+
 
 class DeviceFeed:
     """Stream host batches to the device, ``depth`` items in flight.
@@ -51,10 +53,19 @@ class DeviceFeed:
         pending: collections.deque = collections.deque()
         for item in self._source:
             # device_put maps over pytrees itself and coalesces the
-            # leaves into one batched transfer.
-            pending.append(jax.device_put(item, self._sharding)
-                           if self._sharding is not None
-                           else jax.device_put(item))
+            # leaves into one batched transfer.  The obs span measures
+            # *dispatch* wall time (the transfer itself rides under
+            # the device step — that overlap is the point); the bytes
+            # counter sizes the h2d stream exactly.
+            if obs.active() is not None:
+                obs.count("data.h2d.bytes",
+                          sum(getattr(x, "nbytes", 0)
+                              for x in jax.tree.leaves(item)))
+                obs.count("data.h2d.items")
+            with obs.span("data.h2d"):
+                pending.append(jax.device_put(item, self._sharding)
+                               if self._sharding is not None
+                               else jax.device_put(item))
             if len(pending) > self._depth:
                 yield pending.popleft()
         while pending:
@@ -138,6 +149,12 @@ class Prefetcher:
             except queue.Empty:
                 if self._stop.is_set():
                     raise StopIteration from None
+        # Buffer occupancy at consumption: a gauge pinned near 0 means
+        # the producer can't keep up (input-bound run); near `depth`
+        # means compute-bound.  qsize() takes the queue mutex, so it
+        # is guarded — the disabled path must stay free.
+        if obs.active() is not None:
+            obs.gauge("data.prefetch.occupancy", self._q.qsize())
         if item is self._DONE:
             self._finished = True
             if self._err is not None:

@@ -923,7 +923,8 @@ def make_train_step(cfg: TransformerConfig, optimizer,
                     apply_fn: Callable | None = None,
                     grad_accum: int = 1,
                     hidden_fn: Callable | None = None,
-                    loss_fn: Callable | None = None):
+                    loss_fn: Callable | None = None,
+                    value_and_grad: Callable | None = None):
     """``step((params, opt_state), tokens) -> ((params', opt_state'), loss)``.
 
     Pure; callers jit it with NamedShardings (see __graft_entry__ and
@@ -940,13 +941,24 @@ def make_train_step(cfg: TransformerConfig, optimizer,
     signature; a custom hook reinterprets the differentiated "params"
     tree (e.g. models/lora's (adapters, base) packing, which merges
     before calling lm_loss).
+
+    ``value_and_grad`` (default ``jax.value_and_grad``) is the
+    gradient-construction hook: it receives the loss fn and must
+    return a callable with ``jax.value_and_grad``'s calling
+    convention.  LMTrainer's replicated-DP configuration passes a
+    shard_map-local construction here that sums the tied embedding's
+    two gradient contributions *before* the cross-replica exchange
+    (trainers/lm.py ``_dp_local_value_and_grad``) — XLA's CPU
+    partitioner otherwise all-reduces them separately.
     """
     dropping = cfg.dropout > 0
+    if value_and_grad is None:
+        value_and_grad = jax.value_and_grad
 
     def step(carry, tokens, dropout_rng=None, segment_ids=None):
         params, opt_state = carry
-        grad_fn = jax.value_and_grad(loss_fn if loss_fn is not None
-                                     else lm_loss)
+        grad_fn = value_and_grad(loss_fn if loss_fn is not None
+                                 else lm_loss)
         if dropping and dropout_rng is None:
             raise ValueError(
                 f"cfg.dropout={cfg.dropout} but the train step got no "

@@ -1,0 +1,169 @@
+"""Observability: unified metrics + structured event tracing.
+
+The telemetry subsystem every layer records through (docs/
+observability.md): trainers (``StepTimer`` phases as spans, loss/
+timing gauges), serving (queue depth, rejects, deadline misses, lane
+occupancy, speculative accept rate, request latency histograms),
+resilience (chaos faults, Supervisor attempts/backoff, checkpoint
+durations), and the data path (prefetch occupancy, h2d bytes).
+
+Usage::
+
+    from distkeras_tpu import obs
+
+    with obs.session(trace_path="run.jsonl") as sess:
+        trainer.train(tokens)
+        engine.step()
+    print(sess.registry.render_text())          # Prometheus text
+    # python scripts/obs_report.py run.jsonl    # offline run report
+
+**Disabled is the default and costs (almost) nothing.**  Every hook in
+the production code calls a module function here (``obs.count`` /
+``obs.gauge`` / ``obs.observe`` / ``obs.event`` / ``obs.span``) whose
+first statement is ``if _ACTIVE is None: return`` — one module-attr
+load and an ``is`` check, the same idiom as ``resilience.chaos.probe``.
+No registry, no trace file, no background thread exists until
+:func:`enable` runs.  Nothing here ever reaches inside a jitted
+program (no host callbacks — pinned by the graph lint's
+``host-callback`` rule over the real step programs, tests/test_obs.py),
+so enabling telemetry cannot change compile counts or comm budgets.
+
+One session is active at a time (like a chaos ``FaultPlan``: a
+telemetry stream must be read off one sink, not two interleaved ones).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from distkeras_tpu.obs.metrics import (Counter, Gauge, Histogram,
+                                        MetricsRegistry,
+                                        DEFAULT_TIME_BUCKETS,
+                                        percentile_from_buckets)
+from distkeras_tpu.obs.trace import EventTrace, read_trace
+
+_ACTIVE = None
+
+
+class ObsSession:
+    """One enabled telemetry window: a :class:`MetricsRegistry` plus an
+    optional :class:`EventTrace` (``trace_path=None`` = metrics only).
+
+    On close the registry snapshot is appended to the trace as its
+    final ``metrics`` record, so the JSONL file alone is enough for
+    ``scripts/obs_report.py`` (latency percentiles included).
+    """
+
+    def __init__(self, trace_path: str | None = None,
+                 run_id: str | None = None):
+        self.registry = MetricsRegistry()
+        self.trace = (EventTrace(trace_path, run_id=run_id)
+                      if trace_path else None)
+        self.run_id = self.trace.run_id if self.trace else run_id
+
+    def close(self) -> None:
+        if self.trace is not None:
+            self.trace.metrics(self.registry.snapshot())
+            self.trace.close()
+
+
+def enable(trace_path: str | None = None,
+           run_id: str | None = None) -> ObsSession:
+    """Activate telemetry; returns the session.  Pair with
+    :func:`disable`, or use :func:`session` for scoped enablement."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError(
+            "an obs session is already active; telemetry sessions do "
+            "not nest (disable() the current one first)")
+    _ACTIVE = ObsSession(trace_path=trace_path, run_id=run_id)
+    return _ACTIVE
+
+
+def disable() -> None:
+    """Deactivate and close the current session (no-op when none)."""
+    global _ACTIVE
+    sess, _ACTIVE = _ACTIVE, None
+    if sess is not None:
+        sess.close()
+
+
+@contextlib.contextmanager
+def session(trace_path: str | None = None, run_id: str | None = None):
+    """``with obs.session("run.jsonl") as sess: ...``"""
+    sess = enable(trace_path=trace_path, run_id=run_id)
+    try:
+        yield sess
+    finally:
+        disable()
+
+
+def active() -> ObsSession | None:
+    """The enabled session, or None — production hooks use the module
+    functions below instead of checking this directly."""
+    return _ACTIVE
+
+
+# --------------------------------------------------------------- hooks
+#
+# The functions the instrumented layers call.  Each one is a no-op
+# (one attribute load + `is` check) when telemetry is disabled.
+
+
+# Each hook binds _ACTIVE to a local ONCE: a concurrent disable()
+# (bench_suite's per-config teardown, while a daemon Prefetcher thread
+# is mid-record) must find a hook working on the session it sampled,
+# never a half-observed None.
+
+
+def count(name: str, n: float = 1.0, **labels) -> None:
+    """Increment a counter (created on first use)."""
+    sess = _ACTIVE
+    if sess is None:
+        return
+    sess.registry.counter(name).inc(n, **labels)
+
+
+def gauge(name: str, value: float, **labels) -> None:
+    """Set a gauge (created on first use)."""
+    sess = _ACTIVE
+    if sess is None:
+        return
+    sess.registry.gauge(name).set(value, **labels)
+
+
+def observe(name: str, value: float, buckets=None, **labels) -> None:
+    """Record one histogram observation (default latency buckets)."""
+    sess = _ACTIVE
+    if sess is None:
+        return
+    h = (sess.registry.histogram(name) if buckets is None
+         else sess.registry.histogram(name, buckets=buckets))
+    h.observe(value, **labels)
+
+
+def event(name: str, **fields) -> None:
+    """Append a point event to the trace (no-op without a trace)."""
+    sess = _ACTIVE
+    if sess is None or sess.trace is None:
+        return
+    sess.trace.event(name, **fields)
+
+
+_NULL = contextlib.nullcontext()
+
+
+def span(name: str, **fields):
+    """Span context manager; a shared null context when disabled (no
+    allocation on the disabled path)."""
+    sess = _ACTIVE
+    if sess is None or sess.trace is None:
+        return _NULL
+    return sess.trace.span(name, **fields)
+
+
+__all__ = ["ObsSession", "MetricsRegistry", "Counter", "Gauge",
+           "Histogram", "EventTrace", "read_trace",
+           "percentile_from_buckets", "DEFAULT_TIME_BUCKETS",
+           "enable", "disable", "session", "active",
+           "count", "gauge", "observe", "event", "span"]

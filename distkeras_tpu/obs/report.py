@@ -1,0 +1,227 @@
+"""Offline run reports from obs traces (the scripts/obs_report.py
+library).
+
+A trace JSONL (obs/trace.py) reconstructs into:
+
+* **phase breakdown** — spans aggregated by name: call count, total /
+  mean seconds, p50/p95/p99 of the span durations, share of the run's
+  wall span;
+* **latency percentiles** — every histogram series in the trace's
+  final ``metrics`` record, rendered with bucket-interpolated
+  p50/p95/p99 (obs/metrics.percentile_from_buckets);
+* **counters/gauges** — the remaining metrics series;
+* **event timeline** — point events in time order (chaos faults,
+  supervisor attempts, admission rejects...).
+
+``compare`` diffs two reports for regression triage: per-phase total /
+mean deltas, histogram percentile deltas, counter deltas — the dynamic
+reality the static comm/compile budgets (PR 3) cannot see.
+"""
+
+from __future__ import annotations
+
+import statistics
+
+from distkeras_tpu.obs.metrics import percentile_from_buckets
+from distkeras_tpu.obs.trace import read_trace
+
+
+def _pct(durs: list, q: float) -> float:
+    if not durs:
+        return 0.0
+    s = sorted(durs)
+    idx = min(len(s) - 1, max(0, round(q * (len(s) - 1))))
+    return s[int(idx)]
+
+
+def build_report(records: list[dict]) -> dict:
+    """Trace records -> plain-dict report (JSON-able)."""
+    meta = next((r for r in records if r.get("kind") == "meta"), {})
+    spans: dict[str, list] = {}
+    events = []
+    metrics = {}
+    t_lo = t_hi = None
+    for r in records:
+        kind = r.get("kind")
+        if kind == "span":
+            spans.setdefault(r["name"], []).append(r)
+            lo, hi = r["t0"], r["t0"] + r["dur"]
+        elif kind == "event":
+            events.append(r)
+            lo = hi = r["t"]
+        elif kind == "metrics":
+            metrics = r.get("data", {})
+            continue
+        else:
+            continue
+        t_lo = lo if t_lo is None else min(t_lo, lo)
+        t_hi = hi if t_hi is None else max(t_hi, hi)
+    wall = (t_hi - t_lo) if t_lo is not None else 0.0
+
+    phases = {}
+    for name, recs in sorted(spans.items()):
+        durs = [r["dur"] for r in recs]
+        total = sum(durs)
+        phases[name] = {
+            "count": len(durs), "total_s": total,
+            "mean_s": total / len(durs),
+            "p50_s": statistics.median(durs),
+            "p95_s": _pct(durs, 0.95), "p99_s": _pct(durs, 0.99),
+            "share": (total / wall) if wall else 0.0,
+        }
+
+    hists, scalars = {}, {}
+    for name, m in sorted(metrics.items()):
+        for s in m.get("series", []):
+            lab = ",".join(f"{k}={v}"
+                           for k, v in sorted(s["labels"].items()))
+            key = f"{name}{{{lab}}}" if lab else name
+            if m.get("kind") == "histogram":
+                if s.get("count"):
+                    hists[key] = {
+                        "count": s["count"],
+                        "mean": s["sum"] / s["count"],
+                        "min": s.get("min"), "max": s.get("max"),
+                        "p50": percentile_from_buckets(s, 0.50),
+                        "p95": percentile_from_buckets(s, 0.95),
+                        "p99": percentile_from_buckets(s, 0.99),
+                    }
+            else:
+                scalars[key] = s.get("value")
+
+    timeline = [{"t": (e["t"] - t_lo) if t_lo is not None else e["t"],
+                 "name": e["name"], "fields": e.get("fields", {})}
+                for e in sorted(events, key=lambda e: e["t"])]
+    return {"meta": {k: meta.get(k) for k in
+                     ("run", "host", "pid", "time_unix")},
+            "wall_s": wall, "phases": phases, "latency": hists,
+            "scalars": scalars, "timeline": timeline}
+
+
+def load_report(path: str) -> dict:
+    return build_report(read_trace(path))
+
+
+# ------------------------------------------------------------ rendering
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v * 1e6:.0f}us"
+
+
+def _is_seconds(metric_name: str) -> bool:
+    """Histogram naming convention: ``*_s`` series carry seconds (and
+    render as latency); anything else renders as plain numbers."""
+    return metric_name.split("{")[0].endswith("_s")
+
+
+def _fmt_for(name: str):
+    return _fmt_s if _is_seconds(name) else (
+        lambda v: "-" if v is None else f"{v:.4g}")
+
+
+def render_report(rep: dict, max_events: int = 60) -> str:
+    out = [f"run {rep['meta'].get('run')}  host {rep['meta'].get('host')}"
+           f"  wall {_fmt_s(rep['wall_s'])}"]
+    if rep["phases"]:
+        out.append("\n== phase breakdown (spans) ==")
+        out.append(f"{'phase':<32}{'calls':>7}{'total':>10}{'mean':>10}"
+                   f"{'p50':>10}{'p95':>10}{'p99':>10}{'share':>8}")
+        for name, p in sorted(rep["phases"].items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            out.append(
+                f"{name:<32}{p['count']:>7}{_fmt_s(p['total_s']):>10}"
+                f"{_fmt_s(p['mean_s']):>10}{_fmt_s(p['p50_s']):>10}"
+                f"{_fmt_s(p['p95_s']):>10}{_fmt_s(p['p99_s']):>10}"
+                f"{p['share'] * 100:>7.1f}%")
+    if rep["latency"]:
+        out.append("\n== histograms (latency and sizes) ==")
+        out.append(f"{'metric':<44}{'count':>7}{'mean':>12}{'p50':>12}"
+                   f"{'p95':>12}{'p99':>12}")
+        for name, h in sorted(rep["latency"].items()):
+            fmt = _fmt_for(name)
+            out.append(f"{name:<44}{h['count']:>7}{fmt(h['mean']):>12}"
+                       f"{fmt(h['p50']):>12}{fmt(h['p95']):>12}"
+                       f"{fmt(h['p99']):>12}")
+    if rep["scalars"]:
+        out.append("\n== counters / gauges ==")
+        for name, v in sorted(rep["scalars"].items()):
+            out.append(f"{name:<52}{v:>12g}")
+    if rep["timeline"]:
+        out.append("\n== event timeline ==")
+        shown = rep["timeline"][:max_events]
+        for e in shown:
+            fields = " ".join(f"{k}={v}" for k, v in e["fields"].items())
+            out.append(f"  +{e['t']:>9.4f}s  {e['name']:<28}{fields}")
+        if len(rep["timeline"]) > len(shown):
+            out.append(f"  ... {len(rep['timeline']) - len(shown)} more "
+                       "event(s)")
+    return "\n".join(out)
+
+
+def _delta(old, new) -> str:
+    if old is None or new is None:
+        return "-"
+    if not old:
+        return "new" if new else "0"
+    return f"{(new - old) / old * 100:+.1f}%"
+
+
+def render_compare(base: dict, new: dict) -> str:
+    """Human-readable regression diff: ``new`` against ``base``."""
+    out = [f"compare: base run {base['meta'].get('run')} -> "
+           f"new run {new['meta'].get('run')}",
+           f"wall {_fmt_s(base['wall_s'])} -> {_fmt_s(new['wall_s'])} "
+           f"({_delta(base['wall_s'], new['wall_s'])})"]
+    names = sorted(set(base["phases"]) | set(new["phases"]))
+    if names:
+        out.append("\n== phases: total (mean) base -> new ==")
+        for n in names:
+            b, w = base["phases"].get(n), new["phases"].get(n)
+            if b is None:
+                out.append(f"{n:<32} ADDED    total {_fmt_s(w['total_s'])}")
+            elif w is None:
+                out.append(f"{n:<32} REMOVED  was {_fmt_s(b['total_s'])}")
+            else:
+                out.append(
+                    f"{n:<32}{_fmt_s(b['total_s']):>10} ->"
+                    f"{_fmt_s(w['total_s']):>10} "
+                    f"({_delta(b['total_s'], w['total_s']):>7})   mean "
+                    f"{_fmt_s(b['mean_s'])} -> {_fmt_s(w['mean_s'])} "
+                    f"({_delta(b['mean_s'], w['mean_s'])})")
+    names = sorted(set(base["latency"]) | set(new["latency"]))
+    if names:
+        out.append("\n== histograms: p50 / p95 / p99 base -> new ==")
+        for n in names:
+            b, w = base["latency"].get(n), new["latency"].get(n)
+            if b is None or w is None:
+                out.append(f"{n:<44} {'ADDED' if b is None else 'REMOVED'}")
+                continue
+            fmt = _fmt_for(n)
+            out.append(
+                f"{n:<44}"
+                f"p50 {fmt(b['p50'])}->{fmt(w['p50'])} "
+                f"({_delta(b['p50'], w['p50'])})  "
+                f"p95 {fmt(b['p95'])}->{fmt(w['p95'])} "
+                f"({_delta(b['p95'], w['p95'])})  "
+                f"p99 {fmt(b['p99'])}->{fmt(w['p99'])} "
+                f"({_delta(b['p99'], w['p99'])})")
+    names = sorted(set(base["scalars"]) | set(new["scalars"]))
+    if names:
+        out.append("\n== counters / gauges base -> new ==")
+        for n in names:
+            b = base["scalars"].get(n)
+            w = new["scalars"].get(n)
+            out.append(f"{n:<52}{(b if b is not None else '-'):>10} -> "
+                       f"{(w if w is not None else '-'):>10}")
+    return "\n".join(out)
+
+
+__all__ = ["build_report", "load_report", "render_report",
+           "render_compare"]

@@ -60,6 +60,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distkeras_tpu import obs
 from distkeras_tpu.parallel.compat import shard_map
 
 # ~4 MB buckets: big enough to amortize collective launch latency,
@@ -303,8 +304,30 @@ def zero1_optimizer(inner: optax.GradientTransformation, mesh: Mesh,
         layout = Zero1Layout.for_tree(params, n, bucket_mb)
         return inner.init(layout.shard_views(params))
 
+    def _record_layout(layout: Zero1Layout) -> None:
+        """Bucket geometry into the obs metrics registry — runs at
+        TRACE time (once per compile), so the per-step hot path is
+        untouched.  Per-step *device-side* RS/AG timings are by design
+        not host-observable (overlap interleaves them on the
+        timeline); the ``jax.named_scope`` zero1 regions tag them on
+        profiler traces, and these gauges size the exchange exactly."""
+        if obs.active() is None:
+            return
+        bucket_bytes = [c * layout.n * np.dtype(d).itemsize
+                        for c, d in zip(layout.bucket_cols,
+                                        layout.bucket_dtypes)]
+        pad = sum((s.cols * layout.n - s.size)
+                  * np.dtype(s.dtype).itemsize for s in layout.slots)
+        obs.gauge("zero1.buckets", len(bucket_bytes))
+        obs.gauge("zero1.exchange_bytes", sum(bucket_bytes))
+        obs.gauge("zero1.pad_bytes", pad)
+        for b in bucket_bytes:
+            obs.observe("zero1.bucket_bytes", b,
+                        buckets=(2**18, 2**20, 2**22, 2**24, 2**26))
+
     def update(grads, state, params=None, **kw):
         layout = Zero1Layout.for_tree(grads, n, bucket_mb)
+        _record_layout(layout)
         with jax.named_scope("zero1/reduce_scatter"):
             g_buckets = [scatter(b, mesh, axis) for b in layout.pack(grads)]
         g_views = layout.views_from_buckets(g_buckets)

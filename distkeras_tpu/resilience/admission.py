@@ -75,3 +75,4 @@ class _Pending:
     max_new: int
     deadline: float | None
     submit_kw: dict
+    born: float | None = None  # engine clock() at enqueue (obs latency)

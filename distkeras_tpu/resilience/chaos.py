@@ -39,6 +39,8 @@ import signal as _signal
 import time
 from typing import Callable
 
+from distkeras_tpu import obs
+
 # The known probe sites, checked at rule-registration time so a typo'd
 # site fails loudly instead of silently never firing.
 SITES = (
@@ -153,6 +155,13 @@ class FaultPlan:
                 continue
             rule.fired += 1
             self.events.append((site, n, rule.kind))
+            # Injected faults ride the obs event trace (when a
+            # telemetry session is active), so a chaos run's
+            # fault/recovery timeline is machine-readable —
+            # scripts/chaos_suite.py --trace and obs_report.py
+            # reconstruct it without parsing logs.
+            obs.event("chaos.fault", site=site, step=n, kind=rule.kind)
+            obs.count("chaos.faults", site=site, kind=rule.kind)
             if rule.kind == "delay":
                 time.sleep(rule.seconds)
             elif rule.kind == "signal":

@@ -36,6 +36,7 @@ import signal
 import threading
 import time
 
+from distkeras_tpu import obs
 from distkeras_tpu.resilience.chaos import Preempted
 
 
@@ -163,6 +164,7 @@ class Supervisor:
                     self._record("preempted", e, resumed_from, t0)
                     self.preempt_event.clear()
                     preemptions += 1
+                    obs.count("supervisor.preemptions")
                     if preemptions > self.max_preemptions:
                         raise
                     self._verify_progress(resumed_from)
@@ -170,10 +172,15 @@ class Supervisor:
                 except self.retryable as e:
                     self._record("fault", e, resumed_from, t0)
                     retries += 1
+                    obs.count("supervisor.retries")
                     if retries > self.max_retries:
                         raise
                     self._verify_progress(resumed_from)
-                    self._sleep(self.backoff_for(retries))
+                    wait = self.backoff_for(retries)
+                    obs.event("supervisor.backoff", seconds=wait,
+                              retry=retries)
+                    obs.observe("supervisor.backoff_s", wait)
+                    self._sleep(wait)
                     continue
                 self._record("ok", None, resumed_from, t0)
                 return result
@@ -195,11 +202,18 @@ class Supervisor:
     # ---------------------------------------------------------- helpers
 
     def _record(self, outcome, error, resumed_from, t0):
-        self.attempts.append(Attempt(
+        att = Attempt(
             index=len(self.attempts), outcome=outcome,
             error=None if error is None else repr(error),
             resumed_from=resumed_from,
-            duration=time.perf_counter() - t0))
+            duration=time.perf_counter() - t0)
+        self.attempts.append(att)
+        # Every attempt (and restart) lands in the obs event trace:
+        # the machine-readable fault/recovery timeline chaos_suite.py
+        # and obs_report.py reconstruct.
+        obs.event("supervisor.attempt", index=att.index,
+                  outcome=outcome, resumed_from=resumed_from,
+                  duration_s=att.duration, error=att.error)
 
     def _verify_progress(self, before: int | None):
         """Crash-consistency check between attempts: the checkpoint

@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distkeras_tpu import obs
 from distkeras_tpu.resilience import chaos
 from distkeras_tpu.resilience.admission import (EngineClosed, QueueFull,
                                                  RequestResult, _Pending)
@@ -85,6 +86,7 @@ class _Lane:
     eos: object = None   # per-request eos token (engine default)
     deadline: float | None = None  # absolute clock() time; None = none
     managed: bool = False  # admitted via enqueue(): auto-collected
+    born: float | None = None  # clock() at admission (obs latency)
 
 
 def _make_lane_admit(model_params, model_cfg, off=0, prefix_lane=None):
@@ -147,12 +149,16 @@ class _LaneEngine:
         if not st.done:
             raise ValueError(f"lane {lane} is still decoding")
         self._lane_state[lane] = None
+        self._obs_request_done("ok", st.born)
         return np.asarray(st.tokens, np.int32)
 
     def _emit(self, lane_tokens):
         """Feed each live lane's new tokens (``lane_tokens(lane)``)
         through the transcript/budget/eos bookkeeping; returns the
-        ``{lane: [emitted...]}`` step result."""
+        ``{lane: [emitted...]}`` step result.  The ONE site that
+        counts emitted tokens (``serving.tokens``) — every step path
+        funnels through here, so the throughput metric is
+        structurally complete."""
         out = {}
         for lane, st in enumerate(self._lane_state):
             if st is None or st.done:
@@ -166,6 +172,9 @@ class _LaneEngine:
                     st.done = True
                     break
             out[lane] = emitted
+        if obs.active() is not None:
+            obs.count("serving.tokens",
+                      sum(len(v) for v in out.values()))
         return out
 
     # ----------------------------------------------- admission control
@@ -197,12 +206,25 @@ class _LaneEngine:
 
     def _check_open(self) -> None:
         if self._closed and not self._admitting:
+            obs.count("serving.rejected", reason="closed")
             raise EngineClosed(
                 "engine is shutting down (begin_shutdown was called); "
                 "no new requests are admitted during drain")
 
+    def _obs_request_done(self, status: str, born) -> None:
+        """Terminal-request telemetry: status counter, deadline-miss
+        counter, and the request latency histogram (engine clock, so
+        chaos tests with an injected clock stay deterministic)."""
+        obs.count("serving.requests", status=status)
+        if status == "timeout":
+            obs.count("serving.deadline_misses")
+        if born is not None and obs.active() is not None:
+            obs.observe("serving.request_s", self._clock() - born,
+                        status=status)
+
     def _finish(self, rid: int, tokens, status: str, prompt_len: int,
-                error: str | None = None):
+                error: str | None = None, born=None):
+        self._obs_request_done(status, born)
         self._completed[rid] = RequestResult(
             request_id=rid, tokens=np.asarray(tokens, np.int32),
             status=status, prompt_len=prompt_len, error=error)
@@ -219,7 +241,8 @@ class _LaneEngine:
         if not self._admitting:
             rid = self._next_id
             self._next_id += 1
-            self._finish(rid, prompt, "timeout", p)
+            self._finish(rid, prompt, "timeout", p,
+                         born=self._clock())
             self.last_request_id = rid
         return True
 
@@ -236,6 +259,7 @@ class _LaneEngine:
         """Engine-full decline: no request was registered, so a stale
         ``last_request_id`` must not masquerade as this request's."""
         if not self._admitting:
+            obs.count("serving.rejected", reason="no_free_lane")
             self.last_request_id = None
 
     def enqueue(self, prompt, max_new_tokens: int, ttl=None, deadline=None,
@@ -268,9 +292,14 @@ class _LaneEngine:
         rid = self._next_id
         self._next_id += 1
         if dl is not None and dl <= self._clock():
-            self._finish(rid, prompt, "timeout", prompt.size)
+            # born=now: a ~0s latency observation, so the request_s
+            # histogram count agrees with the requests counter (the
+            # deadline-miss population must not vanish from it).
+            self._finish(rid, prompt, "timeout", prompt.size,
+                         born=self._clock())
             return rid
-        pend = _Pending(rid, prompt, int(max_new_tokens), dl, submit_kw)
+        pend = _Pending(rid, prompt, int(max_new_tokens), dl, submit_kw,
+                        born=self._clock())
         # FIFO: queued requests get first claim on any free lane (and
         # expired heads are dropped) before this one may jump in.
         self.pump()
@@ -281,14 +310,17 @@ class _LaneEngine:
                 return rid
             # A lane was free, so the only way submit declined is the
             # deadline expiring between our check and its re-check.
-            self._finish(rid, prompt, "timeout", prompt.size)
+            self._finish(rid, prompt, "timeout", prompt.size,
+                         born=pend.born)
             return rid
         if len(self._pending) >= self.max_queue:
+            obs.count("serving.rejected", reason="queue_full")
             raise QueueFull(
                 f"all {self.lanes} lanes busy and the admission queue "
                 f"holds {len(self._pending)}/{self.max_queue} requests; "
                 "shed load or raise max_queue")
         self._pending.append(pend)
+        obs.gauge("serving.queue_depth", len(self._pending))
         return rid
 
     def _admit_pending(self, pend) -> bool:
@@ -305,6 +337,12 @@ class _LaneEngine:
         # caller holds (ids stay unique — the fresh one is just unused).
         st.request_id = pend.request_id
         st.managed = True
+        if pend.born is not None:
+            # Request latency counts from enqueue, queue wait included.
+            st.born = pend.born
+            if obs.active() is not None:
+                obs.observe("serving.queue_wait_s",
+                            self._clock() - pend.born)
         return True
 
     def pump(self) -> list[int]:
@@ -319,7 +357,7 @@ class _LaneEngine:
                     and pend.deadline <= self._clock()):
                 self._pending.popleft()
                 self._finish(pend.request_id, pend.prompt, "timeout",
-                             pend.prompt.size)
+                             pend.prompt.size, born=pend.born)
                 continue
             if not self.free_lanes():
                 break
@@ -332,7 +370,8 @@ class _LaneEngine:
                 # at admission: the request must still reach a terminal
                 # structured result, not crash the decode loop.
                 self._finish(pend.request_id, pend.prompt, "error",
-                             pend.prompt.size, error=str(e))
+                             pend.prompt.size, error=str(e),
+                             born=pend.born)
                 continue
             if ok:
                 admitted.append(pend.request_id)
@@ -340,7 +379,11 @@ class _LaneEngine:
                 # Free lane + declined admission == the deadline
                 # expired between pump's check and submit's re-check.
                 self._finish(pend.request_id, pend.prompt, "timeout",
-                             pend.prompt.size)
+                             pend.prompt.size, born=pend.born)
+        # Unconditionally: expired-head drops shrink the queue without
+        # admitting anything, and the gauge must not report phantom
+        # backlog (no-op when telemetry is disabled).
+        obs.gauge("serving.queue_depth", len(self._pending))
         return admitted
 
     def _reap(self) -> None:
@@ -355,7 +398,7 @@ class _LaneEngine:
             if st.done:
                 if st.managed:
                     self._finish(st.request_id, st.tokens, "ok",
-                                 st.prompt_len)
+                                 st.prompt_len, born=st.born)
                     self._lane_state[lane] = None
                 continue
             if st.deadline is not None:
@@ -363,7 +406,7 @@ class _LaneEngine:
                     now = self._clock()
                 if st.deadline <= now:
                     self._finish(st.request_id, st.tokens, "timeout",
-                                 st.prompt_len)
+                                 st.prompt_len, born=st.born)
                     self._lane_state[lane] = None
 
     # ------------------------------------------------------- results
@@ -424,12 +467,13 @@ class _LaneEngine:
             steps += 1
         for pend in self._pending:
             self._finish(pend.request_id, pend.prompt, "cancelled",
-                         pend.prompt.size)
+                         pend.prompt.size, born=pend.born)
         self._pending.clear()
+        obs.gauge("serving.queue_depth", 0)
         for lane, st in enumerate(self._lane_state):
             if st is not None and not st.done:
                 self._finish(st.request_id, st.tokens, "cancelled",
-                             st.prompt_len)
+                             st.prompt_len, born=st.born)
                 self._lane_state[lane] = None
         return self.results()
 
@@ -811,8 +855,9 @@ class ContinuousBatcher(_LaneEngine):
             width = next(w for w in self._buckets if w >= warm)
             rows = np.zeros((1, width), np.int32)
             rows[0, :warm] = prompt[:-1]
-            self.cache = self._admit(
-                self.cache, jnp.asarray(rows), jnp.int32(lane))
+            with obs.span("serving.admit", bucket=width):
+                self.cache = self._admit(
+                    self.cache, jnp.asarray(rows), jnp.int32(lane))
         elif self._prefix_lane is not None:
             # 1-token prompt: no admission chunk runs, but the lane
             # still needs the shared prefix's K/V (code-review
@@ -836,7 +881,7 @@ class ContinuousBatcher(_LaneEngine):
             request_id=self._admitted_id(), prompt_len=p,
             max_new=max_new_tokens, key=key, tokens=list(prompt),
             eos=self.eos_token if eos_token is None else eos_token,
-            deadline=dl)
+            deadline=dl, born=self._clock())
         return lane
 
     def traced_for_analysis(self):
@@ -877,12 +922,15 @@ class ContinuousBatcher(_LaneEngine):
         if all(s is None or s.done for s in self._lane_state):
             return {}
         chaos.probe("serving.step")
+        if obs.active() is not None:  # running() is O(lanes)
+            obs.gauge("serving.lanes_busy", len(self.running()))
         if n not in self._steps:
             self._steps[n] = self._make_step(n)
-        self.cache, self.cur, self.pos, toks = self._steps[n](
-            self.cache, self.cur, self.pos, self.keys,
-            self.temps, self.tps, self.mps)
-        toks = np.asarray(toks)
+        with obs.span("serving.step", n=n):
+            self.cache, self.cur, self.pos, toks = self._steps[n](
+                self.cache, self.cur, self.pos, self.keys,
+                self.temps, self.tps, self.mps)
+            toks = np.asarray(toks)
         out = self._emit(lambda lane: toks[lane].tolist())
         # Deadline granularity is one step window: tokens emitted in
         # the window that straddles the deadline are kept in the
@@ -1160,10 +1208,11 @@ class SpeculativeBatcher(_LaneEngine):
             rows = np.zeros((1, width), np.int32)
             rows[0, :warm] = prompt[:-1]
             rows_j = jnp.asarray(rows)
-            self.tcache = self._admit_t(self.tcache, rows_j,
-                                        jnp.int32(lane))
-            self.dcache = self._admit_d(self.dcache, rows_j,
-                                        jnp.int32(lane))
+            with obs.span("serving.admit", bucket=width):
+                self.tcache = self._admit_t(self.tcache, rows_j,
+                                            jnp.int32(lane))
+                self.dcache = self._admit_d(self.dcache, rows_j,
+                                            jnp.int32(lane))
         # else: stale slots stay masked until overwritten.
         self.pos = self.pos.at[lane].set(p - 1)
         self.cur = self.cur.at[lane].set(int(prompt[-1]))
@@ -1176,7 +1225,7 @@ class SpeculativeBatcher(_LaneEngine):
             request_id=self._admitted_id(), prompt_len=p,
             max_new=max_new_tokens, key=key, tokens=list(prompt),
             eos=self.eos_token if eos_token is None else eos_token,
-            deadline=dl)
+            deadline=dl, born=self._clock())
         return lane
 
     # ------------------------------------------------- degraded mode
@@ -1191,6 +1240,10 @@ class SpeculativeBatcher(_LaneEngine):
         (see the constructor's degradation note).  Called automatically
         when the draft half of a step faults; callable directly by an
         operator who knows the draft model is bad."""
+        if not self._degraded:
+            obs.count("serving.degraded")
+            obs.event("serving.degraded",
+                      error=None if error is None else repr(error))
         self._degraded = True
         if error is not None and self.degraded_error is None:
             self.degraded_error = error
@@ -1242,26 +1295,37 @@ class SpeculativeBatcher(_LaneEngine):
         if all(s is None or s.done for s in self._lane_state):
             return {}
         chaos.probe("serving.step")
+        live = () if obs.active() is None else self.running()
+        obs.gauge("serving.lanes_busy", len(live))
         if not self._degraded:
             try:
                 chaos.probe("serving.draft")
-                (tcache, dcache, prev, cur, pos, iters, win,
-                 adv) = self._step(
-                    self.tcache, self.dcache, self.prev, self.cur,
-                    self.pos, self.keys, self.iters)
-                # Force async dispatch errors to surface INSIDE the
-                # try, before the engine state is rebound: a fault
-                # arriving here finds self.* still naming the donated
-                # (now consumed) inputs, and _note_draft_fault reports
-                # the unrecoverable case with a clear error instead of
-                # leaving poisoned state behind.
-                win, adv = np.asarray(win), np.asarray(adv)
+                with obs.span("serving.step", speculative=True):
+                    (tcache, dcache, prev, cur, pos, iters, win,
+                     adv) = self._step(
+                        self.tcache, self.dcache, self.prev, self.cur,
+                        self.pos, self.keys, self.iters)
+                    # Force async dispatch errors to surface INSIDE the
+                    # try, before the engine state is rebound: a fault
+                    # arriving here finds self.* still naming the donated
+                    # (now consumed) inputs, and _note_draft_fault reports
+                    # the unrecoverable case with a clear error instead of
+                    # leaving poisoned state behind.
+                    win, adv = np.asarray(win), np.asarray(adv)
             except Exception as e:  # noqa: BLE001 — degrade, not die
                 self._note_draft_fault(e)
             else:
                 (self.tcache, self.dcache, self.prev, self.cur,
                  self.pos, self.iters) = (tcache, dcache, prev, cur,
                                           pos, iters)
+                if obs.active() is not None:
+                    # Speculative accept rate, host-visible for free:
+                    # each live lane advanced accepted + 1 positions.
+                    accepted = int(sum(max(int(adv[l]) - 1, 0)
+                                       for l in live))
+                    obs.count("serving.spec.proposed",
+                              self.n_draft * len(live))
+                    obs.count("serving.spec.accepted", accepted)
                 out = self._emit(
                     lambda lane: win[lane, :adv[lane]].tolist())
                 self._reap()
@@ -1269,9 +1333,10 @@ class SpeculativeBatcher(_LaneEngine):
         # Degraded: plain target decode — requests still complete.
         if self._fallback is None:
             self._fallback = self._make_fallback()
-        self.tcache, self.cur, self.pos, nxt, adv = self._fallback(
-            self.tcache, self.cur, self.pos, self.keys)
-        nxt, adv = np.asarray(nxt), np.asarray(adv)
+        with obs.span("serving.step", speculative=False):
+            self.tcache, self.cur, self.pos, nxt, adv = self._fallback(
+                self.tcache, self.cur, self.pos, self.keys)
+            nxt, adv = np.asarray(nxt), np.asarray(adv)
         out = self._emit(
             lambda lane: [int(nxt[lane])] if adv[lane] else [])
         self._reap()

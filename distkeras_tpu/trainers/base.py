@@ -15,6 +15,7 @@ import time
 import jax
 import numpy as np
 
+from distkeras_tpu import obs
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.adapter import ModelAdapter
 from distkeras_tpu.resilience import chaos
@@ -99,7 +100,9 @@ class CheckpointingBase:
         step = self._ckpt.latest_step()
         if step is None:
             return pytree, 0
-        return self._ckpt.restore(pytree, step), step
+        with obs.span("checkpoint.restore", step=step):
+            restored = self._ckpt.restore(pytree, step)
+        return restored, step
 
     def _checkpoint(self, pytree, round_idx: int, final: bool = False) -> None:
         """Persist training state after round ``round_idx`` (1-based).
@@ -116,9 +119,13 @@ class CheckpointingBase:
             # from here bit-for-bit — data order is round-indexed and
             # every RNG stream is keyed on the round counter.
             if self._ckpt is not None and round_idx != self._last_saved_round:
-                self._ckpt.save(pytree, round_idx, force=True)
-                self._ckpt.wait_until_finished()
+                with obs.span("checkpoint.save", step=round_idx,
+                              preempt=True):
+                    self._ckpt.save(pytree, round_idx, force=True)
+                    self._ckpt.wait_until_finished()
                 self._last_saved_round = round_idx
+            obs.event("train.preempted", round=round_idx,
+                      checkpointed=self._ckpt is not None)
             raise Preempted(
                 f"preempted at round {round_idx}"
                 + (" (state checkpointed)" if self._ckpt is not None
@@ -127,9 +134,30 @@ class CheckpointingBase:
             return  # (final save right after a periodic one: already durable)
         periodic = self.checkpoint_every and round_idx % self.checkpoint_every == 0
         if final or periodic:
-            self._ckpt.save(pytree, round_idx, force=True)
-            self._ckpt.wait_until_finished()
+            with obs.span("checkpoint.save", step=round_idx):
+                self._ckpt.save(pytree, round_idx, force=True)
+                self._ckpt.wait_until_finished()
             self._last_saved_round = round_idx
+
+    def _record_run_metrics(self) -> None:
+        """End-of-run telemetry (obs, docs/observability.md): loss and
+        timing gauges from state the run already computed host-side —
+        never a per-step device sync, never an extra compiled program
+        (the zero-overhead contract the obs smoke test pins)."""
+        if obs.active() is None:
+            return
+        name = type(self).__name__
+        obs.gauge("train.training_time_s", self.training_time,
+                  trainer=name)
+        hist = getattr(self, "history", None)
+        if hist:
+            obs.gauge("train.loss", hist[-1], trainer=name)
+            obs.gauge("train.loss_mean", sum(hist) / len(hist),
+                      trainer=name)
+            obs.count("train.rounds", len(hist), trainer=name)
+        for phase, st in self.step_timer.phase_stats().items():
+            obs.gauge("train.phase_total_s", st["total_s"],
+                      trainer=name, phase=phase)
 
 
 class Trainer(CheckpointingBase):
@@ -211,15 +239,21 @@ class Trainer(CheckpointingBase):
         elif self.eval_every:
             raise ValueError(
                 "eval_every is set but train() got no eval_dataset")
+        # Per-run observability: phase stats describe THIS run only
+        # (explicit reset — reuse across train() calls must not blend
+        # runs), and the whole run is one obs span.
+        self.step_timer.reset()
         t0 = time.perf_counter()
         self._open_checkpoints()
         try:
-            state = self._fit(dataset)
-            self._eval_hook(state, rnd=None, final=True)
-            jax.block_until_ready(state.tv)
+            with obs.span("train.run", trainer=type(self).__name__):
+                state = self._fit(dataset)
+                self._eval_hook(state, rnd=None, final=True)
+                jax.block_until_ready(state.tv)
         finally:
             self._close_checkpoints()
         self.training_time = time.perf_counter() - t0
+        self._record_run_metrics()
         return self._export(state)
 
     # -- evaluation hook ---------------------------------------------------

@@ -29,6 +29,7 @@ import optax
 from distkeras_tpu.parallel.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distkeras_tpu import obs
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models import transformer as tfm
 from distkeras_tpu.parallel.mesh import (AXES, make_mesh,
@@ -330,14 +331,74 @@ class LMTrainer(CheckpointingBase):
             self._fwd_kw = {"attention_fn": ring}
         else:
             self._fwd_kw = {}
+        # Replicated-DP (pure data mesh, replicated params): build the
+        # gradient inside a shard_map so the tied embedding's two
+        # cotangent contributions (lookup scatter + unembed dot) are
+        # summed LOCALLY before one explicit per-leaf pmean — the
+        # compiler-inserted exchange otherwise all-reduces them
+        # separately (the graph lint's `comm-redundant-ar` finding:
+        # 2x the embedding bytes on the wire every step).  Scoped to
+        # exactly the configs where the exchange is the plain gradient
+        # all-reduce: any sharded-param/sharded-update plan (fsdp,
+        # zero1, TP/SP/PP axes) and MoE keep the compiler-inserted
+        # collectives.
+        dp_local_grads = (n_model == 1 and n_seq == 1 and n_pipe == 1
+                          and int(self.mesh.shape["expert"]) == 1
+                          and not fsdp and not zero1
+                          and not cfg.num_experts)
+        self._vag = (self._dp_local_value_and_grad() if dp_local_grads
+                     else None)
         # _fwd_kw captures the mesh-specific forward once; the step and
         # eval builders (and LoRATrainer's overrides) share it.
         self._step_builder = lambda opt: tfm.make_train_step(
-            cfg, opt, grad_accum=grad_accum, **self._fwd_kw)
+            cfg, opt, grad_accum=grad_accum,
+            value_and_grad=self._vag, **self._fwd_kw)
         self._nll_fn = lambda p, t, seg=None: tfm.lm_nll(
             p, t, cfg,
             segment_ids=seg,
             **self._fwd_kw)
+
+    def _dp_local_value_and_grad(self):
+        """``jax.value_and_grad`` replacement for the replicated-DP
+        configuration (see __init__): gradients are computed per
+        replica inside a ``shard_map`` over the ``data`` axis — so
+        autodiff's add of the tied embedding's two contributions is a
+        LOCAL op — and exchanged with ONE explicit ``pmean`` per leaf.
+        Identical math to the compiler-inserted all-reduce (the global
+        batch mean's gradient is the mean of equal-sized shard
+        gradients), at exactly parameter-bytes of all-reduce payload.
+
+        Dropout and packed-segment runs fall back to the compiler-
+        inserted exchange at trace time: the dropout mask stream and
+        the valid-target count are *global-batch* quantities that a
+        replica-local loss would compute differently.
+        """
+        mesh = self.mesh
+
+        def value_and_grad(loss):
+            vag = jax.value_and_grad(loss)
+
+            def wrapped(params, tokens, cfg, attention_fn, apply_fn,
+                        rng, hidden_fn, segment_ids=None):
+                if rng is not None or segment_ids is not None:
+                    return vag(params, tokens, cfg, attention_fn,
+                               apply_fn, rng, hidden_fn, segment_ids)
+
+                def local_grads(p, t):
+                    l, g = vag(p, t, cfg, attention_fn, apply_fn,
+                               None, hidden_fn, None)
+                    def pm(x):
+                        return jax.lax.pmean(x, "data")
+                    return pm(l), jax.tree.map(pm, g)
+
+                return shard_map(local_grads, mesh=mesh,
+                                 in_specs=(P(), P("data", None)),
+                                 out_specs=(P(), P()),
+                                 check_vma=False)(params, tokens)
+
+            return wrapped
+
+        return value_and_grad
 
     # ------------------------------------------------------------------
 
@@ -676,6 +737,8 @@ class LMTrainer(CheckpointingBase):
                     f"eval_tokens has {len(eval_tokens)} rows; one eval "
                     f"batch needs {global_bs // n_proc} per process")
 
+        # Per-run phase stats (and obs spans) describe THIS run only.
+        self.step_timer.reset()
         t0 = time.perf_counter()
         # Fail fast on a bad checkpoint_dir before paying parameter
         # init and mesh placement.
@@ -887,6 +950,7 @@ class LMTrainer(CheckpointingBase):
         jax.block_until_ready(jax.tree.leaves(params)[0])
         self.history = [float(l) for l in losses]
         self.training_time = time.perf_counter() - t0
+        self._record_run_metrics()
         return params
 
 
@@ -944,6 +1008,13 @@ class LoRATrainer(LMTrainer):
         self.adapters = None
         loss_fn = make_lora_loss(cfg, self.lora)
         fwd_kw = self._fwd_kw
+        # Deliberately WITHOUT the parent's value_and_grad hook
+        # (_dp_local_value_and_grad): the tied-embedding redundancy it
+        # fixes cannot occur here — the base (embedding included) is
+        # stop-gradiented, so its cotangent is a symbolic zero with no
+        # all-reduce at all — while the shard_map path's per-leaf
+        # pmean would ADD explicit collectives over the base-sized
+        # zero gradient leaves the compiler currently elides.
         self._step_builder = lambda opt: tfm.make_train_step(
             cfg, opt, grad_accum=self.grad_accum, loss_fn=loss_fn,
             **fwd_kw)

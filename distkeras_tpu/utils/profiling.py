@@ -22,6 +22,8 @@ import time
 
 import jax
 
+from distkeras_tpu import obs
+
 
 @contextlib.contextmanager
 def trace(logdir: str):
@@ -71,22 +73,51 @@ class StepTimer:
     ``zero1/all_gather``) so :func:`trace` profiles show the overlap,
     and ``scripts/bench_suite.py zero1_update`` measures the update
     phase as a number.
+
+    The timer is also the **span frontend of the obs subsystem**
+    (``distkeras_tpu.obs``, docs/observability.md): with a telemetry
+    session active, every ``phase`` block is recorded as a trace span
+    ``{scope}.{name}`` and every closed round as a ``{scope}.round``
+    event, so a whole run's phase timeline reconstructs offline via
+    ``scripts/obs_report.py``.  Disabled (the default), the obs hooks
+    are a module-attr ``is None`` check — the timer stays hot-loop
+    cheap either way.
+
+    State persists across rounds but NOT across runs: call
+    :meth:`reset` at the start of each run (the trainers do, at the
+    top of every ``train()``), so ``phase_stats`` always describes the
+    run just measured instead of silently accumulating across
+    ``train()`` calls.
     """
 
-    def __init__(self):
+    def __init__(self, scope: str = "train"):
+        self.scope = scope
         self.rounds: list[tuple[float, int]] = []  # (seconds, n_steps)
         self.phases: dict[str, tuple[float, int]] = {}  # name -> (s, calls)
         self._t0: float | None = None
+        self._n = 0
+
+    def reset(self) -> None:
+        """Drop all recorded rounds and phase stats (fresh run).  Any
+        open round is abandoned, not recorded."""
+        self.rounds = []
+        self.phases = {}
+        self._t0 = None
         self._n = 0
 
     @contextlib.contextmanager
     def phase(self, name: str):
         """Accumulate host wall time under ``name`` (re-entrant safe to
         nest *different* names; never syncs the device — wrap dispatch
-        sites, then ``finalize`` closes the round with one barrier)."""
+        sites, then ``finalize`` closes the round with one barrier).
+        Doubles as an obs trace span when telemetry is enabled."""
         t0 = time.perf_counter()
         try:
-            yield self
+            if obs.active() is None:  # keep the disabled path
+                yield self           # allocation-free (no f-string)
+            else:
+                with obs.span(f"{self.scope}.{name}"):
+                    yield self
         finally:
             dt = time.perf_counter() - t0
             s, c = self.phases.get(name, (0.0, 0))
@@ -117,7 +148,9 @@ class StepTimer:
         if sync_refs:
             jax.block_until_ready(sync_refs)
         if self._t0 is not None:
-            self.rounds.append((time.perf_counter() - self._t0, self._n))
+            dur = time.perf_counter() - self._t0
+            self.rounds.append((dur, self._n))
+            obs.event(f"{self.scope}.round", dur_s=dur, n_steps=self._n)
             self._t0 = None
             self._n = 0
 

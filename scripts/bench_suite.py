@@ -834,16 +834,26 @@ def main(names):
     if unknown:
         sys.exit(f"unknown config(s) {sorted(unknown)}; "
                  f"choose from {sorted(BENCHES)}")
+    from distkeras_tpu import obs
+
     print(f"# backend={jax.default_backend()} device={jax.devices()[0]}",
           file=sys.stderr)
     peak = peak_flops()
     for name in names or BENCHES:
         fn, unit = BENCHES[name]
+        # Each config runs under its own obs session (metrics only, no
+        # trace file) so the result line carries its telemetry — h2d
+        # bytes, prefetch occupancy, zero1 bucket geometry, serving
+        # counters — and a perf regression ships its own evidence.
+        sess = obs.enable()
         try:
             out = fn()
         except Exception as e:  # keep the suite going; record the failure
             print(json.dumps({"metric": name, "error": repr(e)[:200]}))
             continue
+        finally:
+            snapshot = sess.registry.compact()
+            obs.disable()
         rate, step_s, step_flops = out[:3]
         extra = out[3] if len(out) > 3 else {}
         line = {
@@ -854,6 +864,8 @@ def main(names):
         }
         if peak and step_flops:
             line["mfu"] = round(step_flops / step_s / peak, 4)
+        if snapshot:
+            line["obs"] = snapshot
         print(json.dumps(line))
         if jax.default_backend() == "tpu":
             update_last_green(line,

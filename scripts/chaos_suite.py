@@ -17,7 +17,15 @@ the subsystem claims to survive (docs/resilience.md):
   (greedy: exact solo-generate parity);
 - drain-then-shutdown -> no request is silently dropped.
 
+The whole matrix runs under an obs telemetry session
+(docs/observability.md): every injected fault, Supervisor attempt and
+backoff lands in a JSONL event trace, and the suite ends with a
+machine-readable **fault/recovery timeline** (one JSON object per
+line) reconstructed from that trace — no log parsing.  ``--trace``
+keeps the trace file for ``scripts/obs_report.py``.
+
 Usage: python scripts/chaos_suite.py [--seed N] [--kill-rounds 3,7,12]
+                                     [--trace chaos.jsonl]
 """
 
 import argparse
@@ -164,6 +172,9 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--kill-rounds", default="3,7,12",
                     help="comma-separated rounds for the kill matrix")
+    ap.add_argument("--trace", default=None,
+                    help="write the obs event trace here (default: a "
+                         "temp file, deleted after the timeline prints)")
     args = ap.parse_args()
     kills = [int(r) for r in args.kill_rounds.split(",")]
 
@@ -182,16 +193,47 @@ def main():
             args.seed)),
     ]
 
+    import json
+
+    from distkeras_tpu import obs
+    from distkeras_tpu.obs.trace import read_trace
+
+    trace_path = args.trace or os.path.join(
+        tempfile.mkdtemp(prefix="chaos_obs_"), "chaos.jsonl")
     failures = 0
-    for name, fn in matrix:
-        try:
-            fn()
-            print(f"  PASS  {name}")
-        except Exception as e:  # noqa: BLE001 — report the whole matrix
-            failures += 1
-            print(f"  FAIL  {name}: {type(e).__name__}: {e}")
-        assert chaos.active_plan() is None, "a FaultPlan leaked"
+    with obs.session(trace_path=trace_path):
+        for name, fn in matrix:
+            obs.event("chaos_suite.check", check=name, status="start")
+            try:
+                fn()
+                print(f"  PASS  {name}")
+                obs.event("chaos_suite.check", check=name, status="pass")
+            except Exception as e:  # noqa: BLE001 — report the matrix
+                failures += 1
+                print(f"  FAIL  {name}: {type(e).__name__}: {e}")
+                obs.event("chaos_suite.check", check=name,
+                          status="fail", error=repr(e)[:200])
+            assert chaos.active_plan() is None, "a FaultPlan leaked"
     print(f"{len(matrix) - failures}/{len(matrix)} chaos checks passed")
+
+    # Machine-readable fault/recovery timeline, straight off the obs
+    # event trace: injected faults (chaos.fault), Supervisor attempts/
+    # backoffs (supervisor.*), preemption checkpoints and engine
+    # degradation — one JSON object per line, time-ordered.
+    records = [r for r in read_trace(trace_path)
+               if r.get("kind") == "event"]
+    t0 = min((r["t"] for r in records), default=0.0)
+    print("--- fault/recovery timeline (JSONL) ---")
+    for r in sorted(records, key=lambda r: r["t"]):
+        print(json.dumps({"t": round(r["t"] - t0, 4),
+                          "event": r["name"], **r.get("fields", {})}))
+    if args.trace:
+        print(f"--- obs trace kept at {args.trace} "
+              "(render: scripts/obs_report.py) ---")
+    else:
+        import shutil
+
+        shutil.rmtree(os.path.dirname(trace_path), ignore_errors=True)
     return 1 if failures else 0
 
 

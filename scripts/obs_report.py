@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Render an obs trace (JSONL) as a human-readable run report.
+
+Phase breakdown (span totals/means/percentiles and share of the run's
+wall span), latency histograms (bucket-interpolated p50/p95/p99),
+counters/gauges, and the point-event timeline (chaos faults,
+supervisor attempts, admission rejects) — reconstructed entirely from
+one trace file written by ``distkeras_tpu.obs`` (docs/observability.md).
+
+Usage::
+
+    python scripts/obs_report.py run.jsonl
+    python scripts/obs_report.py new.jsonl --compare base.jsonl
+    python scripts/obs_report.py run.jsonl --json   # the report dict
+
+``--compare BASE`` prints a regression diff of NEW (the positional
+trace) against BASE instead of the full report — per-phase total/mean
+deltas, latency percentile deltas, counter drift.
+
+Pure host-side file parsing: no jax import, safe anywhere.
+"""
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_report_module():
+    """Import distkeras_tpu.obs.report WITHOUT executing the package
+    root's ``__init__`` (which imports jax/keras and the whole
+    framework): register stub parent packages whose ``__path__``
+    points at the real directories, then import the stdlib-only obs
+    submodules through them.  Keeps this script runnable on a host
+    with no jax installed — it only parses JSONL files."""
+    for name, path in (
+            ("distkeras_tpu", os.path.join(REPO, "distkeras_tpu")),
+            ("distkeras_tpu.obs",
+             os.path.join(REPO, "distkeras_tpu", "obs"))):
+        if name not in sys.modules:
+            mod = types.ModuleType(name)
+            mod.__path__ = [path]
+            sys.modules[name] = mod
+    return importlib.import_module("distkeras_tpu.obs.report")
+
+
+def main(argv):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="obs JSONL trace to report on")
+    ap.add_argument("--compare", metavar="BASE",
+                    help="diff TRACE against this earlier trace "
+                         "instead of printing the full report")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    ap.add_argument("--max-events", type=int, default=60,
+                    help="timeline rows to print (default 60)")
+    args = ap.parse_args(argv)
+
+    report = _load_report_module()
+
+    rep = report.load_report(args.trace)
+    if args.compare:
+        base = report.load_report(args.compare)
+        if args.json:
+            print(json.dumps({"base": base, "new": rep}, indent=1,
+                             default=str))
+        else:
+            print(report.render_compare(base, rep))
+        return 0
+    if args.json:
+        print(json.dumps(rep, indent=1, default=str))
+    else:
+        print(report.render_report(rep, max_events=args.max_events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

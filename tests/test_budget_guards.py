@@ -84,16 +84,19 @@ def test_declared_exchange_measures_param_bytes(linted):
         assert decl["rs_bytes"] == decl["ag_bytes"] == spec.params_bytes
 
 
-def test_lm_dp_tied_embedding_redundancy_is_surfaced(linted):
-    """The parity machinery's side discovery, pinned so it stays
-    visible: replicated-DP LM compiles a redundant all-reduce for the
-    tied embedding's two gradient contributions (reported as info,
-    non-gating)."""
+def test_lm_dp_tied_embedding_grads_summed_before_exchange(linted):
+    """PR 3's parity machinery discovered replicated-DP LM all-reduced
+    the tied embedding's two gradient contributions separately (8 KiB
+    per step redundant); PR 4 sums them locally before ONE pmean per
+    leaf (LMTrainer._dp_local_value_and_grad).  Pinned: the DP census
+    carries exactly parameter-bytes of gradient all-reduce and the
+    `comm-redundant-ar` rule — now promoted to warn, so a regression
+    gates — stays silent."""
     spec = linted["lmtrainer_zero1/train_step"][0]
     findings = ir_lint.check_zero1_parity(
         spec, linted["lmtrainer_dp/train_step"][2])
-    assert any(f.rule == "comm-redundant-ar" and not f.gating
-               for f in findings)
+    assert not any(f.rule == "comm-redundant-ar" for f in findings)
+    assert not [f.format() for f in findings if f.gating]
 
 
 def test_serving_steps_have_no_collectives(linted):

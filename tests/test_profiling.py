@@ -48,6 +48,44 @@ def test_step_timer_named_phases():
         stats["step"]["total_s"] / 3)
 
 
+def test_step_timer_reset_is_explicit():
+    """Regression (PR 4 satellite): stats must not silently blend
+    across runs — reset() clears rounds AND phases, and abandons an
+    open round instead of recording it."""
+    timer = StepTimer()
+    with timer.phase("h2d"):
+        pass
+    with timer.round(4):
+        pass
+    timer.finalize()
+    assert timer.total_steps == 4 and timer.phases
+    with timer.round(2):  # left open on purpose
+        timer.reset()
+    assert timer.rounds == [] and timer.phases == {}
+    assert timer.total_steps == 0 and timer.total_s == 0.0
+    timer.finalize()  # the abandoned round must not resurface
+    assert timer.rounds == []
+
+
+def test_trainer_resets_timer_per_run():
+    """Two train() calls on one trainer: phase stats describe the
+    SECOND run only (the trainers call timer.reset() at train())."""
+    import distkeras_tpu as dk
+    from helpers import make_blobs, make_mlp
+
+    feats, labels = make_blobs(n=128)
+    ds = dk.Dataset({"features": feats, "label": labels})
+    t = dk.ADAG(make_mlp(), loss="sparse_categorical_crossentropy",
+                worker_optimizer="sgd", learning_rate=0.05, batch_size=4,
+                num_epoch=1, communication_window=2)
+    t.train(ds)
+    rounds_per_run = len(t.history)
+    first = t.step_timer.phase_stats()["step"]["calls"]
+    t.train(ds)
+    again = t.step_timer.phase_stats()["step"]["calls"]
+    assert first == again == rounds_per_run, (first, again)
+
+
 def test_trainer_populates_phase_counters():
     """A distributed trainer run leaves "h2d"/"step" populated — the
     input plane is distinguishable from compute without a profiler."""
