@@ -80,7 +80,8 @@ from distkeras_tpu.data.transformers import (
     DenseTransformer,
 )
 from distkeras_tpu.checkpoint import CheckpointManager
-from distkeras_tpu.resilience import (EngineClosed, FaultPlan, Preempted,
+from distkeras_tpu.resilience import (ClusterMember, ClusterSupervisor,
+                                       EngineClosed, FaultPlan, Preempted,
                                        QueueFull, RequestResult,
                                        Supervisor)
 from distkeras_tpu.serving import (ContinuousBatcher,
@@ -133,6 +134,8 @@ __all__ = [
     "DenseTransformer",
     "CheckpointManager",
     "EngineClosed",
+    "ClusterMember",
+    "ClusterSupervisor",
     "FaultPlan",
     "Preempted",
     "QueueFull",

@@ -124,6 +124,28 @@ class CheckpointManager:
     def all_steps(self) -> list[int]:
         return sorted(self._impl.all_steps())
 
+    def valid_steps(self) -> list[int]:
+        """Committed steps that pass the cheap integrity check
+        (:func:`distkeras_tpu.resilience.cluster.step_is_valid`): a
+        host that died mid-save on a filesystem without atomic rename
+        can leave a torn step directory that lists as committed but
+        cannot be restored.  The cluster-consistent resume rule and the
+        trainers' restore validation both select from THIS set, not
+        ``all_steps``.  Delegates to the cluster-resilience scan so
+        per-host resume and cluster-consistent selection share ONE
+        validity rule."""
+        from distkeras_tpu.resilience.cluster import valid_steps
+
+        return valid_steps(self.directory)
+
+    def latest_valid_step(self) -> int | None:
+        """Newest valid step — scans newest-first and stops at the
+        first step that passes, so the common case (intact latest)
+        validates one payload instead of the whole history."""
+        from distkeras_tpu.resilience.cluster import latest_valid_step
+
+        return latest_valid_step(self.directory)
+
     def wait_until_finished(self) -> None:
         """Block until outstanding async saves hit disk."""
         self._impl.wait_until_finished()

@@ -102,6 +102,73 @@ def load_report(path: str) -> dict:
     return build_report(read_trace(path))
 
 
+# ------------------------------------------------------ multi-host merge
+
+
+def merge_traces(paths) -> dict:
+    """Merge per-host trace files into ONE cross-host event timeline.
+
+    Each trace's monotonic clock has its own epoch, so records are
+    aligned through the meta record's wall anchor (``time_unix`` taken
+    at the same instant as monotonic ``t``): ``wall = time_unix +
+    (t - meta.t)``.  Every event keeps its source run id and host, so
+    a coordinated-restart session — several runs per host, one file
+    per attempt — reads as one story: fault events on the dying host,
+    watchdog trips on the survivors, supervisor resumes in the next
+    epoch, in true wall order.  NTP caveat: cross-host ordering is as
+    good as the hosts' wall clocks (exact in the single-machine
+    harness).
+
+    Returns ``{"hosts": [...], "timeline": [...], "wall_s": float}``;
+    timeline entries are ``{"t", "host", "run", "name", "fields"}``
+    with ``t`` relative to the earliest event."""
+    runs = []
+    events = []
+    for path in paths:
+        records = read_trace(path)
+        meta = next((r for r in records if r.get("kind") == "meta"), {})
+        off = 0.0
+        if meta.get("time_unix") is not None and meta.get("t") is not None:
+            off = meta["time_unix"] - meta["t"]
+        host = meta.get("host", 0)
+        run = meta.get("run")
+        n = 0
+        for r in records:
+            if r.get("kind") != "event":
+                continue
+            events.append({"wall": r["t"] + off, "host": host,
+                           "run": run, "name": r["name"],
+                           "fields": r.get("fields", {})})
+            n += 1
+        runs.append({"path": path, "run": run, "host": host,
+                     "events": n, "pid": meta.get("pid")})
+    events.sort(key=lambda e: e["wall"])
+    t0 = events[0]["wall"] if events else 0.0
+    timeline = [{"t": e["wall"] - t0, "host": e["host"], "run": e["run"],
+                 "name": e["name"], "fields": e["fields"]}
+                for e in events]
+    wall = (events[-1]["wall"] - t0) if events else 0.0
+    return {"hosts": runs, "timeline": timeline, "wall_s": wall}
+
+
+def render_merged(rep: dict, max_events: int = 200) -> str:
+    out = [f"merged {len(rep['hosts'])} trace(s), "
+           f"wall {_fmt_s(rep['wall_s'])}"]
+    for h in rep["hosts"]:
+        out.append(f"  host {h['host']}  run {h['run']}  "
+                   f"{h['events']} event(s)  {h['path']}")
+    out.append("\n== cross-host event timeline ==")
+    shown = rep["timeline"][:max_events]
+    for e in shown:
+        fields = " ".join(f"{k}={v}" for k, v in e["fields"].items())
+        out.append(f"  +{e['t']:>9.4f}s  h{e['host']}  "
+                   f"{e['name']:<28}{fields}")
+    if len(rep["timeline"]) > len(shown):
+        out.append(f"  ... {len(rep['timeline']) - len(shown)} more "
+                   "event(s)")
+    return "\n".join(out)
+
+
 # ------------------------------------------------------------ rendering
 
 
@@ -224,4 +291,4 @@ def render_compare(base: dict, new: dict) -> str:
 
 
 __all__ = ["build_report", "load_report", "render_report",
-           "render_compare"]
+           "render_compare", "merge_traces", "render_merged"]

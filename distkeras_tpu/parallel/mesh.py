@@ -92,11 +92,28 @@ def initialize_multihost(coordinator_address: str | None = None,
     """
     if num_processes is None or num_processes <= 1:
         return
+    enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+def enable_cpu_collectives() -> None:
+    """Select the gloo backend for cross-process CPU collectives.
+
+    The pinned jax (0.4.37) ships multiprocess CPU support but does not
+    enable it by default — without this, any cross-process psum on the
+    CPU backend dies with "Multiprocess computations aren't implemented
+    on the CPU backend".  Must run BEFORE ``jax.distributed.initialize``.
+    Guarded: on accelerator backends the option is irrelevant, and a
+    future jax that renames or removes it must not break multihost
+    init on real hardware."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — option gone/renamed: proceed
+        pass
 
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:

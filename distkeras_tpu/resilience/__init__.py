@@ -1,29 +1,40 @@
-"""Resilience subsystem: fault injection, supervised training, and
-serving admission control.
+"""Resilience subsystem: fault injection, supervised training, cluster
+coordination, and serving admission control.
 
-Three layers (docs/resilience.md has the failure model):
+Five layers (docs/resilience.md has the failure model):
 
 - :mod:`~distkeras_tpu.resilience.chaos` — deterministic, seedable
   fault injection over named probe sites in the production code paths
   (checkpoint saves, training rounds, serving steps, the speculative
-  draft).
+  draft, cluster heartbeats).
 - :mod:`~distkeras_tpu.resilience.supervisor` — retry + backoff +
   verified auto-resume around any trainer's ``train``, with a SIGTERM
   preemption handler that forces a final synchronous checkpoint.
+- :mod:`~distkeras_tpu.resilience.health` — per-host heartbeats over a
+  shared directory plus the read-side staleness monitor.
+- :mod:`~distkeras_tpu.resilience.cluster` — cluster epochs, the
+  collective watchdog, per-host restart drivers, and cluster-consistent
+  checkpoint selection (coordinated multi-host restart).
 - :mod:`~distkeras_tpu.resilience.admission` — request deadlines,
   bounded-queue backpressure, and structured results for the serving
   engines (wired into :mod:`distkeras_tpu.serving`).
 """
 
-from distkeras_tpu.resilience import chaos
+from distkeras_tpu.resilience import chaos, cluster, health
 from distkeras_tpu.resilience.admission import (EngineClosed, QueueFull,
                                                  RequestResult)
 from distkeras_tpu.resilience.chaos import (FaultInjected, FaultPlan,
                                              Preempted)
+from distkeras_tpu.resilience.cluster import (ClusterMember,
+                                               ClusterSupervisor,
+                                               cluster_consistent_step)
+from distkeras_tpu.resilience.health import HealthMonitor, HeartbeatWriter
 from distkeras_tpu.resilience.supervisor import Attempt, Supervisor
 
 __all__ = [
     "chaos",
+    "cluster",
+    "health",
     "FaultPlan",
     "FaultInjected",
     "Preempted",
@@ -32,4 +43,9 @@ __all__ = [
     "RequestResult",
     "QueueFull",
     "EngineClosed",
+    "ClusterMember",
+    "ClusterSupervisor",
+    "cluster_consistent_step",
+    "HealthMonitor",
+    "HeartbeatWriter",
 ]

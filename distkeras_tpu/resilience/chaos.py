@@ -49,11 +49,18 @@ SITES = (
     "serving.step",     # ContinuousBatcher/SpeculativeBatcher.step
     "serving.admit",    # lane admission (submit/pump)
     "serving.draft",    # SpeculativeBatcher's draft half of the step
+    "cluster.heartbeat",  # HeartbeatWriter: before every beat publishes
 )
 
 
 class FaultInjected(RuntimeError):
     """Default error raised by an injected fault."""
+
+
+class BeatDropped(RuntimeError):
+    """Internal signal of a ``drop`` rule: the heartbeat writer catches
+    it and skips publishing the beat — the partition fault kind (host
+    alive, beats invisible to peers).  Never escapes the writer."""
 
 
 class Preempted(RuntimeError):
@@ -68,7 +75,7 @@ class Preempted(RuntimeError):
 @dataclasses.dataclass
 class _Rule:
     site: str
-    kind: str                      # "fail" | "delay" | "signal"
+    kind: str            # "fail" | "delay" | "signal" | "kill" | "drop"
     at: int | None = None          # fire when the probe's step/call == at
     times: int | None = 1          # firings remaining (None = unlimited)
     error: Callable[[str], BaseException] | None = None
@@ -127,12 +134,39 @@ class FaultPlan:
 
     def delay(self, site: str, seconds: float, at: int | None = None,
               times: int | None = None, p: float = 1.0) -> "FaultPlan":
-        """Sleep ``seconds`` at ``site`` (default: every probe)."""
+        """Sleep ``seconds`` at ``site`` (default: every probe).  On
+        ``cluster.heartbeat`` this is the **heartbeat-stall** fault
+        kind: the writer thread wedges mid-beat and peers see the host
+        go stale."""
         self._check_site(site)
         if seconds < 0:
             raise ValueError(f"seconds must be >= 0, got {seconds}")
         self._rules.append(_Rule(site, "delay", at=at, times=times,
                                  seconds=seconds, p=p))
+        return self
+
+    def kill(self, site: str, at: int | None = None,
+             rc: int = 137) -> "FaultPlan":
+        """**Host-kill** fault kind: ``os._exit(rc)`` at ``site`` — the
+        process dies instantly with no cleanup, no atexit, no final
+        checkpoint, exactly like SIGKILL/hardware loss.  The default rc
+        mirrors a SIGKILLed process (128 + 9).  Only meaningful in
+        multiprocess chaos runs (the cluster restart harness); a
+        single-process test that kills itself takes pytest with it."""
+        self._check_site(site)
+        self._rules.append(_Rule(site, "kill", at=at, times=1,
+                                 seconds=float(rc)))
+        return self
+
+    def drop(self, site: str = "cluster.heartbeat", at: int | None = None,
+             times: int | None = 1, p: float = 1.0) -> "FaultPlan":
+        """**Partition** fault kind: the probe site swallows the
+        operation instead of performing it.  On ``cluster.heartbeat``
+        the beat is silently not published — the host keeps running
+        (and keeps training) while its peers watch it go stale, which
+        is what a network partition looks like from the outside."""
+        self._check_site(site)
+        self._rules.append(_Rule(site, "drop", at=at, times=times, p=p))
         return self
 
     # ------------------------------------------------------------ firing
@@ -166,6 +200,14 @@ class FaultPlan:
                 time.sleep(rule.seconds)
             elif rule.kind == "signal":
                 _signal.raise_signal(_signal.SIGTERM)
+            elif rule.kind == "kill":
+                import os
+
+                # Hard host loss: flush what telemetry we can (the
+                # trace file is line-buffered) and die without cleanup.
+                os._exit(int(rule.seconds))
+            elif rule.kind == "drop":
+                raise BeatDropped(f"chaos: dropped {site} (step {n})")
             else:
                 raise rule.error(f"chaos: injected fault at {site} "
                                  f"(step {n})")

@@ -30,7 +30,6 @@ bookkeeping.
 from __future__ import annotations
 
 import dataclasses
-import os
 import random
 import signal
 import threading
@@ -81,7 +80,7 @@ class Supervisor:
                  jitter: float = 0.5, seed: int = 0,
                  handle_sigterm: bool = True,
                  retryable: tuple = (Exception,),
-                 sleep=time.sleep):
+                 sleep=None):
         if not getattr(trainer, "checkpoint_dir", None):
             raise ValueError(
                 "Supervisor needs a trainer with checkpoint_dir set — "
@@ -120,20 +119,34 @@ class Supervisor:
     # ------------------------------------------------------------ state
 
     def latest_step(self) -> int | None:
-        """Latest committed checkpoint step, backend-agnostic (both the
-        orbax and pickle backends commit a step by renaming an
-        integer-named directory into place)."""
+        """Latest committed AND valid checkpoint step, backend-agnostic
+        (both the orbax and pickle backends commit a step by renaming
+        an integer-named directory into place; a torn step — died
+        mid-save, no atomic rename — does not count as progress, and
+        the trainer's restore validation trims it on resume)."""
+        from distkeras_tpu.resilience.cluster import latest_valid_step
+
         d = self.trainer.checkpoint_dir
-        if not os.path.isdir(d):
-            return None
-        steps = [int(e) for e in os.listdir(d) if e.isdigit()]
-        return max(steps) if steps else None
+        return latest_valid_step(d) if d else None
 
     def backoff_for(self, retry: int) -> float:
         """Sleep before fault retry ``retry`` (1-based)."""
         base = min(self.backoff * self.backoff_factor ** (retry - 1),
                    self.max_backoff)
         return base * (1.0 + self.jitter * self._rng.random())
+
+    def _backoff_sleep(self, wait: float) -> None:
+        """Interruptible backoff: a SIGTERM during the window must not
+        ride it out — ``preempt_event.wait`` returns the instant the
+        preemption arrives, and the next attempt's first round boundary
+        then runs the normal forced-sync-checkpoint path (a preemption
+        outranks politeness toward a flaky disk).  An injected
+        ``sleep=`` (tests) bypasses the event and keeps full control of
+        timing."""
+        if self._sleep is not None:
+            self._sleep(wait)
+        else:
+            self.preempt_event.wait(wait)
 
     # -------------------------------------------------------------- run
 
@@ -180,7 +193,7 @@ class Supervisor:
                     obs.event("supervisor.backoff", seconds=wait,
                               retry=retries)
                     obs.observe("supervisor.backoff_s", wait)
-                    self._sleep(wait)
+                    self._backoff_sleep(wait)
                     continue
                 self._record("ok", None, resumed_from, t0)
                 return result

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import threading
 import time
 
 import jax
@@ -187,7 +188,28 @@ class _LaneEngine:
         self._pending = collections.deque()
         self._completed: dict[int, RequestResult] = {}
         self._closed = False
+        # One lock makes the closed-check and the queue insert ATOMIC:
+        # a begin_shutdown() racing an in-flight enqueue() must yield
+        # exactly one of two outcomes — the request raised EngineClosed
+        # (close won) or it is in the queue/lane and shutdown's drain
+        # reaches it (insert won).  Without the lock, the enqueue could
+        # pass the closed check, lose the race, and then raise
+        # QueueFull off a queue that shutdown was already cancelling —
+        # the caller would shed load from an engine that is not
+        # overloaded, it is closing.  EngineClosed WINS: once
+        # begin_shutdown returns, every later enqueue/submit raises it,
+        # even when the queue is also full.  Reentrant because
+        # enqueue -> pump -> _admit_pending nests.
+        self._admission_lock = threading.RLock()
         self._admitting = False  # pump()-internal submit bypasses _closed
+        # Elastic-tier bookkeeping (ContinuousBatcher(lane_tiers=...);
+        # inert defaults for every other engine).
+        self.lane_tiers = None
+        self.tier_epoch = 0
+        self.scale_up_after = 2
+        self.scale_down_after = 8
+        self._bp_strikes = 0
+        self._idle_strikes = 0
         # The id under which the most recent bare submit() recorded (or
         # will record) its RequestResult — how drain()-style callers
         # that pass a ttl reach their structured timeout via poll/take
@@ -279,49 +301,74 @@ class _LaneEngine:
         key / sampling overrides / eos_token); engine-specific
         validation beyond the prompt/budget checks runs at admission
         time, which for a queued request is a later ``step()``.
+
+        Thread safety: the closed check and the queue insert are
+        atomic under one engine lock, and **EngineClosed wins** — an
+        enqueue racing ``begin_shutdown`` either gets its request in
+        (and shutdown's drain reaches it) or raises EngineClosed;
+        QueueFull is only ever raised by an engine that is actually
+        open and overloaded.  On elastic engines (``lane_tiers``),
+        sustained overflow steps the lane tier up instead of raising
+        (see the ContinuousBatcher docstring).
         """
-        self._check_open()
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
-        if prompt.size < 1:
-            raise ValueError("prompt must contain at least one token")
-        if max_new_tokens < 1:
-            raise ValueError(
-                f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        self._validate_budget(prompt.size, max_new_tokens)
-        dl = self._deadline_of(ttl, deadline)
-        rid = self._next_id
-        self._next_id += 1
-        if dl is not None and dl <= self._clock():
-            # born=now: a ~0s latency observation, so the request_s
-            # histogram count agrees with the requests counter (the
-            # deadline-miss population must not vanish from it).
-            self._finish(rid, prompt, "timeout", prompt.size,
-                         born=self._clock())
-            return rid
-        pend = _Pending(rid, prompt, int(max_new_tokens), dl, submit_kw,
-                        born=self._clock())
-        # FIFO: queued requests get first claim on any free lane (and
-        # expired heads are dropped) before this one may jump in.
-        self.pump()
-        if self.free_lanes() and not self._pending:
-            # Immediate admission: validation errors raise to the
-            # caller here, synchronously.
-            if self._admit_pending(pend):
+        with self._admission_lock:
+            self._check_open()
+            prompt = np.asarray(prompt, np.int32).reshape(-1)
+            if prompt.size < 1:
+                raise ValueError("prompt must contain at least one token")
+            if max_new_tokens < 1:
+                raise ValueError(
+                    f"max_new_tokens must be >= 1, got {max_new_tokens}")
+            self._validate_budget(prompt.size, max_new_tokens)
+            dl = self._deadline_of(ttl, deadline)
+            rid = self._next_id
+            self._next_id += 1
+            if dl is not None and dl <= self._clock():
+                # born=now: a ~0s latency observation, so the request_s
+                # histogram count agrees with the requests counter (the
+                # deadline-miss population must not vanish from it).
+                self._finish(rid, prompt, "timeout", prompt.size,
+                             born=self._clock())
                 return rid
-            # A lane was free, so the only way submit declined is the
-            # deadline expiring between our check and its re-check.
-            self._finish(rid, prompt, "timeout", prompt.size,
-                         born=pend.born)
+            pend = _Pending(rid, prompt, int(max_new_tokens), dl,
+                            submit_kw, born=self._clock())
+            # FIFO: queued requests get first claim on any free lane
+            # (and expired heads are dropped) before this one may jump
+            # in.
+            self.pump()
+            if self.free_lanes() and not self._pending:
+                # Immediate admission: validation errors raise to the
+                # caller here, synchronously.
+                if self._admit_pending(pend):
+                    self._bp_strikes = 0
+                    return rid
+                # A lane was free, so the only way submit declined is
+                # the deadline expiring between our check and its
+                # re-check.
+                self._finish(rid, prompt, "timeout", prompt.size,
+                             born=pend.born)
+                return rid
+            while len(self._pending) >= self.max_queue:
+                if not self._try_scale_up():
+                    obs.count("serving.rejected", reason="queue_full")
+                    raise QueueFull(
+                        f"all {self.lanes} lanes busy and the "
+                        f"admission queue holds {len(self._pending)}/"
+                        f"{self.max_queue} requests; shed load or "
+                        "raise max_queue")
+                # Fresh lanes: queued requests keep FIFO priority,
+                # then this one takes a lane or the queue headroom.
+                self.pump()
+                if self.free_lanes() and not self._pending:
+                    if self._admit_pending(pend):
+                        return rid
+                    self._finish(rid, prompt, "timeout", prompt.size,
+                                 born=pend.born)
+                    return rid
+            self._bp_strikes = 0
+            self._pending.append(pend)
+            obs.gauge("serving.queue_depth", len(self._pending))
             return rid
-        if len(self._pending) >= self.max_queue:
-            obs.count("serving.rejected", reason="queue_full")
-            raise QueueFull(
-                f"all {self.lanes} lanes busy and the admission queue "
-                f"holds {len(self._pending)}/{self.max_queue} requests; "
-                "shed load or raise max_queue")
-        self._pending.append(pend)
-        obs.gauge("serving.queue_depth", len(self._pending))
-        return rid
 
     def _admit_pending(self, pend) -> bool:
         self._admitting = True
@@ -350,6 +397,10 @@ class _LaneEngine:
         requests whose deadline expired are dropped with a structured
         timeout — they never occupy a lane.  Runs automatically at the
         start of every ``step()``; returns the admitted request ids."""
+        with self._admission_lock:
+            return self._pump_locked()
+
+    def _pump_locked(self) -> list[int]:
         admitted = []
         while self._pending:
             pend = self._pending[0]
@@ -409,6 +460,84 @@ class _LaneEngine:
                                  st.prompt_len, born=st.born)
                     self._lane_state[lane] = None
 
+    # ------------------------------------------------- elastic tiers
+
+    def _try_scale_up(self) -> bool:
+        """One overflow strike; step the lane tier up once the
+        backpressure is *sustained* (``scale_up_after`` consecutive
+        overflowing enqueues).  Returns whether a resize happened —
+        False means the caller raises QueueFull (non-elastic engine,
+        top tier reached, or not sustained yet)."""
+        if self.lane_tiers is None:
+            return False
+        i = self.lane_tiers.index(self.lanes)
+        if i + 1 >= len(self.lane_tiers):
+            return False
+        self._bp_strikes += 1
+        if self._bp_strikes < self.scale_up_after:
+            return False
+        self._resize_to(self.lane_tiers[i + 1])
+        return True
+
+    def _maybe_scale_down(self) -> None:
+        """Hysteresis mirror of :meth:`_try_scale_up`: after
+        ``scale_down_after`` consecutive steps with the queue empty and
+        occupancy at or under the next tier down, shrink to it (free
+        lanes burn a row of decode compute each step — the whole point
+        of stepping back down).  Runs under the admission lock: the
+        resize compacts ``_lane_state``, which a concurrent
+        ``enqueue`` (the documented thread-safe surface) must never
+        observe mid-move."""
+        if self.lane_tiers is None:
+            return
+        with self._admission_lock:
+            i = self.lane_tiers.index(self.lanes)
+            if i == 0:
+                return
+            lower = self.lane_tiers[i - 1]
+            busy = sum(1 for s in self._lane_state if s is not None)
+            if busy <= lower and not self._pending:
+                self._idle_strikes += 1
+            else:
+                self._idle_strikes = 0
+                return
+            if self._idle_strikes >= self.scale_down_after:
+                self._resize_to(lower)
+
+    def _resize_to(self, tier: int) -> None:
+        """Move the engine to ``tier`` lanes through the pre-compiled
+        resize program: occupied lanes compact into the low indices
+        (their device rows gathered, their host records remapped), new
+        lanes arrive free (stale rows — masked until admission
+        overwrites them, the same contract as lane reuse).  Strictly
+        host-plus-precompiled work: no compile, ever (pinned by
+        ``scripts/check_compile_counts.py``'s elastic session)."""
+        old = self.lanes
+        keep = [i for i, s in enumerate(self._lane_state)
+                if s is not None]
+        assert len(keep) <= tier, "resize below occupancy"
+        idx = keep + [0] * (tier - len(keep))
+        # numpy, not jnp.asarray(list): the latter jit-compiles a
+        # convert_element_type per target length — a recompile the
+        # elastic session's zero-compile assertion would catch.
+        self._resize_state(np.asarray(idx, np.int32))
+        state: list = [None] * tier
+        for j, i in enumerate(keep):
+            state[j] = self._lane_state[i]
+        self._lane_state = state
+        self.lanes = tier
+        self.tier_epoch += 1
+        self._bp_strikes = self._idle_strikes = 0
+        obs.gauge("serving.lanes_tier", tier)
+        obs.count("serving.resizes",
+                  direction="up" if tier > old else "down")
+        obs.event("serving.resize", from_lanes=old, to_lanes=tier,
+                  tier_epoch=self.tier_epoch)
+
+    def _resize_state(self, idx) -> None:  # pragma: no cover
+        raise NotImplementedError(
+            "this engine does not support lane_tiers")
+
     # ------------------------------------------------------- results
 
     def poll(self, request_id: int):
@@ -439,8 +568,13 @@ class _LaneEngine:
 
     def begin_shutdown(self) -> None:
         """Stop admission (submit/enqueue raise :class:`EngineClosed`);
-        in-flight lanes and the queue keep decoding via ``step()``."""
-        self._closed = True
+        in-flight lanes and the queue keep decoding via ``step()``.
+        Taken under the admission lock: any enqueue that already
+        passed its closed check finishes its insert first (and will be
+        drained), and every enqueue after this returns raises
+        EngineClosed — never QueueFull (EngineClosed wins)."""
+        with self._admission_lock:
+            self._closed = True
 
     def shutdown(self, max_steps: int | None = None) -> dict:
         """Drain-then-shutdown: stop admission, run the decode loop
@@ -511,6 +645,25 @@ class ContinuousBatcher(_LaneEngine):
     ``generate(kv_int8=True, use_prefill=False)``), rolling ring
     lanes included (round-5: the scale slabs ride the same ring-slot
     updates as the K/V).
+
+    **Elastic lane tiers** (round-7, resilience subsystem):
+    ``lane_tiers=(2, 4, 8)`` starts the engine at 2 lanes and moves it
+    between the declared tiers under load — ``scale_up_after``
+    consecutive queue overflows step the tier up (the overflowing
+    enqueue is absorbed instead of raising :class:`QueueFull`);
+    ``scale_down_after`` consecutive steps with the queue empty and
+    occupancy fitting the next tier down step it back (free lanes burn
+    a decode row per step — shrinking recovers that compute).  EVERY
+    tier's programs — each ``step_windows`` decode window, each
+    admission bucket, the inter-tier resize gathers — compile at
+    construction, so no request ever pays a recompile
+    (``scripts/check_compile_counts.py``'s ``serving_elastic`` budget
+    pins it).  A resize compacts occupied lanes; lane ids are
+    therefore unstable, so elastic engines admit through the id-keyed
+    :meth:`enqueue` surface only (bare ``submit`` rejects).
+    ``serving.lanes_tier`` / ``serving.resizes`` /
+    ``serving.resize`` events expose the tier trajectory through obs,
+    and ``tier_epoch`` counts resizes for drain/debug correlation.
     """
 
     def __init__(self, params, cfg: TransformerConfig, lanes: int = 8,
@@ -519,7 +672,9 @@ class ContinuousBatcher(_LaneEngine):
                  prompt_buckets=(8, 32, 128, 512), prompt_cache=None,
                  kv_int8: bool = False,
                  per_request_sampling: bool = False,
-                 max_queue: int = 0, clock=None):
+                 max_queue: int = 0, clock=None,
+                 lane_tiers=None, scale_up_after: int = 2,
+                 scale_down_after: int = 8, step_windows=(1,)):
         # Windowed configs: the engine runs ROLLING lanes — each lane
         # decodes past max_len on the ring-buffer cache (the unbounded
         # streaming-chat shape), which needs rope (positions beyond
@@ -540,6 +695,38 @@ class ContinuousBatcher(_LaneEngine):
             # kv_int8 composes: the int8 ring slab is the same
             # slot-addressed slab update with scale slabs riding along.
             self._rolling = True
+        # Elastic lane tiers (resilience subsystem): the engine starts
+        # at the smallest tier and moves between PRE-COMPILED tiers
+        # under load — every tier's programs compile at construction,
+        # so no request ever pays a recompile (the admission-latency
+        # analogue of the prompt-bucket contract).
+        _tiers = None
+        if lane_tiers is not None:
+            _tiers = tuple(sorted({int(t) for t in lane_tiers}))
+            if len(_tiers) < 2:
+                raise ValueError(
+                    f"lane_tiers needs >= 2 distinct tiers, got "
+                    f"{lane_tiers} (a single fixed size is just lanes=)")
+            if _tiers[0] < 1:
+                raise ValueError(f"lane tiers must be >= 1, got {_tiers}")
+            if scale_up_after < 1 or scale_down_after < 1:
+                raise ValueError(
+                    "scale_up_after/scale_down_after must be >= 1 "
+                    f"(got {scale_up_after}, {scale_down_after})")
+            _windows = tuple(sorted({int(n) for n in step_windows}))
+            if not _windows or _windows[0] < 1:
+                raise ValueError(
+                    f"step_windows must be positive ints, got "
+                    f"{step_windows}")
+            if 1 not in _windows:
+                raise ValueError(
+                    "step_windows must include 1 — drain/shutdown "
+                    "steps one token at a time")
+            if max_queue < 1:
+                raise ValueError(
+                    "lane_tiers needs max_queue >= 1: the queue "
+                    "overflow IS the scale-up signal")
+            lanes = _tiers[0]
         if lanes < 1:
             raise ValueError(f"lanes must be >= 1, got {lanes}")
         if prompt_cache is not None and prompt_cache[1] >= cfg.max_len:
@@ -613,6 +800,11 @@ class ContinuousBatcher(_LaneEngine):
         # lane); ``clock`` is the deadline clock (monotonic seconds;
         # injectable for deterministic chaos tests).
         self._init_admission(max_queue, clock)
+        if _tiers is not None:
+            self.lane_tiers = _tiers
+            self.scale_up_after = scale_up_after
+            self.scale_down_after = scale_down_after
+            self._step_windows = _windows
 
         # Device state: one cache, per-lane next-position, per-lane
         # current token (the one the next step processes), per-lane key.
@@ -639,9 +831,15 @@ class ContinuousBatcher(_LaneEngine):
         # admitted lane's slots.  top_p 1.0 / min_p 0.0 are exact
         # no-ops in the row-wise masks.
         if per_request_sampling:
-            self.temps = jnp.full((lanes,), float(temperature))
-            self.tps = jnp.full((lanes,), float(top_p or 1.0))
-            self.mps = jnp.full((lanes,), float(min_p or 0.0))
+            # Explicit dtype: weak-typed f32 and plain f32 are distinct
+            # jit avals, and the elastic warmup's dummy states must hit
+            # the exact programs the live state will use.
+            self.temps = jnp.full((lanes,), float(temperature),
+                                  jnp.float32)
+            self.tps = jnp.full((lanes,), float(top_p or 1.0),
+                                jnp.float32)
+            self.mps = jnp.full((lanes,), float(min_p or 0.0),
+                                jnp.float32)
         else:
             # Placeholder args keep one step signature across modes
             # (allocated once — step() is the latency-floor hot loop).
@@ -747,6 +945,93 @@ class ContinuousBatcher(_LaneEngine):
 
         self._reseed = jax.jit(reseed, donate_argnums=0)
 
+        if self.lane_tiers is not None:
+            def resize(cache, cur, pos, keys, temps, tps, mps, idx):
+                # Gather lanes idx[j] -> j across the WHOLE device
+                # state; jit specializes one program per (from, to)
+                # tier pair, all warmed below.
+                cache = jax.tree.map(
+                    lambda a: jnp.take(a, idx, axis=1), cache)
+                g = lambda a: jnp.take(a, idx, axis=0)
+                return (cache, g(cur), g(pos), g(keys), g(temps),
+                        g(tps), g(mps))
+
+            # No donation: the gathered output has a different lane
+            # count, so nothing could be reused in place anyway (and
+            # XLA would warn on every tier pair).
+            self._resize = jax.jit(resize)
+            self._compile_tiers()
+
+    # ---------------------------------------------------- elastic tiers
+
+    def _tier_state(self, tier: int):
+        """A dummy device state at ``tier`` lanes with EXACTLY the live
+        state's avals — the warmup vehicle that populates the jit
+        caches every tier will hit.  Returned in step-argument order
+        ``(cache, cur, pos, keys, temps, tps, mps)``."""
+        cache = init_cache(self.cfg, tier, kv_int8=self.kv_int8)
+        cur = jnp.zeros((tier,), jnp.int32)
+        pos = jnp.zeros((tier,), jnp.int32)
+        keys = (jnp.stack([jax.random.key(0)] * tier) if self._keyed
+                else jnp.zeros((tier,), jnp.int32))
+        if self.per_request_sampling:
+            temps = jnp.full((tier,), float(self.temperature),
+                             jnp.float32)
+            tps = jnp.full((tier,), float(self.top_p or 1.0),
+                           jnp.float32)
+            mps = jnp.full((tier,), float(self.min_p or 0.0),
+                           jnp.float32)
+        else:
+            temps = tps = mps = jnp.zeros((tier,), jnp.float32)
+        return cache, cur, pos, keys, temps, tps, mps
+
+    def _compile_tiers(self) -> None:
+        """Compile EVERY tier's programs up front: each declared step
+        window and each admission bucket at each tier, plus the resize
+        gathers between adjacent tiers (both directions).  After this,
+        the elastic engine's whole lifetime — admissions, decode
+        windows, tier moves — runs on warm jit caches; the
+        ``serving_elastic`` budget in scripts/compile_budget.json pins
+        exactly that."""
+        with obs.span("serving.compile_tiers", tiers=self.lane_tiers):
+            for n in self._step_windows:
+                if n not in self._steps:
+                    self._steps[n] = self._make_step(n)
+            for tier in self.lane_tiers:
+                for n in self._step_windows:
+                    # The step donates its cache: a fresh dummy per
+                    # window.
+                    self._steps[n](*self._tier_state(tier))
+                for width in self._buckets:
+                    cache = self._tier_state(tier)[0]
+                    self._admit(cache, jnp.zeros((1, width), jnp.int32),
+                                jnp.int32(0))
+                if self._prefix_lane is not None:
+                    self._reseed(self._tier_state(tier)[0],
+                                 jnp.int32(0))
+                # submit()'s host bookkeeping (lane-slot writes)
+                # specializes per tier too — tiny scatters, but a
+                # compile is a compile.
+                ints = jnp.zeros((tier,), jnp.int32)
+                ints.at[0].set(0)
+                if self._keyed:
+                    jnp.stack([jax.random.key(0)] * tier).at[0].set(
+                        jax.random.key(0))
+                if self.per_request_sampling:
+                    jnp.zeros((tier,), jnp.float32).at[0].set(0.0)
+            for a, b in zip(self.lane_tiers, self.lane_tiers[1:]):
+                for frm, to in ((a, b), (b, a)):
+                    cache, cur, pos, keys, temps, tps, mps = \
+                        self._tier_state(frm)
+                    self._resize(cache, cur, pos, keys, temps, tps, mps,
+                                 jnp.zeros((to,), jnp.int32))
+
+    def _resize_state(self, idx) -> None:
+        (self.cache, self.cur, self.pos, self.keys, self.temps,
+         self.tps, self.mps) = self._resize(
+            self.cache, self.cur, self.pos, self.keys, self.temps,
+            self.tps, self.mps, idx)
+
     # ------------------------------------------------------------ API
 
     def _validate_budget(self, p: int, max_new_tokens: int) -> None:
@@ -796,7 +1081,28 @@ class ContinuousBatcher(_LaneEngine):
         is exposed as ``self.last_request_id`` (the queue-level
         :meth:`enqueue` API wraps all of this and returns the request
         id directly).
+
+        Elastic engines (``lane_tiers=``) reject bare ``submit``: lane
+        indices are not stable across tier resizes, so requests must go
+        through the id-keyed :meth:`enqueue` surface.
+
+        The whole admission runs under the engine lock, so a submit
+        racing ``begin_shutdown`` either lands its lane before the
+        drain looks (and is drained) or raises EngineClosed — the same
+        contract :meth:`enqueue` documents.
         """
+        with self._admission_lock:
+            return self._submit_locked(prompt, max_new_tokens, key,
+                                       temperature, top_p, min_p,
+                                       eos_token, ttl, deadline)
+
+    def _submit_locked(self, prompt, max_new_tokens, key, temperature,
+                       top_p, min_p, eos_token, ttl, deadline):
+        if self.lane_tiers is not None and not self._admitting:
+            raise ValueError(
+                "elastic engines (lane_tiers=...) admit through "
+                "enqueue(): a tier resize compacts lanes, so the lane "
+                "id submit() would return can dangle")
         self._check_open()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = prompt.size
@@ -912,31 +1218,47 @@ class ContinuousBatcher(_LaneEngine):
         eos/budget mid-window keeps decoding privately — the surplus
         tokens are discarded here, identical to truncating generate()'s
         sticky-fill output.  Emitted tokens are EXACTLY step(1)'s.
+
+        Runs under the engine lock end to end: a concurrent
+        ``enqueue`` can trigger a tier resize (scale-up), and the
+        device state this step captures must not be swapped and
+        compacted under it mid-round-trip.
         """
         if n < 1:
             raise ValueError(f"n must be >= 1, got {n}")
-        self.pump()
-        # Idle engine (every lane empty or finished-but-undrained):
-        # nothing can emit, so skip the device round-trip entirely
-        # instead of burning a full decode window.
-        if all(s is None or s.done for s in self._lane_state):
-            return {}
-        chaos.probe("serving.step")
-        if obs.active() is not None:  # running() is O(lanes)
-            obs.gauge("serving.lanes_busy", len(self.running()))
-        if n not in self._steps:
-            self._steps[n] = self._make_step(n)
-        with obs.span("serving.step", n=n):
-            self.cache, self.cur, self.pos, toks = self._steps[n](
-                self.cache, self.cur, self.pos, self.keys,
-                self.temps, self.tps, self.mps)
-            toks = np.asarray(toks)
-        out = self._emit(lambda lane: toks[lane].tolist())
-        # Deadline granularity is one step window: tokens emitted in
-        # the window that straddles the deadline are kept in the
-        # partial result.
-        self._reap()
-        return out
+        if self.lane_tiers is not None and n not in self._step_windows:
+            raise ValueError(
+                f"elastic engines pre-compile their decode windows; "
+                f"step({n}) is not in step_windows={self._step_windows}"
+                " — declare it at construction (a lazy compile here "
+                "would break the no-recompile contract across tiers)")
+        with self._admission_lock:
+            self.pump()
+            # Tier hysteresis BEFORE the idle early-out: an idle
+            # elastic engine must still step its lane count back down.
+            self._maybe_scale_down()
+            # Idle engine (every lane empty or finished-but-
+            # undrained): nothing can emit, so skip the device
+            # round-trip entirely instead of burning a full decode
+            # window.
+            if all(s is None or s.done for s in self._lane_state):
+                return {}
+            chaos.probe("serving.step")
+            if obs.active() is not None:  # running() is O(lanes)
+                obs.gauge("serving.lanes_busy", len(self.running()))
+            if n not in self._steps:
+                self._steps[n] = self._make_step(n)
+            with obs.span("serving.step", n=n):
+                self.cache, self.cur, self.pos, toks = self._steps[n](
+                    self.cache, self.cur, self.pos, self.keys,
+                    self.temps, self.tps, self.mps)
+                toks = np.asarray(toks)
+            out = self._emit(lambda lane: toks[lane].tolist())
+            # Deadline granularity is one step window: tokens emitted
+            # in the window that straddles the deadline are kept in
+            # the partial result.
+            self._reap()
+            return out
 
 
 class SpeculativeBatcher(_LaneEngine):
@@ -960,15 +1282,26 @@ class SpeculativeBatcher(_LaneEngine):
     is the Leviathan/Chen speculative-sampling rollout — each lane
     carries its own iteration counter so its accept/corrective draws
     replay the solo run's ``fold_in(key, iteration)`` stream exactly,
-    whenever the lane was admitted.  Scope: full-cache configs, no
-    shared prefix, no top-k/p filters (the solo fn has none either);
-    unsupported combinations reject loudly.
+    whenever the lane was admitted.  Scope: no shared prefix, no
+    top-k/p filters (the solo fn has none either); unsupported
+    combinations reject loudly.
 
-    Budget: a request needs ``prompt + max_new_tokens + n_draft <=
-    max_len`` on BOTH models (the verify chunk writes ``n_draft + 1``
-    slots past the frontier; same slack as the solo fn).  Finished
-    lanes keep decoding with their frontier clamped at the last
-    budget-safe position — outputs discarded, admission reseeds.
+    Budget (full-cache): a request needs ``prompt + max_new_tokens +
+    n_draft <= max_len`` on BOTH models (the verify chunk writes
+    ``n_draft + 1`` slots past the frontier; same slack as the solo
+    fn).  Finished lanes keep decoding with their frontier clamped at
+    the last budget-safe position — outputs discarded, admission
+    reseeds.
+
+    ROLLING lanes (round-7): when BOTH configs are windowed
+    (rope + ``attention_window``, with ``window + n_draft + 1 <=
+    max_len`` each — solo speculative's ring bound), lanes decode past
+    ``max_len`` on the ring caches with no total-length cap (prompts
+    still must fit the ring), matching solo windowed
+    ``speculative_generate`` per request; and the draft-fault FALLBACK
+    is ring-compatible — it inherits the lanes' unbounded positions
+    and ring slabs mid-wrap, so greedy parity with solo rolling
+    ``generate`` holds past ``max_len`` through a degradation.
     """
 
     def __init__(self, params, draft_params, cfg: TransformerConfig,
@@ -976,13 +1309,43 @@ class SpeculativeBatcher(_LaneEngine):
                  n_draft: int = 4, temperature: float = 0.0,
                  eos_token=None, prompt_buckets=(8, 32, 128, 512),
                  max_queue: int = 0, clock=None):
-        if cfg.attention_window is not None or draft_cfg.attention_window:
+        # Windowed configs run ROLLING speculative lanes (round-7): the
+        # verify chunk writes through _decode_chunk's modular ring
+        # scatter under the same bound as solo speculative_generate —
+        # window + n_draft + 1 <= max_len keeps every rejected tail's
+        # slots outside every live query's band — and lanes decode past
+        # max_len with no total-length cap, exactly like rolling
+        # ContinuousBatcher lanes.  Crucially the DEGRADED path stays
+        # ring-compatible too: the target-only fallback advances the
+        # same unbounded per-lane positions over the same ring slabs,
+        # so a draft fault mid-wrap preserves greedy solo parity past
+        # max_len (the PR-1 follow-up).  Mixed full/windowed model
+        # pairs stay rejected: their caches disagree on what a
+        # position IS past the smaller ring.
+        self._rolling = False
+        if (cfg.attention_window is None) != (draft_cfg.attention_window
+                                              is None):
             raise ValueError(
-                "SpeculativeBatcher v1 supports full-cache configs "
-                "only (ring-cache speculative serving needs its own "
-                "garbage-aliasing bound per lane); use "
-                "speculative_generate for offline windowed runs or "
-                "ContinuousBatcher for rolling lanes")
+                "speculative serving needs the target and draft caches "
+                "to agree: both full-cache or both windowed (got "
+                f"target window={cfg.attention_window}, draft "
+                f"window={draft_cfg.attention_window})")
+        if cfg.attention_window is not None:
+            for name, c in (("cfg", cfg), ("draft_cfg", draft_cfg)):
+                if not rolling_eligible(c):
+                    raise ValueError(
+                        f"windowed speculative serving runs rolling "
+                        f"lanes, which needs {name}.rope=True and "
+                        f"attention_window <= max_len")
+                if c.attention_window + n_draft + 1 > c.max_len:
+                    raise ValueError(
+                        f"rolling speculative lanes need "
+                        f"{name}.attention_window "
+                        f"({c.attention_window}) + n_draft + 1 "
+                        f"({n_draft + 1}) <= max_len ({c.max_len}): "
+                        "the verify chunk's rejected tail must alias "
+                        "outside every live query's band")
+            self._rolling = True
         if draft_cfg.vocab_size != cfg.vocab_size:
             raise ValueError(
                 f"draft vocab_size {draft_cfg.vocab_size} != target "
@@ -1018,11 +1381,19 @@ class SpeculativeBatcher(_LaneEngine):
         self.temperature = temperature
         self.eos_token = eos_token
         # The verify chunk writes k+1 slots past the frontier on BOTH
-        # caches; bucket admission caps prompts the same way.
-        self._cap = min(cfg.max_len, draft_cfg.max_len) - n_draft - 1
+        # caches; bucket admission caps prompts the same way.  Rolling
+        # engines have no frontier cap (positions are unbounded on the
+        # ring) — only the prompt must fit it: the admission warm
+        # chunk is uniform-pos and must not wrap, so p - 1 <= ring - 1.
+        if self._rolling:
+            self._cap = None
+            bucket_cap = min(cfg.max_len, draft_cfg.max_len) - 1
+        else:
+            self._cap = min(cfg.max_len, draft_cfg.max_len) - n_draft - 1
+            bucket_cap = self._cap
         self._buckets = tuple(sorted(
-            {min(int(w), self._cap) for w in prompt_buckets}
-            | {self._cap}))
+            {min(int(w), bucket_cap) for w in prompt_buckets}
+            | {bucket_cap}))
         self._lane_state: list[_Lane | None] = [None] * lanes
         self._next_id = 0
         self._init_admission(max_queue, clock)
@@ -1054,7 +1425,8 @@ class SpeculativeBatcher(_LaneEngine):
 
         k = n_draft
         idx = jnp.arange(k + 1)
-        cap = jnp.int32(self._cap)
+        rolling = self._rolling
+        cap = None if rolling else jnp.int32(self._cap)
         sampled = temperature > 0
 
         def step_fn(tcache, dcache, prev, cur, pos, keys, iters):
@@ -1118,13 +1490,20 @@ class SpeculativeBatcher(_LaneEngine):
             win = jnp.where(idx[None, :] < n[:, None], d_ext,
                             corrective[:, None]).astype(jnp.int32)
 
-            # ---- advance: accepted + corrective, frontier clamped at
-            # the budget-safe cap (live lanes never reach it — submit
-            # guarantees total - 1 <= cap; clamped lanes spin and the
-            # host discards their output).
-            adv = jnp.where(pos >= cap, 0,
-                            jnp.minimum(n + 1, cap - pos)
-                            ).astype(jnp.int32)
+            # ---- advance: accepted + corrective.  Full-cache: the
+            # frontier clamps at the budget-safe cap (live lanes never
+            # reach it — submit guarantees total - 1 <= cap; clamped
+            # lanes spin and the host discards their output).
+            # Rolling: positions are unbounded — the ring absorbs any
+            # advance (idle/done lanes keep rolling too; their writes
+            # land in slots admission reseeds, like the rolling
+            # ContinuousBatcher).
+            if rolling:
+                adv = (n + 1).astype(jnp.int32)
+            else:
+                adv = jnp.where(pos >= cap, 0,
+                                jnp.minimum(n + 1, cap - pos)
+                                ).astype(jnp.int32)
             new_pos = pos + adv
             last = jnp.take_along_axis(
                 win, jnp.maximum(adv - 1, 0)[:, None], axis=1)[:, 0]
@@ -1159,6 +1538,16 @@ class SpeculativeBatcher(_LaneEngine):
             donate_argnums=(0, 1))]
 
     def _validate_budget(self, p: int, max_new_tokens: int) -> None:
+        if self._rolling:
+            # No total-length cap: lanes roll past max_len on the
+            # ring.  Only the PROMPT is bounded — its warm chunk is
+            # uniform-pos and must not wrap.
+            if p - 1 > self._buckets[-1]:
+                raise ValueError(
+                    f"prompt length {p} exceeds the largest admission "
+                    f"bucket ({self._buckets[-1]} + 1); rolling "
+                    "speculative prompts must fit the ring")
+            return
         if p + max_new_tokens - 1 > self._cap:
             raise ValueError(
                 f"prompt ({p}) + max_new_tokens ({max_new_tokens}) + "
@@ -1172,7 +1561,15 @@ class SpeculativeBatcher(_LaneEngine):
         ``key``: per-request PRNG key (required iff the engine
         samples, i.e. ``temperature > 0``).  ``ttl``/``deadline``:
         request deadline, same contract as
-        :meth:`ContinuousBatcher.submit`."""
+        :meth:`ContinuousBatcher.submit` — including holding the
+        engine lock for the whole admission, so a submit racing
+        ``begin_shutdown`` is either drained or raises EngineClosed."""
+        with self._admission_lock:
+            return self._submit_locked(prompt, max_new_tokens, key,
+                                       eos_token, ttl, deadline)
+
+    def _submit_locked(self, prompt, max_new_tokens, key, eos_token,
+                       ttl, deadline):
         self._check_open()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = prompt.size
@@ -1263,10 +1660,17 @@ class SpeculativeBatcher(_LaneEngine):
     def _make_fallback(self):
         """Plain target-only decode step over the SAME engine state
         (tcache/cur/pos): one token per lane per call, frontier clamped
-        at the budget-safe cap exactly like the speculative step."""
+        at the budget-safe cap exactly like the speculative step —
+        except on ROLLING engines, where the fallback preserves the
+        ring-slot arithmetic instead: positions stay unbounded and each
+        row keeps writing slot ``pos % max_len``, so a draft fault
+        mid-wrap hands the plain path a cache whose implied positions
+        it continues exactly (greedy parity past max_len; pinned by
+        tests/test_speculative.py's chaos regression)."""
         cfg = self.cfg
         temperature = self.temperature
-        cap = jnp.int32(self._cap)
+        rolling = self._rolling
+        cap = None if rolling else jnp.int32(self._cap)
 
         def pick(k, row, q):
             return jax.random.categorical(jax.random.fold_in(k, q), row)
@@ -1280,8 +1684,12 @@ class SpeculativeBatcher(_LaneEngine):
             else:
                 nxt = logits.argmax(axis=-1)
             nxt = nxt.astype(jnp.int32)
-            adv = (pos < cap).astype(jnp.int32)
-            new_pos = jnp.minimum(pos + 1, cap)
+            if rolling:
+                adv = jnp.ones_like(pos)
+                new_pos = pos + 1
+            else:
+                adv = (pos < cap).astype(jnp.int32)
+                new_pos = jnp.minimum(pos + 1, cap)
             new_cur = jnp.where(adv > 0, nxt, cur)
             return tcache, new_cur, new_pos, nxt, adv
 
@@ -1290,7 +1698,14 @@ class SpeculativeBatcher(_LaneEngine):
     def step(self):
         """One decode round for every lane; returns
         ``{lane: [tokens...]}`` — up to ``n_draft + 1`` tokens per
-        lane per call (exactly 1 once the engine is degraded)."""
+        lane per call (exactly 1 once the engine is degraded).  Runs
+        under the engine lock, like :meth:`ContinuousBatcher.step`, so
+        a concurrent locked ``submit``/``enqueue`` never rebinds the
+        lane state mid-round-trip."""
+        with self._admission_lock:
+            return self._step_locked()
+
+    def _step_locked(self):
         self.pump()
         if all(s is None or s.done for s in self._lane_state):
             return {}

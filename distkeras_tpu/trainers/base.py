@@ -100,6 +100,32 @@ class CheckpointingBase:
         step = self._ckpt.latest_step()
         if step is None:
             return pytree, 0
+        valid = self._ckpt.latest_valid_step()
+        if valid != step:
+            # Torn latest (host died mid-save on a store without
+            # atomic rename): resume from the newest step that passes
+            # the integrity check instead of crashing inside restore —
+            # the same selection rule the cluster-consistent restart
+            # applies across hosts.
+            import warnings
+
+            from distkeras_tpu.resilience.cluster import (
+                trim_to_consistent)
+
+            warnings.warn(
+                f"checkpoint step {step} under "
+                f"{self.checkpoint_dir!r} is torn/partial; resuming "
+                f"from the latest valid step {valid} instead",
+                stacklevel=2)
+            obs.event("checkpoint.torn", step=step, fallback=valid)
+            # Drop the torn steps: the resumed run will pass their
+            # rounds again, and both backends refuse to overwrite a
+            # step directory that (half-)exists.  One trimming rule,
+            # shared with the cluster driver's pre-epoch trim.
+            trim_to_consistent([self._ckpt.directory])
+            if valid is None:
+                return pytree, 0
+            step = valid
         with obs.span("checkpoint.restore", step=step):
             restored = self._ckpt.restore(pytree, step)
         return restored, step

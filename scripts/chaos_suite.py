@@ -24,8 +24,21 @@ machine-readable **fault/recovery timeline** (one JSON object per
 line) reconstructed from that trace — no log parsing.  ``--trace``
 keeps the trace file for ``scripts/obs_report.py``.
 
+``--cluster`` runs the MULTI-HOST ladder instead (PR 5): two OS
+processes join one jax.distributed runtime and train under per-host
+Supervisors wrapped by cluster drivers; chaos then kills one host
+mid-training (``kill``), wedges its heartbeat writer (``stall``), or
+partitions it (``drop``) — the survivor's collective watchdog fires
+within the configured window, both hosts tear down and re-init
+jax.distributed under a new cluster epoch, training resumes from the
+cluster-consistent checkpoint, and the final weights must be
+bit-for-bit identical to an uninterrupted two-host run.  Every
+attempt's obs trace is merged (obs_report --merge machinery) into ONE
+cross-host fault/recovery timeline, printed as JSON lines.
+
 Usage: python scripts/chaos_suite.py [--seed N] [--kill-rounds 3,7,12]
                                      [--trace chaos.jsonl]
+       python scripts/chaos_suite.py --cluster [--scenarios kill,stall]
 """
 
 import argparse
@@ -150,6 +163,28 @@ def check_backpressure(seed):
     assert res[r1].ok and res[r2].ok, "queued request lost"
 
 
+def check_heartbeat_fault_kinds(seed):
+    """The cluster fault kinds, single-process: a ``drop`` rule
+    (partition) suppresses beats until peers see the host stale; beats
+    flow again when the plan lifts."""
+    import tempfile as _tf
+
+    from distkeras_tpu.resilience.health import (HealthMonitor,
+                                                  HeartbeatWriter,
+                                                  read_beat)
+
+    d = _tf.mkdtemp(prefix="chaos_hb_")
+    w = HeartbeatWriter(d, host=1, interval=0.05)
+    mon = HealthMonitor(d, host=0, num_hosts=2, window=60.0, grace=0.0)
+    with FaultPlan(seed).drop("cluster.heartbeat", times=None):
+        w.beat_once()
+    assert read_beat(d, 1) is None, "partitioned beat was published"
+    assert mon.stale_peers() == [1], "partitioned host not stale"
+    w.beat_once()
+    assert read_beat(d, 1)["host"] == 1, "beats did not resume"
+    assert mon.stale_peers() == [], "fresh beat still read as stale"
+
+
 def check_draft_fault_fallback(seed):
     rng = np.random.default_rng(seed)
     tp = tfm.init_params(jax.random.key(seed), CFG)
@@ -167,6 +202,193 @@ def check_draft_fault_fallback(seed):
         eng.drain(lane), np.asarray(generate(tp, prompt[None], CFG, 8))[0])
 
 
+# --------------------------------------------------- multi-host ladder
+#
+# The child below is ONE program started identically on every host of
+# the cluster (deploy.py's SPMD model): join the epoch's
+# jax.distributed runtime under a ClusterMember (heartbeats out,
+# collective watchdog in), train the shared tiny LM under a per-host
+# Supervisor with a SHARED orbax checkpoint store, and let chaos kill/
+# stall/partition host 1 during epoch 0 only.  Epoch 1 must resume
+# from the cluster-consistent step and finish; host 0 then writes the
+# final weights for the bit-for-bit comparison.
+
+CLUSTER_CHILD = '''
+import os, sys
+os.environ["KERAS_BACKEND"] = "jax"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+
+# Join the EPOCH-STAMPED runtime before anything touches a device
+# (jax.distributed.initialize must precede the first computation, and
+# importing the framework runs keras backend init): coordinator port =
+# base + epoch, so a stale epoch's half-dead runtime cannot be
+# rejoined.  Until the member starts beating below, liveness is the
+# drivers' job (their launch grace covers import + join).
+host = int(os.environ["DKT_CLUSTER_HOST"])
+epoch = int(os.environ["DKT_CLUSTER_EPOCH"])
+try:  # gloo: cross-process CPU collectives (mesh.enable_cpu_collectives)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(
+    "localhost:%d" % (int(os.environ["DKT_CLUSTER_BASE_PORT"]) + epoch),
+    num_processes={nhosts}, process_id=host)
+
+from distkeras_tpu import obs
+from distkeras_tpu.resilience import FaultPlan, Supervisor, cluster
+
+member = cluster.member_from_env()
+trace = os.path.join({tracedir!r}, f"host{{host}}.e{{epoch}}.jsonl")
+obs.enable(trace_path=trace)
+obs.event("cluster.child", host=host, epoch=epoch, phase="start")
+member.start()
+assert jax.process_count() == {nhosts}, jax.process_count()
+
+import numpy as np
+import distkeras_tpu as dk
+from distkeras_tpu.models.transformer import TransformerConfig
+
+rng = np.random.default_rng({seed})
+tokens = rng.integers(0, 64, (64, 17)).astype(np.int32)
+cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                        d_ff=64, max_len=17)
+t = dk.LMTrainer(cfg, optimizer="sgd", learning_rate=0.05, batch_size=16,
+                 num_epoch={num_epoch}, checkpoint_dir={ckdir!r},
+                 checkpoint_every=1)
+sup = Supervisor(t, max_retries=1, backoff=0.0, max_backoff=0.0,
+                 jitter=0.0)
+
+plan = None
+spec = os.environ.get("DKT_CHAOS", "")
+if spec and epoch == 0:
+    kind, site, at = spec.split(":")
+    plan = FaultPlan({seed})
+    if kind == "kill":
+        plan.kill(site, at=int(at))
+    elif kind == "stall":
+        plan.delay(site, seconds=3600.0, at=int(at))
+    elif kind == "drop":
+        plan.drop(site, at=None, times=None)
+    else:
+        raise ValueError(f"unknown chaos kind {{kind}}")
+    plan.__enter__()
+
+params = sup.run(tokens[host::{nhosts}])
+obs.event("cluster.child", host=host, epoch=epoch, phase="trained",
+          rounds=len(t.history))
+if host == 0:
+    flat = {{"/".join(map(str, p)): np.asarray(v)
+            for p, v in jax.tree_util.tree_flatten_with_path(params)[0]}}
+    np.savez({out!r}, losses=np.asarray(t.history), **flat)
+member.complete()
+obs.disable()
+print("HOST", host, "epoch", epoch, "DONE", flush=True)
+'''
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def run_cluster_scenario(scenario, seed, workdir, window=2.0,
+                         attempt_timeout=240.0, num_epoch=2,
+                         kill_round=5):
+    """One coordinated-restart scenario on 2 local hosts; returns
+    (summaries, out_npz_path, trace_paths).  ``scenario`` None = no
+    chaos (the uninterrupted reference run)."""
+    import glob
+
+    from distkeras_tpu.resilience.cluster import run_cluster_local
+
+    name = scenario or "reference"
+    base = os.path.join(workdir, name)
+    coord = os.path.join(base, "coord")
+    ckdir = os.path.join(base, "ckpt")
+    tracedir = os.path.join(base, "traces")
+    out = os.path.join(base, "host0.npz")
+    os.makedirs(tracedir, exist_ok=True)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(base, "child.py")
+    with open(script, "w", encoding="utf-8") as f:
+        f.write(CLUSTER_CHILD.format(repo=repo, nhosts=2, seed=seed,
+                                     ckdir=ckdir, out=out,
+                                     tracedir=tracedir,
+                                     num_epoch=num_epoch))
+    per_host_env = {}
+    if scenario == "kill":
+        per_host_env = {1: {"DKT_CHAOS": f"kill:train.round:{kill_round}"}}
+    elif scenario == "stall":
+        per_host_env = {1: {"DKT_CHAOS": "stall:cluster.heartbeat:6"}}
+    elif scenario == "drop":
+        per_host_env = {1: {"DKT_CHAOS": "drop:cluster.heartbeat:0"}}
+    elif scenario is not None:
+        raise ValueError(f"unknown cluster scenario {scenario!r}")
+    summaries = run_cluster_local(
+        [sys.executable, script], num_hosts=2, coord_dir=coord,
+        per_host_env=per_host_env, base_port=_free_port(),
+        checkpoint_dirs=[ckdir], window=window, poll=0.2,
+        heartbeat_interval=0.4, grace=90.0, max_restarts=2,
+        attempt_timeout=attempt_timeout)
+    return summaries, out, sorted(glob.glob(
+        os.path.join(tracedir, "*.jsonl")))
+
+
+def run_cluster_ladder(scenarios, seed, workdir):
+    """The --cluster entry: reference run + one chaos run per
+    scenario, bit-for-bit weight comparison, merged cross-host
+    timeline per scenario.  Returns the number of failures."""
+    import json
+
+    import numpy as np
+
+    from distkeras_tpu.obs.report import merge_traces
+
+    print("== cluster ladder: uninterrupted 2-host reference ==",
+          flush=True)
+    _, ref_out, _ = run_cluster_scenario(None, seed, workdir)
+    ref = np.load(ref_out)
+
+    failures = 0
+    for scenario in scenarios:
+        print(f"== cluster scenario: {scenario} ==", flush=True)
+        try:
+            summaries, out, traces = run_cluster_scenario(
+                scenario, seed, workdir)
+            assert all(s["epochs"] >= 2 for s in summaries), (
+                f"no coordinated restart happened: {summaries}")
+            got = np.load(out)
+            mismatch = [k for k in ref.files if k != "losses"
+                        and not np.array_equal(got[k], ref[k])]
+            assert not mismatch, (
+                f"resumed weights differ from the uninterrupted run: "
+                f"{mismatch}")
+            print(f"  PASS  cluster/{scenario}: restart under epoch "
+                  f"{summaries[0]['epochs'] - 1}, weights bit-for-bit")
+        except Exception as e:  # noqa: BLE001 — report the ladder
+            failures += 1
+            print(f"  FAIL  cluster/{scenario}: "
+                  f"{type(e).__name__}: {e}")
+            continue
+        # Machine-readable cross-host fault/recovery timeline,
+        # assembled by the obs_report --merge machinery.
+        merged = merge_traces(traces)
+        print(f"--- cross-host fault/recovery timeline "
+              f"({scenario}, JSONL) ---")
+        for e in merged["timeline"]:
+            print(json.dumps({"t": round(e["t"], 4), "host": e["host"],
+                              "run": e["run"], "event": e["name"],
+                              **e["fields"]}))
+    return failures
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0)
@@ -175,7 +397,33 @@ def main():
     ap.add_argument("--trace", default=None,
                     help="write the obs event trace here (default: a "
                          "temp file, deleted after the timeline prints)")
+    ap.add_argument("--cluster", action="store_true",
+                    help="run the multi-host coordinated-restart "
+                         "ladder instead of the single-host matrix")
+    ap.add_argument("--scenarios", default="kill,stall,drop",
+                    help="--cluster fault kinds to run "
+                         "(kill = host loss, stall = wedged heartbeat "
+                         "writer, drop = partition)")
+    ap.add_argument("--workdir", default=None,
+                    help="--cluster scratch dir (default: a temp dir, "
+                         "kept on failure)")
     args = ap.parse_args()
+
+    if args.cluster:
+        workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_cluster_")
+        failures = run_cluster_ladder(
+            [s for s in args.scenarios.split(",") if s], args.seed,
+            workdir)
+        if failures:
+            print(f"cluster ladder: {failures} scenario(s) FAILED "
+                  f"(artifacts kept at {workdir})")
+            return 1
+        print("cluster ladder: all scenarios passed")
+        if not args.workdir:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+        return 0
     kills = [int(r) for r in args.kill_rounds.split(",")]
 
     matrix = []
@@ -187,6 +435,8 @@ def main():
     matrix += [
         ("checkpoint-save-fault", lambda: check_checkpoint_fault_retry(
             args.seed)),
+        ("cluster-heartbeat-partition",
+         lambda: check_heartbeat_fault_kinds(args.seed)),
         ("serving-deadlines", lambda: check_serving_deadlines(args.seed)),
         ("queue-backpressure", lambda: check_backpressure(args.seed)),
         ("draft-fault-fallback", lambda: check_draft_fault_fallback(

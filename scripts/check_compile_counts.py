@@ -172,6 +172,43 @@ def session_speculative():
         eng.drain(lane)
 
 
+def session_serving_elastic():
+    """Elastic ContinuousBatcher session: EVERY program — each tier's
+    decode step, each (tier, bucket) admission, the inter-tier resize
+    gathers — compiles at construction; the overload -> step-up ->
+    drain -> step-down cycle afterwards must be COMPILE-FREE (asserted
+    here, not just budgeted: a post-construction compile means a tier
+    program was missed and some request paid a recompile)."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.serving import ContinuousBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    eng = ContinuousBatcher(params, cfg, lane_tiers=(1, 2), max_queue=1,
+                            scale_up_after=1, scale_down_after=2,
+                            prompt_buckets=(8,))
+    built = _COMPILES["n"]
+    rng = np.random.default_rng(0)
+    rids = [eng.enqueue(rng.integers(0, 64, (5,)).astype(np.int32), 6)
+            for _ in range(3)]
+    assert eng.lanes == 2, eng.lanes          # stepped up under load
+    while any(eng.poll(r) is None for r in rids):
+        eng.step()
+    for _ in range(3):
+        eng.step()                            # drained + idle: back down
+    assert eng.lanes == 1, eng.lanes
+    assert all(eng.take(r).ok for r in rids)
+    serve_compiles = _COMPILES["n"] - built
+    assert serve_compiles == 0, (
+        f"elastic serve phase compiled {serve_compiles} program(s); "
+        "tier compiles must all happen at construction")
+
+
 SESSIONS = {
     "adag": lambda: session_adag(),
     "adag_zero1": lambda: session_adag(zero1=True),
@@ -181,6 +218,7 @@ SESSIONS = {
     "lm_device_data": lambda: session_lm(device_data=True),
     "serving": session_serving,
     "speculative": session_speculative,
+    "serving_elastic": session_serving_elastic,
 }
 
 

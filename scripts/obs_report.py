@@ -12,10 +12,19 @@ Usage::
     python scripts/obs_report.py run.jsonl
     python scripts/obs_report.py new.jsonl --compare base.jsonl
     python scripts/obs_report.py run.jsonl --json   # the report dict
+    python scripts/obs_report.py --merge host0.jsonl host1.jsonl ...
 
 ``--compare BASE`` prints a regression diff of NEW (the positional
 trace) against BASE instead of the full report — per-phase total/mean
 deltas, latency percentile deltas, counter drift.
+
+``--merge`` takes SEVERAL per-host traces (a multi-host run writes one
+file per host per attempt) and renders ONE cross-host event timeline,
+wall-clock aligned through each trace's meta anchor and tagged with
+run id + host — how a coordinated cluster restart's fault/recovery
+sequence reads as a single story (``--json`` emits it as one JSON
+object per line, machine-readable; scripts/chaos_suite.py --cluster
+prints exactly this).
 
 Pure host-side file parsing: no jax import, safe anywhere.
 """
@@ -50,19 +59,37 @@ def _load_report_module():
 
 def main(argv):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="obs JSONL trace to report on")
+    ap.add_argument("trace", nargs="+",
+                    help="obs JSONL trace(s); several only with --merge")
     ap.add_argument("--compare", metavar="BASE",
                     help="diff TRACE against this earlier trace "
                          "instead of printing the full report")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge per-host traces into one cross-host "
+                         "event timeline (wall-clock aligned)")
     ap.add_argument("--json", action="store_true",
-                    help="emit the report as JSON instead of text")
-    ap.add_argument("--max-events", type=int, default=60,
-                    help="timeline rows to print (default 60)")
+                    help="emit the report as JSON instead of text "
+                         "(with --merge: one timeline entry per line)")
+    ap.add_argument("--max-events", type=int, default=None,
+                    help="timeline rows to print "
+                         "(default 60; 200 with --merge)")
     args = ap.parse_args(argv)
 
     report = _load_report_module()
 
-    rep = report.load_report(args.trace)
+    if args.merge:
+        rep = report.merge_traces(args.trace)
+        if args.json:
+            for e in rep["timeline"]:
+                print(json.dumps(e, default=str))
+        else:
+            print(report.render_merged(
+                rep, max_events=args.max_events
+                if args.max_events is not None else 200))
+        return 0
+    if len(args.trace) != 1:
+        ap.error("several traces need --merge")
+    rep = report.load_report(args.trace[0])
     if args.compare:
         base = report.load_report(args.compare)
         if args.json:
@@ -74,7 +101,9 @@ def main(argv):
     if args.json:
         print(json.dumps(rep, indent=1, default=str))
     else:
-        print(report.render_report(rep, max_events=args.max_events))
+        print(report.render_report(
+            rep, max_events=args.max_events
+            if args.max_events is not None else 60))
     return 0
 
 

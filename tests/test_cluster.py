@@ -1,0 +1,409 @@
+"""Cluster resilience: heartbeats, epochs, coordinated restart, and
+cluster-consistent checkpoint selection (PR 5 tentpole).
+
+Layers under test, cheapest first: the health/epoch primitives with
+injected clocks (no processes), the restart DRIVER with stdlib-only
+child processes (no jax — proves the coordination protocol alone), the
+torn-checkpoint consistency rule on both backends, and finally the
+real thing: a 2-process jax.distributed training job whose host 1 is
+chaos-killed mid-training — the survivor's collective watchdog fires
+within the window, both hosts re-init under a new cluster epoch, and
+the resumed run lands on the uninterrupted run's weights.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.checkpoint import CheckpointManager
+from distkeras_tpu.resilience import cluster
+from distkeras_tpu.resilience.chaos import FaultPlan
+from distkeras_tpu.resilience.cluster import (ClusterMember, EpochStore,
+                                              cluster_consistent_step,
+                                              step_is_valid,
+                                              trim_to_consistent,
+                                              valid_steps)
+from distkeras_tpu.resilience.health import (HealthMonitor,
+                                             HeartbeatWriter, read_beat,
+                                             write_beat)
+
+from conftest import make_blobs, make_mlp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- epochs
+
+
+def test_epoch_store_is_monotone(tmp_path):
+    store = EpochStore(str(tmp_path))
+    assert store.current() == 0
+    store.request(1)
+    store.request(1)            # idempotent, concurrent-safe
+    assert store.current() == 1
+    store.request(3)
+    store.request(2)            # late lower request cannot regress
+    assert store.current() == 3
+    with pytest.raises(ValueError):
+        store.request(-1)
+
+
+# ----------------------------------------------------------- heartbeats
+
+
+def test_heartbeat_staleness_and_done(tmp_path):
+    t = [100.0]
+    clock = lambda: t[0]
+    d = str(tmp_path / "hb")
+    write_beat(d, 0, epoch=0, n=1, clock=clock)
+    write_beat(d, 1, epoch=0, n=1, clock=clock)
+    mon = HealthMonitor(d, host=0, num_hosts=3, window=2.0, grace=5.0,
+                        clock=clock)
+    assert mon.stale_peers() == []          # host 2 inside grace
+    t[0] += 6.0
+    # host 1's beat is now 6s old (> window) and host 2 never beat.
+    assert mon.stale_peers() == [1, 2]
+    write_beat(d, 1, epoch=0, n=2, clock=clock)
+    assert mon.stale_peers() == [2]
+    # done beat: clean completion is never read as death
+    write_beat(d, 2, epoch=0, n=1, clock=clock, done=True)
+    t[0] += 100.0
+    assert mon.stale_peers() == [1]         # host 2 done; host 1 stale
+
+
+def test_heartbeat_epoch_filter(tmp_path):
+    """A relaunched cluster must not count a dead host's pre-restart
+    beats as liveness in the new generation."""
+    t = [0.0]
+    clock = lambda: t[0]
+    d = str(tmp_path / "hb")
+    write_beat(d, 1, epoch=0, n=9, clock=clock)
+    mon = HealthMonitor(d, host=0, num_hosts=2, window=10.0, grace=1.0,
+                        clock=clock)
+    assert mon.stale_peers(epoch=0) == []   # fresh beat, right epoch
+    t[0] += 2.0
+    assert mon.stale_peers(epoch=1) == [1]  # old-epoch beat filtered
+
+
+@pytest.mark.chaos
+def test_chaos_partition_drops_beats(tmp_path):
+    """The ``drop`` fault kind: the host keeps running but its beats
+    never publish — a partition as peers see it."""
+    d = str(tmp_path / "hb")
+    w = HeartbeatWriter(d, host=0, interval=0.05)
+    with FaultPlan().drop("cluster.heartbeat", times=None):
+        w.beat_once()
+        w.beat_once()
+    assert read_beat(d, 0) is None          # nothing ever published
+    w.beat_once()                           # plan gone: beats flow
+    assert read_beat(d, 0)["host"] == 0
+
+
+def test_watchdog_trips_on_stale_peer_and_requests_epoch(tmp_path):
+    """The collective-watchdog core: a peer stops beating -> the
+    member requests the next epoch and aborts (injected abort — the
+    production default is os._exit, the only way out of a wedged
+    collective)."""
+    coord = str(tmp_path)
+    # Peer host 1 beats once, then goes silent.
+    write_beat(os.path.join(coord, "hb"), 1, epoch=0, n=1)
+    tripped = []
+    m = ClusterMember(coord, host=0, num_hosts=2, epoch=0,
+                      heartbeat_interval=0.05, window=0.3, poll=0.05,
+                      grace=5.0, abort=tripped.append)
+    m.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not tripped and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        m.stop()
+    assert tripped and "1" in tripped[0]
+    assert m.epochs.current() == 1          # next epoch requested
+    assert m.fault_reason is not None
+
+
+def test_watchdog_trips_on_epoch_advance(tmp_path):
+    coord = str(tmp_path)
+    tripped = []
+    m = ClusterMember(coord, host=0, num_hosts=1, epoch=0,
+                      heartbeat_interval=0.05, window=5.0, poll=0.05,
+                      abort=tripped.append)
+    m.start()
+    try:
+        m.epochs.request(1)                 # another host moved on
+        deadline = time.monotonic() + 5.0
+        while not tripped and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        m.stop()
+    assert tripped and "epoch 1" in tripped[0]
+
+
+# ------------------------------------- cluster-consistent checkpoints
+
+
+def _pickle_store(d, steps):
+    with CheckpointManager(str(d), backend="pickle",
+                           max_to_keep=10) as m:
+        for s in steps:
+            m.save({"v": np.float32(s)}, step=s, force=True)
+
+
+def _tear_pickle(d, step):
+    """Truncate the step's payload mid-byte: a host that died inside
+    save() on a filesystem without atomic rename."""
+    p = os.path.join(str(d), str(step), "state.pkl")
+    data = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(data[:max(1, len(data) // 2)])
+
+
+def test_cluster_consistent_step_skips_torn_pickle(tmp_path):
+    a, b = tmp_path / "h0", tmp_path / "h1"
+    _pickle_store(a, [2, 4, 6])
+    _pickle_store(b, [2, 4, 6])
+    assert cluster_consistent_step([str(a), str(b)]) == 6
+    _tear_pickle(b, 6)
+    assert not step_is_valid(str(b / "6"))
+    assert valid_steps(str(b)) == [2, 4]
+    # Highest step valid on EVERY host: host 1's torn 6 disqualifies 6.
+    assert cluster_consistent_step([str(a), str(b)]) == 4
+    # A step only one host committed never wins either.
+    _pickle_store(a, [8])
+    assert cluster_consistent_step([str(a), str(b)]) == 4
+    kept = trim_to_consistent([str(a), str(b)])
+    assert kept == 4
+    assert valid_steps(str(a)) == [2, 4]
+    assert sorted(int(e) for e in os.listdir(str(b))
+                  if e.isdigit()) == [2, 4]
+
+
+def test_cluster_consistent_step_skips_torn_orbax(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    a, b = tmp_path / "h0", tmp_path / "h1"
+    for d in (a, b):
+        with CheckpointManager(str(d), backend="orbax",
+                               async_save=False) as m:
+            for s in (1, 2):
+                m.save({"v": np.arange(4.0)}, step=s, force=True)
+            m.wait_until_finished()
+    assert cluster_consistent_step([str(a), str(b)]) == 2
+    # Torn orbax step: the committed-by-name dir exists but its
+    # payload never landed (crash mid-save without atomic rename).
+    for e in os.listdir(str(b / "2")):
+        path = b / "2" / e
+        if path.is_dir():
+            import shutil
+
+            shutil.rmtree(path)
+        else:
+            path.unlink()
+    assert not step_is_valid(str(b / "2"))
+    assert cluster_consistent_step([str(a), str(b)]) == 1
+    # Shared-store case (multi-host orbax): one real dir, deduped.
+    assert cluster_consistent_step([str(a), str(a)]) == 2
+
+
+def test_trainer_restore_skips_torn_latest(tmp_path):
+    """Trainers' restore validation (tentpole satellite): a torn
+    latest checkpoint must not crash resume — the trainer falls back
+    to the latest VALID step, replays from there, and still lands on
+    the uninterrupted run's weights."""
+    x, y = make_blobs(n=128)
+    ds = dk.Dataset.from_arrays(x, y)
+    common = dict(loss="sparse_categorical_crossentropy",
+                  worker_optimizer="sgd", learning_rate=0.05,
+                  batch_size=16, num_epoch=2)
+    ref = dk.SingleTrainer(make_mlp(), **common).train(ds)
+
+    ckdir = str(tmp_path / "c")
+    t = dk.SingleTrainer(make_mlp(), checkpoint_dir=ckdir,
+                         checkpoint_every=1, checkpoint_backend="pickle",
+                         max_checkpoints=100, **common)
+    t.train(ds)
+    steps = sorted(int(e) for e in os.listdir(ckdir) if e.isdigit())
+    _tear_pickle(tmp_path / "c", steps[-1])
+    _tear_pickle(tmp_path / "c", steps[-2])
+
+    resumed = dk.SingleTrainer(make_mlp(), checkpoint_dir=ckdir,
+                               checkpoint_every=1, resume=True,
+                               checkpoint_backend="pickle",
+                               max_checkpoints=100, **common)
+    with pytest.warns(UserWarning, match="torn/partial"):
+        out = resumed.train(ds)
+    # Resumed from the last VALID step: replays the torn rounds.
+    assert len(resumed.history) == 2
+    for wr, wo in zip(ref.get_weights(), out.get_weights()):
+        np.testing.assert_allclose(np.asarray(wr), np.asarray(wo),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------- driver protocol (no jax)
+
+# A stdlib-only cluster child: imports health/cluster through stub
+# parent packages (no jax, no keras — ~0.2 s startup), beats, "works",
+# and at epoch 0 host 1 hard-dies mid-work.  Proves the driver
+# protocol — detection, epoch bump, barrier, relaunch — in seconds.
+DRIVER_CHILD = """
+import importlib, os, sys, time, types
+for name, path in (("distkeras_tpu", {pkg!r}),
+                   ("distkeras_tpu.resilience", {res!r})):
+    mod = types.ModuleType(name)
+    mod.__path__ = [path]
+    sys.modules[name] = mod
+cluster = importlib.import_module("distkeras_tpu.resilience.cluster")
+
+member = cluster.member_from_env()
+member.start()
+if member.epoch == 0 and member.host == 1:
+    time.sleep(0.6)
+    os._exit(137)                     # hard host loss, no cleanup
+time.sleep(2.5)                       # "training"
+member.complete()
+print("host", member.host, "epoch", member.epoch, "done", flush=True)
+"""
+
+
+@pytest.mark.multiprocess
+def test_driver_coordinated_restart_protocol(tmp_path):
+    """Two drivers, stdlib children: host 1 dies at epoch 0 -> host
+    0's child watchdog aborts (EXIT_RESTART), both drivers meet at the
+    epoch-1 barrier and relaunch, epoch 1 completes on both hosts."""
+    pkg = os.path.join(REPO, "distkeras_tpu")
+    res = os.path.join(pkg, "resilience")
+    child = DRIVER_CHILD.format(pkg=pkg, res=res)
+    summaries = cluster.run_cluster_local(
+        [sys.executable, "-c", child], num_hosts=2,
+        coord_dir=str(tmp_path / "coord"), base_port=0,
+        window=0.6, poll=0.1, heartbeat_interval=0.15, grace=20.0,
+        max_restarts=2, barrier_timeout=30.0, attempt_timeout=60.0)
+    for s in summaries:
+        assert s["epochs"] == 2, s        # exactly one restart
+        assert s["restarts"] == 1, s
+    # The dead host's driver recorded the failed attempt; host 0's
+    # recorded either the watchdog abort rc or a driver-side kill.
+    rcs = [a["rc"] for a in summaries[1]["history"]
+           if a["event"] == "attempt"]
+    assert rcs[0] == 137 and rcs[-1] == 0
+
+
+def test_member_from_env_round_trip(tmp_path, monkeypatch):
+    monkeypatch.setenv("DKT_CLUSTER_DIR", str(tmp_path))
+    monkeypatch.setenv("DKT_CLUSTER_HOST", "1")
+    monkeypatch.setenv("DKT_CLUSTER_NHOSTS", "4")
+    monkeypatch.setenv("DKT_CLUSTER_EPOCH", "3")
+    monkeypatch.setenv("DKT_CLUSTER_BASE_PORT", "9100")
+    m = cluster.member_from_env()
+    assert (m.host, m.num_hosts, m.epoch) == (1, 4, 3)
+    assert m.coordinator_address == "localhost:9103"  # epoch-stamped
+
+
+# ------------------------------------------------ the real thing (jax)
+
+
+def _load_chaos_suite():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_suite", os.path.join(REPO, "scripts", "chaos_suite.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.multihost
+@pytest.mark.multiprocess
+def test_two_process_kill_one_host_coordinated_restart(tmp_path):
+    """The fast-gate smoke (bounded: every layer has a timeout well
+    under 120 s): 2 jax.distributed processes train the tiny LM under
+    per-host Supervisors; chaos hard-kills host 1 at round 5.  Host
+    0 wedges in the next collective, its watchdog detects the missed
+    heartbeats inside the window and aborts; both drivers meet at the
+    epoch-1 barrier, re-init jax.distributed on the epoch-stamped
+    port, resume from the cluster-consistent checkpoint, and finish.
+    The resumed weights must match the uninterrupted run (the
+    byte-exact 2-process-vs-2-process comparison runs in the slow
+    chaos ladder; here the reference is the single-process run over
+    the same global batches — identical math, reduction-order
+    tolerance)."""
+    cs = _load_chaos_suite()
+    summaries, out, traces = cs.run_cluster_scenario(
+        "kill", 0, str(tmp_path), window=2.0, attempt_timeout=100.0,
+        num_epoch=1, kill_round=3)
+    for s in summaries:
+        assert s["epochs"] == 2 and s["restarts"] == 1, s
+    assert os.path.exists(out)
+
+    # Chaos really killed host 1 (its epoch-0 trace records the
+    # injected fault) and BOTH hosts started an epoch-1 attempt (the
+    # coordinated restart).  How the survivor noticed is environment-
+    # dependent and both paths are by-design: a wedged collective is
+    # aborted by the watchdog (cluster.fault event — the stall/drop
+    # ladder scenarios and the unit tests pin that path), while this
+    # container's gloo fails fast and the Supervisor's re-raise takes
+    # the child down for the driver to restart.
+    from distkeras_tpu.obs.report import merge_traces
+
+    merged = merge_traces(traces)
+    names = [(e["host"], e["name"]) for e in merged["timeline"]]
+    assert (1, "chaos.fault") in names
+    epoch1 = [(e["host"], e["fields"].get("epoch"))
+              for e in merged["timeline"] if e["name"] == "cluster.child"]
+    assert (0, 1) in epoch1 and (1, 1) in epoch1
+
+    # Uninterrupted single-process reference over the same global data.
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 64, (64, 17)).astype(np.int32)
+    from distkeras_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_len=17)
+    t = dk.LMTrainer(cfg, optimizer="sgd", learning_rate=0.05,
+                     batch_size=16, num_epoch=1)
+    params = t.train(tokens)
+    import jax
+
+    ref = {"/".join(map(str, p)): np.asarray(v) for p, v in
+           jax.tree_util.tree_flatten_with_path(params)[0]}
+    got = np.load(out)
+    # Killed at round 3 with rounds 1-2 committed: the resumed attempt
+    # replays rounds 3-4 only.
+    np.testing.assert_allclose(got["losses"], np.asarray(t.history)[2:],
+                               rtol=1e-4, atol=1e-5)
+    for k, v in ref.items():
+        np.testing.assert_allclose(got[k], v, rtol=1e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+@pytest.mark.slow
+@pytest.mark.multihost
+@pytest.mark.multiprocess
+def test_chaos_suite_cluster_ladder(tmp_path):
+    """`chaos_suite.py --cluster`: the full fault ladder (host-kill,
+    heartbeat-stall, partition), each scenario's resumed weights
+    BIT-FOR-BIT against an uninterrupted 2-process reference, plus the
+    machine-readable cross-host fault/recovery timeline assembled by
+    the obs_report --merge machinery."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "chaos_suite.py"),
+         "--cluster", "--workdir", str(tmp_path / "w")],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "all scenarios passed" in proc.stdout
+    # The timeline is machine-readable: JSON object lines with host +
+    # event fields, containing the injected fault and the watchdog
+    # trip.
+    lines = [l for l in proc.stdout.splitlines()
+             if l.startswith("{")]
+    events = [json.loads(l) for l in lines]
+    assert any(e["event"] == "chaos.fault" for e in events)
+    assert any(e["event"] == "cluster.fault" for e in events)
+    assert all("t" in e and "host" in e for e in events)

@@ -8,6 +8,7 @@ draft model must degrade to the plain decode path, not kill requests.
 """
 
 import sys
+import time
 
 import jax
 import numpy as np
@@ -226,6 +227,40 @@ def test_supervisor_retries_checkpoint_save_fault(tmp_path):
         sup.run(ds)
     assert [a.outcome for a in sup.attempts] == ["fault", "ok"]
     assert sup.attempts[1].resumed_from == 4  # durable through round 4
+
+
+@pytest.mark.chaos
+def test_sigterm_interrupts_backoff_immediately(tmp_path):
+    """A SIGTERM arriving during the backoff window must not ride out
+    the sleep: the Supervisor's backoff waits on the preempt event, so
+    the preemption cuts it short and the next attempt's first round
+    boundary runs the normal forced-sync-checkpoint path.  The
+    regression: a 30 s backoff used to delay the preemption checkpoint
+    by the full 30 s — well past any eviction notice."""
+    import threading
+
+    x, y = make_blobs(n=128)
+    ds = dk.Dataset.from_arrays(x, y)
+    t = dk.SingleTrainer(make_mlp(), checkpoint_dir=str(tmp_path / "c"),
+                         checkpoint_every=1, checkpoint_backend="pickle",
+                         **COMMON)
+    sup = Supervisor(t, max_retries=2, backoff=30.0, max_backoff=30.0,
+                     jitter=0.0, handle_sigterm=False)
+    # Deliver the "SIGTERM" around the fault retry's backoff window
+    # (the chaos probe outranks the preempt check at a round boundary,
+    # so the round-1 fault fires first in every interleaving).
+    threading.Timer(0.5, sup.preempt_event.set).start()
+    t0 = time.monotonic()
+    with FaultPlan().fail("train.round", at=1):
+        sup.run(ds)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 15.0, (
+        f"backoff was not interrupted: run took {elapsed:.1f}s against "
+        "a 30s backoff")
+    outcomes = [a.outcome for a in sup.attempts]
+    # fault -> (interrupted backoff) -> preempted at the next round
+    # boundary -> clean resumed finish.
+    assert outcomes == ["fault", "preempted", "ok"], outcomes
 
 
 @pytest.mark.chaos
@@ -453,6 +488,161 @@ def test_draft_fault_falls_back_and_completes_greedy_parity(rng):
         eng.step()
     np.testing.assert_array_equal(
         eng.drain(lc), np.asarray(generate(tp, pa[None], CFG, 4))[0])
+
+
+def test_enqueue_vs_shutdown_race_is_atomic(params, rng):
+    """`begin_shutdown` racing in-flight `enqueue`s: the closed check
+    and the queue insert are atomic under one lock, and EngineClosed
+    wins — every enqueue either gets its request in (and shutdown's
+    drain reaches a terminal result for it) or raises EngineClosed;
+    QueueFull only ever comes from an engine that is open.  No request
+    may be silently lost."""
+    import threading
+
+    prompt = rng.integers(0, 64, (3,)).astype(np.int32)
+    for trial in range(4):
+        # One lane, held busy by a long request, so racing enqueues
+        # only ever touch the queue — the contended structure.
+        eng = ContinuousBatcher(params, CFG, lanes=1, max_queue=4)
+        blocker = eng.enqueue(prompt, 25)
+        outcomes: list = [None] * 8
+        start = threading.Barrier(9)
+
+        def worker(i):
+            start.wait()
+            try:
+                outcomes[i] = ("rid", eng.enqueue(prompt, 2))
+            except QueueFull:
+                outcomes[i] = ("queue_full", None)
+            except EngineClosed:
+                outcomes[i] = ("closed", None)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        start.wait()
+        eng.begin_shutdown()
+        for t in threads:
+            t.join()
+        res = eng.shutdown(max_steps=3)
+        assert all(o is not None for o in outcomes)
+        accepted = [rid for kind, rid in outcomes if kind == "rid"]
+        # Bounded queue held under the race...
+        assert len(accepted) <= eng.max_queue
+        # ...and EVERY accepted request reached a terminal result.
+        assert blocker in res
+        for rid in accepted:
+            assert rid in res, f"request {rid} lost in the race"
+
+
+# --------------------------------------------------- elastic lane tiers
+
+
+def test_elastic_tiers_step_up_under_backpressure_and_back_down(
+        params, rng):
+    """The acceptance contract: sustained overload steps the lane tier
+    up (with scale_up_after=1, ZERO QueueFull is raised — the overflow
+    that would have raised is absorbed by the resize), requests all
+    complete with exact solo parity, and a drained idle engine steps
+    back down.  Tier moves are obs-visible."""
+    from distkeras_tpu import obs
+
+    prompts = [rng.integers(0, 64, (p,)).astype(np.int32)
+               for p in (3, 5, 4, 6, 3)]
+    with obs.session() as sess:
+        eng = ContinuousBatcher(params, CFG, lane_tiers=(1, 2, 4),
+                                max_queue=1, scale_up_after=1,
+                                scale_down_after=2, prompt_buckets=(8,))
+        assert eng.lanes == 1
+        rids = [eng.enqueue(p, 6) for p in prompts]   # never raises
+        assert eng.lanes == 4, "sustained overflow did not scale up"
+        while any(eng.poll(r) is None for r in rids):
+            eng.step()
+        for _ in range(6):
+            eng.step()               # idle: tier steps down 4->2->1
+        assert eng.lanes == 1, "idle engine did not scale back down"
+        snap = sess.registry.snapshot()
+    res = {r: eng.take(r) for r in rids}
+    for rid, p in zip(rids, prompts):
+        assert res[rid].ok
+        np.testing.assert_array_equal(
+            res[rid].tokens,
+            np.asarray(generate(params, p[None], CFG, 6))[0])
+    resizes = {tuple(s["labels"].items()): s["value"]
+               for s in snap["serving.resizes"]["series"]}
+    assert resizes[(("direction", "up"),)] == 2
+    assert resizes[(("direction", "down"),)] == 2
+    assert "queue_full" not in str(snap.get("serving.rejected", ""))
+
+
+def test_elastic_rejects_bare_submit_and_undeclared_windows(params, rng):
+    eng = ContinuousBatcher(params, CFG, lane_tiers=(1, 2), max_queue=1,
+                            prompt_buckets=(8,), step_windows=(1, 4))
+    p = rng.integers(0, 64, (3,)).astype(np.int32)
+    with pytest.raises(ValueError, match="enqueue"):
+        eng.submit(p, 4)
+    rid = eng.enqueue(p, 4)
+    with pytest.raises(ValueError, match="step_windows"):
+        eng.step(3)
+    eng.step(4)                      # declared window: fine
+    while eng.poll(rid) is None:
+        eng.step()
+    assert eng.take(rid).ok
+    with pytest.raises(ValueError, match=">= 2 distinct tiers"):
+        ContinuousBatcher(params, CFG, lane_tiers=(4,), max_queue=1)
+    with pytest.raises(ValueError, match="max_queue"):
+        ContinuousBatcher(params, CFG, lane_tiers=(1, 2))
+    with pytest.raises(ValueError, match="include 1"):
+        ContinuousBatcher(params, CFG, lane_tiers=(1, 2), max_queue=1,
+                          step_windows=(4,))
+
+
+def test_elastic_scale_up_after_counts_strikes(params, rng):
+    """scale_up_after=2: the first overflow raises QueueFull (strike
+    one), the second resizes instead — backpressure must be SUSTAINED
+    before the engine spends memory on a bigger tier."""
+    eng = ContinuousBatcher(params, CFG, lane_tiers=(1, 2), max_queue=1,
+                            scale_up_after=2, prompt_buckets=(8,))
+    p = rng.integers(0, 64, (3,)).astype(np.int32)
+    ra = eng.enqueue(p, 4)
+    rb = eng.enqueue(p, 4)           # queued
+    with pytest.raises(QueueFull):
+        eng.enqueue(p, 4)            # strike 1: still tier 1
+    assert eng.lanes == 1
+    rc = eng.enqueue(p, 4)           # strike 2: resize absorbs it
+    assert eng.lanes == 2
+    res = eng.shutdown()
+    assert res[ra].ok and res[rb].ok and res[rc].ok
+
+
+@pytest.mark.slow
+def test_elastic_resize_preserves_inflight_requests(params, rng):
+    """A tier move mid-decode must not disturb running lanes: requests
+    admitted before, across, and after resizes all keep exact solo
+    parity (the lane compaction gathers their device rows)."""
+    eng = ContinuousBatcher(params, CFG, lane_tiers=(1, 2, 4),
+                            max_queue=2, scale_up_after=1,
+                            scale_down_after=2, prompt_buckets=(8,))
+    pa = rng.integers(0, 64, (4,)).astype(np.int32)
+    pb = rng.integers(0, 64, (6,)).astype(np.int32)
+    ra = eng.enqueue(pa, 12)
+    eng.step(); eng.step()           # ra decodes at tier 1
+    rbs = [eng.enqueue(pb, 5) for _ in range(5)]  # forces tier up
+    assert eng.lanes == 4
+    while any(eng.poll(r) is None for r in [ra, *rbs]):
+        eng.step()
+    for _ in range(6):
+        eng.step()                   # drain: tier steps back down 4->2->1
+    assert eng.lanes == 1
+    res = eng.results()
+    np.testing.assert_array_equal(
+        res[ra].tokens, np.asarray(generate(params, pa[None], CFG,
+                                            12))[0])
+    for r in rbs:
+        np.testing.assert_array_equal(
+            res[r].tokens, np.asarray(generate(params, pb[None], CFG,
+                                               5))[0])
 
 
 def test_speculative_deadline_and_queue(rng, fake_clock):

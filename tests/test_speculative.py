@@ -348,3 +348,84 @@ def test_windowed_small_ring_matches_big_cache_sampled(rng, kv_int8):
     out, _ = speculative_generate(params, draft, prompt, WIN,
                                   WIN_DRAFT, 25, **kw)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+# ------------------------- ring-cache-compatible serving fallback (PR 5)
+
+
+@pytest.mark.chaos
+def test_rolling_batcher_draft_fault_fallback_past_max_len(rng):
+    """The ROADMAP follow-up closed by PR 5: a SpeculativeBatcher on
+    rolling/ring-slot lanes must degrade to plain decode when the
+    draft model faults, PRESERVING ring-slot state — the fallback
+    inherits the lanes' unbounded positions and wrapped ring slabs
+    mid-flight, so greedy parity with solo rolling generate holds past
+    max_len through the degradation."""
+    from distkeras_tpu.resilience import FaultInjected, FaultPlan
+    from distkeras_tpu.serving import SpeculativeBatcher
+
+    params, draft = _models(WIN, WIN_DRAFT)
+    eng = SpeculativeBatcher(params, draft, WIN, WIN_DRAFT, lanes=2,
+                             n_draft=3)
+    pa = np.asarray(rng.integers(1, 64, (5,)), np.int32)
+    pb = np.asarray(rng.integers(1, 64, (3,)), np.int32)
+    la = eng.submit(pa, 25)          # 5 + 25 = 30 >> max_len=16: wraps
+    for _ in range(4):               # healthy speculative rounds first:
+        eng.step()                   # lane A's ring is mid-wrap
+    lb = eng.submit(pb, 20)          # admitted while A wraps
+    with FaultPlan().fail("serving.draft"):
+        eng.step()                   # draft faults mid-wrap
+    assert eng.degraded
+    assert isinstance(eng.degraded_error, FaultInjected)
+    while eng.running():
+        eng.step()
+    np.testing.assert_array_equal(
+        eng.drain(la),
+        np.asarray(generate(params, pa[None], WIN, 25))[0])
+    np.testing.assert_array_equal(
+        eng.drain(lb),
+        np.asarray(generate(params, pb[None], WIN, 20))[0])
+    # A degraded rolling engine still admits fresh wrapping requests.
+    lc = eng.submit(pa, 18)
+    while lc in eng.running():
+        eng.step()
+    np.testing.assert_array_equal(
+        eng.drain(lc),
+        np.asarray(generate(params, pa[None], WIN, 18))[0])
+
+
+@pytest.mark.slow
+def test_rolling_batcher_healthy_matches_solo_and_validates(rng):
+    """Healthy rolling speculative lanes match solo rolling
+    speculative_generate (== rolling generate, greedy); the engine's
+    ring bound and rolling-eligibility checks reject loudly; rolling
+    budgets cap only the PROMPT."""
+    from distkeras_tpu.serving import SpeculativeBatcher
+
+    params, draft = _models(WIN, WIN_DRAFT)
+    eng = SpeculativeBatcher(params, draft, WIN, WIN_DRAFT, lanes=2,
+                             n_draft=3)
+    p = np.asarray(rng.integers(1, 64, (4,)), np.int32)
+    lane = eng.submit(p, 24)         # no total-length cap on the ring
+    while lane in eng.running():
+        eng.step()
+    np.testing.assert_array_equal(
+        eng.drain(lane),
+        np.asarray(generate(params, p[None], WIN, 24))[0])
+    # Prompt must still fit the ring's admission chunk.
+    with pytest.raises(ValueError, match="admission bucket"):
+        eng.submit(np.asarray(rng.integers(1, 64, (17,)), np.int32), 2)
+    # Mixed full/windowed model pairs stay rejected.
+    full_draft = dataclasses.replace(WIN_DRAFT, attention_window=None)
+    with pytest.raises(ValueError, match="agree"):
+        SpeculativeBatcher(params, draft, WIN, full_draft, lanes=1,
+                           n_draft=2)
+    # The ring bound: window + n_draft + 1 must fit max_len.
+    with pytest.raises(ValueError, match="rejected tail"):
+        SpeculativeBatcher(params, draft, WIN, WIN_DRAFT, lanes=1,
+                           n_draft=12)
+    # Windowed without rope has no rolling semantics.
+    norope = dataclasses.replace(WIN, rope=False)
+    with pytest.raises(ValueError, match="rope"):
+        SpeculativeBatcher(params, draft, norope, WIN_DRAFT, lanes=1,
+                           n_draft=2)
