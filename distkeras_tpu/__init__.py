@@ -61,8 +61,10 @@ from distkeras_tpu.utils.serialization import (
 )
 from distkeras_tpu import obs
 from distkeras_tpu.models.adapter import ModelAdapter, TrainState
-from distkeras_tpu.parallel import collectives
+from distkeras_tpu.parallel import collectives, exchange
 from distkeras_tpu.parallel.collectives import zero1_optimizer
+from distkeras_tpu.parallel.exchange import (ExchangeConfig,
+                                              exchange_optimizer)
 from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
 from distkeras_tpu.parallel.sharding import (ShardingPlan, dp_plan,
                                               fsdp_plan, tp_plan,
@@ -120,6 +122,9 @@ __all__ = [
     "zero1_plan",
     "zero1_optimizer",
     "collectives",
+    "exchange",
+    "ExchangeConfig",
+    "exchange_optimizer",
     "obs",
     "Dataset",
     "pack_documents",

@@ -80,13 +80,23 @@ class TraceSpec:
 
 @dataclasses.dataclass(frozen=True)
 class CollectiveOp:
-    """One collective in the compiled program (aggregated by kind)."""
+    """One collective in the compiled program (aggregated by kind).
+
+    ``dtype`` is the HLO element type of the payload ("f32", "s8",
+    "bf16", ... — "+"-joined when a tuple-shaped collective mixes
+    types).  Payload bytes were always computed from the compiled
+    shapes, so compressed exchanges were never *miscounted*; recording
+    the dtype makes the budget PROVE the wire carries int8, not f32 —
+    a census that only showed byte totals could silently pass an
+    exchange that decompressed before the wire.
+    """
 
     op: str               # HLO opcode as compiled
     canonical: str        # opcode after AR+slice canonicalization
     payload_bytes: int
     group_size: int
     count: int = 1
+    dtype: str = "f32"
 
     @property
     def wire_bytes(self) -> float:
@@ -103,6 +113,7 @@ class CollectiveOp:
 
     def as_json(self) -> dict:
         return {"op": self.op, "canonical": self.canonical,
+                "dtype": self.dtype,
                 "payload_bytes": self.payload_bytes,
                 "group_size": self.group_size, "count": self.count,
                 "wire_bytes": round(self.wire_bytes, 1)}
@@ -136,6 +147,18 @@ def _shape_bytes(segment: str) -> int:
                 n *= int(d)
         total += n * _DTYPE_BYTES[dtype]
     return total
+
+
+def _shape_dtypes(segment: str) -> str:
+    """Element type(s) of a shape segment: "f32", "s8", ... — ordered,
+    de-duplicated, "+"-joined for tuple shapes mixing types ("?" when
+    no shape parses).  The census field that distinguishes an int8
+    compressed payload from the f32 it replaced."""
+    seen = []
+    for dtype, _ in _SHAPE_RE.findall(segment):
+        if dtype in _DTYPE_BYTES and dtype not in seen:
+            seen.append(dtype)
+    return "+".join(seen) or "?"
 
 
 def _group_size(line: str, default: int) -> int:
@@ -238,6 +261,7 @@ def comm_census(hlo: str, default_group: int | None = None
             payload = _operand_bytes(ins)
         else:
             payload = _shape_bytes(ins.result_seg)
+        dtype = _shape_dtypes(ins.result_seg)
         canonical = op
         if op == "all-reduce" and n > 1:
             consumers = [c for c in instrs.values()
@@ -249,14 +273,15 @@ def comm_census(hlo: str, default_group: int | None = None
                     for c in consumers):
                 canonical = "reduce-scatter"
         raw.append(CollectiveOp(op=op, canonical=canonical,
-                                payload_bytes=payload, group_size=n))
+                                payload_bytes=payload, group_size=n,
+                                dtype=dtype))
     # Aggregate identical ops so the census is order-stable.
     agg: dict[tuple, int] = {}
     for c in raw:
-        key = (c.op, c.canonical, c.payload_bytes, c.group_size)
+        key = (c.op, c.canonical, c.payload_bytes, c.group_size, c.dtype)
         agg[key] = agg.get(key, 0) + 1
     return [CollectiveOp(op=k[0], canonical=k[1], payload_bytes=k[2],
-                         group_size=k[3], count=v)
+                         group_size=k[3], dtype=k[4], count=v)
             for k, v in sorted(agg.items())]
 
 

@@ -35,7 +35,7 @@ def _lm_cfg():
                                  n_layers=2, d_ff=64, max_len=16)
 
 
-def _mlp_trainer(zero1: bool):
+def _mlp_trainer(zero1: bool = False, **kw):
     import keras
 
     import distkeras_tpu as dk
@@ -47,7 +47,8 @@ def _mlp_trainer(zero1: bool):
                               keras.layers.Dense(8)])
     return dk.ADAG(model, loss="sparse_categorical_crossentropy",
                    worker_optimizer="adam", learning_rate=0.05,
-                   batch_size=4, communication_window=2, zero1=zero1)
+                   batch_size=4, communication_window=2, zero1=zero1,
+                   **kw)
 
 
 def _mlp_dataset():
@@ -64,7 +65,12 @@ def _mlp_dataset():
 def adag_targets() -> list[TraceSpec]:
     ds = _mlp_dataset()
     specs = (_mlp_trainer(zero1=False).traced_for_analysis(ds)
-             + _mlp_trainer(zero1=True).traced_for_analysis(ds))
+             + _mlp_trainer(zero1=True).traced_for_analysis(ds)
+             # Exchange-layer variants (docs/lowcomm.md): the adasum
+             # merge and the local-SGD period whose census pins the
+             # 1/H per-step collective-count claim.
+             + _mlp_trainer(merge_rule="adasum").traced_for_analysis(ds)
+             + _mlp_trainer(sync_every=4).traced_for_analysis(ds))
     return _pair(specs)
 
 
@@ -73,7 +79,12 @@ def lm_targets() -> list[TraceSpec]:
 
     cfg = _lm_cfg()
     specs = []
-    for kw in ({}, {"zero1": True}, {"fsdp": True}):
+    # compress="int8": the error-feedback exchange whose census pins
+    # the <= 1/4 gradient-wire-bytes claim (s8 payloads) against the
+    # dp baseline; zero1 x int8 pins the compressed reduce-scatter leg.
+    for kw in ({}, {"zero1": True}, {"fsdp": True},
+               {"compress": "int8"},
+               {"zero1": True, "compress": "int8"}):
         t = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=8, **kw)
         specs += t.traced_for_analysis()
     return _pair(specs)

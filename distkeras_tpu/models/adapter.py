@@ -194,7 +194,10 @@ class ModelAdapter:
 
         return train_step
 
-    def make_accum_train_step(self, window: int) -> Callable:
+    def make_accum_train_step(self, window: int,
+                              value_and_grad: Callable | None = None,
+                              grad_axis_size: int | None = None,
+                              probe: bool = False) -> Callable:
         """Build a gradient-accumulation step over ``window`` microbatches.
 
         ``step(state, xs, ys)`` with ``xs: [window, B, ...]`` scans the
@@ -203,13 +206,34 @@ class ModelAdapter:
         the reference's ``communication_window`` commit cadence
         (distkeras/workers.py: workers accumulate for N batches then
         commit to the parameter server) — see SURVEY.md §7.4.
+
+        ``value_and_grad`` (default ``jax.value_and_grad``) is the
+        gradient-construction hook, same contract as the transformer's
+        (models/transformer.make_train_step): it receives the loss fn
+        and must return a ``(loss, aux), grads``-shaped callable.  The
+        distributed trainers' gradient-exchange configurations pass a
+        shard_map-local construction that returns STACKED per-replica
+        gradients (leading axis ``grad_axis_size``) for the exchange
+        optimizer to merge (parallel/exchange.py).
+
+        ``probe=True``: the step returns ``(state, (loss, aux))`` with
+        ``aux = {"grad_norm": ...}`` computed in-graph (the opt-in
+        diagnostics probe; same program count — the trainers declare
+        the compile-budget delta, which is zero extra programs).
         """
         compute_loss = self.make_loss_fn()
         optimizer = self.optimizer
+        vag = (jax.value_and_grad if value_and_grad is None
+               else value_and_grad)
 
         def train_step(state: TrainState, xs, ys):
-            grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-            zero = jax.tree.map(jnp.zeros_like, state.tv)
+            grad_fn = vag(compute_loss, has_aux=True)
+            if grad_axis_size is None:
+                zero = jax.tree.map(jnp.zeros_like, state.tv)
+            else:
+                zero = jax.tree.map(
+                    lambda v: jnp.zeros((grad_axis_size,) + v.shape,
+                                        v.dtype), state.tv)
 
             def micro(carry, batch):
                 g_acc, ntv, loss_acc = carry
@@ -223,8 +247,85 @@ class ModelAdapter:
             grads = jax.tree.map(lambda g: g / window, g_sum)
             updates, opt_state = optimizer.update(grads, state.opt_state, state.tv)
             tv = jax.tree.map(lambda p, u: p + u, state.tv, updates)
-            return TrainState(tv=tv, ntv=ntv2, opt_state=opt_state,
-                              step=state.step + 1), loss_sum / window
+            out_state = TrainState(tv=tv, ntv=ntv2, opt_state=opt_state,
+                                   step=state.step + 1)
+            loss = loss_sum / window
+            if probe:
+                import optax
+
+                return out_state, (loss,
+                                   {"grad_norm": optax.global_norm(grads)})
+            return out_state, loss
+
+        return train_step
+
+    def make_localsgd_accum_step(self, window: int, sync_every: int,
+                                 mesh, config, axis: str = "data"
+                                 ) -> Callable:
+        """Local-SGD over the accumulation step (parallel/exchange.py):
+        ``step(state, xs, ys)`` with ``xs: [sync_every, window, GB, ...]``
+        runs, per replica INSIDE a shard_map over ``axis``,
+        ``sync_every`` purely-local rounds (each a ``window``-microbatch
+        accumulation + local optimizer update on this replica's batch
+        shard), then ONE cross-replica merge: parameter deltas by the
+        configured rule (mean / adasum) and floating optimizer-state
+        leaves averaged (the momentum-aware sync).  Collective
+        frequency drops to 1/``sync_every`` of the synchronous step's.
+
+        Loss reported is the cross-replica mean of the per-replica mean
+        losses over the period.  Requires a model whose non-trainable
+        variables do not update cross-batch (BatchNorm is rejected by
+        the trainers): a replica-local ntv update would diverge.
+        """
+        from distkeras_tpu.parallel.compat import shard_map as smap
+        from distkeras_tpu.parallel.exchange import (merge_local_params,
+                                                     sync_local_tree)
+        from jax.sharding import PartitionSpec as P
+
+        compute_loss = self.make_loss_fn()
+        optimizer = self.optimizer
+        n = int(mesh.shape[axis])
+
+        def train_step(state: TrainState, xs, ys):
+            def local_run(tv0, ntv0, opt0, xs, ys):
+                grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+
+                def local_round(carry, batch):
+                    tv, ntv, opt = carry
+                    xw, yw = batch          # [window, b_local, ...]
+                    zero = jax.tree.map(jnp.zeros_like, tv)
+
+                    def micro(c, b):
+                        g_acc, ntv_c, loss_acc = c
+                        x, y = b
+                        (loss, ntv2), g = grad_fn(tv, ntv_c, x, y)
+                        return (jax.tree.map(jnp.add, g_acc, g), ntv2,
+                                loss_acc + loss), None
+
+                    (g_sum, ntv2, loss_sum), _ = jax.lax.scan(
+                        micro, (zero, ntv, jnp.zeros(())), (xw, yw))
+                    grads = jax.tree.map(lambda g: g / window, g_sum)
+                    u, opt = optimizer.update(grads, opt, tv)
+                    tv = jax.tree.map(lambda p, q: p + q, tv, u)
+                    return (tv, ntv2, opt), loss_sum / window
+
+                (tv, ntv, opt), losses = jax.lax.scan(
+                    local_round, (tv0, ntv0, opt0), (xs, ys))
+                tv = merge_local_params(tv0, tv, config, axis, n)
+                opt = sync_local_tree(opt, config, axis, n)
+                ntv = sync_local_tree(ntv, config, axis, n)
+                return tv, ntv, opt, jax.lax.pmean(
+                    jnp.mean(losses), axis)
+
+            tv, ntv, opt, loss = smap(
+                local_run, mesh=mesh,
+                in_specs=(P(), P(), P(), P(None, None, axis),
+                          P(None, None, axis)),
+                out_specs=(P(), P(), P(), P()),
+                check_vma=False)(list(state.tv), list(state.ntv),
+                                 state.opt_state, xs, ys)
+            return TrainState(tv=tv, ntv=ntv, opt_state=opt,
+                              step=state.step + sync_every), loss
 
         return train_step
 

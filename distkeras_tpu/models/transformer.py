@@ -924,7 +924,8 @@ def make_train_step(cfg: TransformerConfig, optimizer,
                     grad_accum: int = 1,
                     hidden_fn: Callable | None = None,
                     loss_fn: Callable | None = None,
-                    value_and_grad: Callable | None = None):
+                    value_and_grad: Callable | None = None,
+                    probe: bool = False):
     """``step((params, opt_state), tokens) -> ((params', opt_state'), loss)``.
 
     Pure; callers jit it with NamedShardings (see __graft_entry__ and
@@ -950,6 +951,12 @@ def make_train_step(cfg: TransformerConfig, optimizer,
     two gradient contributions *before* the cross-replica exchange
     (trainers/lm.py ``_dp_local_value_and_grad``) — XLA's CPU
     partitioner otherwise all-reduces them separately.
+
+    ``probe=True``: the step returns ``(carry, (loss, aux))`` with
+    ``aux = {"grad_norm": ...}`` computed in-graph — LMTrainer's
+    opt-in diagnostics probe (same program count either way; under the
+    stacked-local-gradient exchange the norm is over the stacked
+    per-replica tree).
     """
     dropping = cfg.dropout > 0
     if value_and_grad is None:
@@ -970,6 +977,10 @@ def make_train_step(cfg: TransformerConfig, optimizer,
             loss, grads = grad_fn(params, tokens, cfg, attention_fn,
                                   apply_fn, rng, hidden_fn, segment_ids)
         else:
+            # NOTE: a stacked-local value_and_grad returns [n, *leaf]
+            # gradients; the zeros_like(params) accumulator broadcasts
+            # against them on the first add, so accumulation works for
+            # both layouts.
             grads = jax.tree.map(jnp.zeros_like, params)
             loss = jnp.zeros((), jnp.float32)
             for i in range(grad_accum):
@@ -984,6 +995,11 @@ def make_train_step(cfg: TransformerConfig, optimizer,
             loss = loss / grad_accum
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
+        if probe:
+            import optax
+
+            return (params, opt_state), (
+                loss, {"grad_norm": optax.global_norm(grads)})
         return (params, opt_state), loss
 
     return step

@@ -46,6 +46,13 @@ whole ``ops/optimizers.py`` name set; see
 drop-in ``optax.GradientTransformation``, so every trainer that calls
 ``optimizer.update`` — the Keras accumulation step, LMTrainer's train
 step, the EMA/clip chains — picks it up unchanged.
+
+The bucketed layout here is also the substrate of the pluggable
+**gradient-exchange layer** (``parallel/exchange.py``): Adasum merging,
+local-SGD periodic sync, and error-feedback int8/top-k compression all
+operate per fusion bucket, and the int8 codec composes with ZeRO-1 by
+compressing exactly the reduce-scatter leg of this module's exchange
+(docs/lowcomm.md).
 """
 
 from __future__ import annotations
@@ -264,7 +271,70 @@ def all_gather(x, mesh: Mesh, axis: str = "data"):
                      out_specs=P(None, None), check_vma=False)(x)
 
 
+def adasum_reduce(x, mesh: Mesh, axis: str = "data"):
+    """Adasum merge primitive (shard_map): ``[n, C]`` whose *rows are
+    per-replica addends* (the :func:`reduce_scatter` contract) ->
+    their pairwise adaptive sum ``[C]``, replicated on every replica
+    (arXiv 2006.02924; rule in ``parallel/exchange.py``).
+
+    The standalone spelling of the bucketed exchange layer's
+    ``merge_rule="adasum"`` for manual-SPMD callers and for testing
+    the merge math in isolation: identical replicas reproduce the
+    value itself (== mean-reduce of agreeing replicas), orthogonal
+    replicas reproduce the plain sum.
+    """
+    from distkeras_tpu.parallel.exchange import adasum_combine
+
+    n = int(mesh.shape[axis])
+    if x.ndim != 2 or x.shape[0] != n:
+        raise ValueError(
+            f"adasum_reduce takes [n, C] with n == the {axis!r} axis "
+            f"size ({n}); got shape {tuple(x.shape)}")
+
+    def body(s):  # [1, C] — this replica's addend
+        stacked = jax.lax.all_gather(s[0], axis, axis=0)  # [n, C]
+        return adasum_combine(stacked).astype(s.dtype)
+
+    return shard_map(body, mesh=mesh, in_specs=P(axis, None),
+                     out_specs=P(None), check_vma=False)(x)
+
+
 # ------------------------------------------------------------ the wrapper
+
+
+def zero1_validate(mesh: Mesh, spec, axis: str = "data") -> None:
+    """The zero1 enablement checks, shared by :func:`zero1_enable` and
+    the exchange layer's zero1+int8 composition
+    (``parallel/exchange.py``): pure-``axis`` mesh, and an optimizer
+    whose update rule is per-leaf elementwise
+    (``ops.optimizers.zero1_compatible`` — known-unsafe raises,
+    uninspectable warns)."""
+    for ax, size in mesh.shape.items():
+        if ax != axis and int(size) > 1:
+            raise ValueError(
+                f"zero1=True composes with the {axis} axis only, but the "
+                f"mesh has {ax}={int(size)}; zero1 shards the update of "
+                "*replicated* parameters — use fsdp/TP plans when the "
+                "parameters themselves shard")
+    from distkeras_tpu.ops.optimizers import zero1_compatible
+
+    compat = zero1_compatible(spec)
+    if compat is False:
+        raise ValueError(
+            f"optimizer {spec!r} is known-incompatible with the zero1 "
+            "sharded update (its update rule mixes elements within a "
+            "leaf, so sharding changes the math); train it replicated "
+            "or under fsdp")
+    if compat is None:
+        import warnings
+
+        warnings.warn(
+            "zero1=True with a prebuilt/factory optax optimizer that "
+            "cannot be verified elementwise: the sharded update is "
+            "math-identical only for per-leaf elementwise update rules; "
+            "transforms mixing elements within a leaf (LARS/LAMB trust "
+            "ratios, Shampoo preconditioners) will silently diverge",
+            stacklevel=3)
 
 
 def zero1_optimizer(inner: optax.GradientTransformation, mesh: Mesh,
@@ -357,32 +427,7 @@ def zero1_enable(inner: optax.GradientTransformation, mesh: Mesh,
       prebuilt transform) against ``ops.optimizers.zero1_compatible``:
       known-unsafe raises, uninspectable warns.
     """
-    for ax, size in mesh.shape.items():
-        if ax != axis and int(size) > 1:
-            raise ValueError(
-                f"zero1=True composes with the {axis} axis only, but the "
-                f"mesh has {ax}={int(size)}; zero1 shards the update of "
-                "*replicated* parameters — use fsdp/TP plans when the "
-                "parameters themselves shard")
-    from distkeras_tpu.ops.optimizers import zero1_compatible
-
-    compat = zero1_compatible(spec if spec is not None else inner)
-    if compat is False:
-        raise ValueError(
-            f"optimizer {spec!r} is known-incompatible with the zero1 "
-            "sharded update (its update rule mixes elements within a "
-            "leaf, so sharding changes the math); train it replicated "
-            "or under fsdp")
-    if compat is None:
-        import warnings
-
-        warnings.warn(
-            "zero1=True with a prebuilt/factory optax optimizer that "
-            "cannot be verified elementwise: the sharded update is "
-            "math-identical only for per-leaf elementwise update rules; "
-            "transforms mixing elements within a leaf (LARS/LAMB trust "
-            "ratios, Shampoo preconditioners) will silently diverge",
-            stacklevel=3)
+    zero1_validate(mesh, spec if spec is not None else inner, axis=axis)
     return zero1_optimizer(
         inner, mesh, axis=axis,
         bucket_mb=DEFAULT_BUCKET_MB if bucket_mb is None else bucket_mb)
@@ -420,5 +465,7 @@ def zero1_state_shardings(params, opt_state, mesh: Mesh,
 
 
 __all__ = ["Zero1Layout", "scatter", "reduce_scatter", "all_gather",
-           "zero1_optimizer", "zero1_enable", "zero1_shard_shapes",
-           "zero1_state_shardings", "DEFAULT_BUCKET_MB"]
+           "adasum_reduce", "zero1_optimizer", "zero1_enable",
+           "zero1_validate",
+           "zero1_shard_shapes", "zero1_state_shardings",
+           "DEFAULT_BUCKET_MB"]

@@ -193,6 +193,38 @@ class Zero1Plan(ShardingPlan):
         )
 
 
+class ExchangePlan(ShardingPlan):
+    """Data parallelism under a non-default gradient-exchange policy
+    (``parallel/exchange.py``): parameters replicate like
+    :func:`dp_plan`, but the optimizer state may carry error-feedback
+    residuals (sharded over their leading replica axis) and — when the
+    int8 codec composes with ZeRO-1 — scattered ``[n, cols]`` shard
+    views.  One shared sharding rule
+    (``exchange.exchange_state_shardings``) covers both.
+    """
+
+    def __init__(self, config, zero1: bool = False):
+        super().__init__(rules=(), batch_spec=P("data"))
+        self.exchange = config
+        self.zero1 = zero1
+        self.bucket_mb = config.bucket_mb
+
+    def state_shardings(self, mesh: Mesh, state, tv_paths: Sequence[str]):
+        from distkeras_tpu.models.adapter import TrainState
+        from distkeras_tpu.parallel.exchange import (
+            exchange_state_shardings)
+
+        rep = NamedSharding(mesh, P())
+        return TrainState(
+            tv=[rep for _ in state.tv],
+            ntv=jax.tree.map(lambda _: rep, state.ntv),
+            opt_state=exchange_state_shardings(
+                list(state.tv), state.opt_state, mesh,
+                zero1=self.zero1),
+            step=rep,
+        )
+
+
 def dp_plan() -> ShardingPlan:
     """Pure data parallelism: replicate weights, split batch on ``data``."""
     return ShardingPlan(rules=(), batch_spec=P("data"))

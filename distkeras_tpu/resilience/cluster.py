@@ -398,6 +398,18 @@ class ClusterSupervisor:
     - give up after ``max_restarts`` coordinated restarts
       (:class:`ClusterGivenUp`).
 
+    **Flap dampening** (``healthy_uptime``): a link that partitions
+    every few minutes would burn the whole restart budget in a day
+    even though each epoch between flaps made real progress.  When an
+    attempt runs at least ``healthy_uptime`` seconds before dying, the
+    restart budget is REFUNDED (the counter resets to zero before the
+    failure is charged): ``max_restarts`` then bounds *consecutive
+    rapid* failures — the crash-loop it exists to stop — instead of
+    lifetime flap count.  Attempts killed for exceeding
+    ``attempt_timeout`` never refund (a hung child always outlives any
+    uptime bar).  ``None`` disables the refund (the pre-PR-6
+    behavior).
+
     Stdlib-only on purpose: drivers survive anything the training
     stack does, including jax refusing to import.
     """
@@ -409,7 +421,8 @@ class ClusterSupervisor:
                  heartbeat_interval: float = 0.5,
                  checkpoint_dirs=None, max_restarts: int = 4,
                  barrier_timeout: float = 120.0,
-                 attempt_timeout: float | None = None):
+                 attempt_timeout: float | None = None,
+                 healthy_uptime: float | None = None):
         self.coord_dir = coord_dir
         self.host = host
         self.num_hosts = num_hosts
@@ -424,6 +437,11 @@ class ClusterSupervisor:
         self.max_restarts = max_restarts
         self.barrier_timeout = barrier_timeout
         self.attempt_timeout = attempt_timeout
+        if healthy_uptime is not None and healthy_uptime <= 0:
+            raise ValueError(
+                f"healthy_uptime must be positive (seconds) or None, "
+                f"got {healthy_uptime}")
+        self.healthy_uptime = healthy_uptime
         self.epochs = EpochStore(coord_dir)
         self.history: list[dict] = []   # one record per attempt
 
@@ -512,13 +530,27 @@ class ClusterSupervisor:
                     child.kill()
                     child.wait(timeout=30)
             rc = child.returncode
+            duration = time.monotonic() - t0
             self.history.append({
                 "epoch": epoch, "event": "attempt", "rc": rc,
                 "reason": reason,
-                "duration": time.monotonic() - t0})
+                "duration": duration})
             if rc == 0 and self.epochs.current() == epoch:
                 return {"host": self.host, "epochs": epoch + 1,
                         "restarts": restarts, "history": self.history}
+            if (self.healthy_uptime is not None and restarts
+                    and duration >= self.healthy_uptime
+                    and reason != "attempt timeout"):
+                # Flap dampening: the attempt was healthy long enough
+                # that this failure is a fresh fault, not the next
+                # rung of a crash loop — refund the budget before
+                # charging it.  A kill for exceeding attempt_timeout
+                # is excluded: a deterministically hung child always
+                # "survives" past healthy_uptime, and refunding it
+                # would make ClusterGivenUp unreachable.
+                self.history.append({"epoch": epoch, "event": "refund",
+                                     "restarts_forgiven": restarts})
+                restarts = 0
             self.epochs.request(epoch + 1)
             restarts += 1
             if restarts > self.max_restarts:

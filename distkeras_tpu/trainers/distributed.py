@@ -62,9 +62,23 @@ class DistributedTrainer(Trainer):
     (parallel/collectives.py).  Identical math at unchanged
     communication volume, ~num_workers x less optimizer memory and
     update compute per device; see docs/zero1.md for zero1 vs fsdp.
+
+    **Gradient-exchange policy** (docs/lowcomm.md, ADAG/DynSGD only):
+    ``merge_rule="adasum"`` replaces the mean-reduce with pairwise
+    adaptive summation (arXiv 2006.02924); ``sync_every=H`` switches to
+    local-SGD — H purely-local rounds per replica, then one
+    momentum-aware parameter merge (1/H the collective frequency);
+    ``compress="int8"``/``"topk"`` applies an error-feedback codec per
+    fusion bucket (~4x fewer gradient wire bytes for int8, pinned in
+    scripts/comm_budget.json).  ``compress="int8"`` composes with
+    ``zero1=True`` by compressing the reduce-scatter leg.
+    ``probe_metrics=True`` adds an in-graph grad-norm probe to the step
+    (``probe_history``; zero extra compiled programs — the step is one
+    program either way).
     """
 
     _supports_device_data = False
+    _supports_exchange = False
 
     def __init__(self, keras_model, loss="categorical_crossentropy",
                  worker_optimizer="sgd", learning_rate: float | None = None,
@@ -72,7 +86,10 @@ class DistributedTrainer(Trainer):
                  num_workers: int | None = None, mesh=None,
                  plan: ShardingPlan | None = None, fsdp: bool = False,
                  zero1: bool = False, zero1_bucket_mb: float | None = None,
-                 device_data: bool = False, **kw):
+                 device_data: bool = False, merge_rule: str = "mean",
+                 sync_every: int = 1, compress: str | None = None,
+                 topk_frac: float = 0.01, probe_metrics: bool = False,
+                 **kw):
         super().__init__(keras_model, loss=loss,
                          worker_optimizer=worker_optimizer,
                          learning_rate=learning_rate, batch_size=batch_size,
@@ -85,6 +102,62 @@ class DistributedTrainer(Trainer):
                 "DOWNPOUR/Averaging/Ensemble), SingleTrainer, and "
                 "LMTrainer")
         self.device_data = device_data
+        from distkeras_tpu.parallel.exchange import ExchangeConfig
+
+        exchange = ExchangeConfig(
+            merge_rule=merge_rule, sync_every=sync_every,
+            compress=compress, topk_frac=topk_frac,
+            # Under zero1 x int8 the exchange's bucket layout IS the
+            # zero1 layout, so the one bucket knob governs both.
+            **({} if zero1_bucket_mb is None
+               else {"bucket_mb": zero1_bucket_mb}))
+        self.exchange = exchange
+        self.probe_metrics = probe_metrics
+        self.probe_history: list[dict] = []
+        if (not exchange.is_default or probe_metrics) \
+                and not self._supports_exchange:
+            raise ValueError(
+                f"{type(self).__name__} does not support the gradient-"
+                "exchange options (merge_rule/sync_every/compress/"
+                "probe_metrics); they are implemented for ADAG/DynSGD "
+                "(and LMTrainer) — the replica family already has its "
+                "own communication cadence")
+        if not exchange.is_default:
+            if device_data:
+                raise ValueError(
+                    "merge_rule/sync_every/compress do not compose with "
+                    "device_data=True: the exchange layer computes "
+                    "per-replica gradients in a shard_map the indexed "
+                    "data plane does not route through")
+            if fsdp or plan is not None:
+                raise ValueError(
+                    "merge_rule/sync_every/compress build their own "
+                    "placement plan; they do not compose with fsdp=True "
+                    "or an explicit plan=")
+            if self.adapter.ntv_paths:
+                raise ValueError(
+                    "gradient-exchange options need a model without "
+                    "non-trainable training state (BatchNorm running "
+                    "stats, seeded Dropout): per-replica local updates "
+                    "would diverge it — train such models with the "
+                    "default synchronous exchange")
+            if zero1 and not (exchange.compress == "int8"
+                              and exchange.sync_every == 1):
+                raise ValueError(
+                    "zero1=True composes with compress='int8' only "
+                    "(the chunked codec compresses the reduce-scatter "
+                    "leg); adasum and local-SGD replace the exchange "
+                    "zero1 shards")
+        if probe_metrics and exchange.sync_every > 1:
+            raise ValueError(
+                "probe_metrics with sync_every > 1 is not supported: "
+                "the local-SGD period has no single per-step global "
+                "gradient to probe")
+        if probe_metrics and device_data:
+            raise ValueError(
+                "probe_metrics does not compose with device_data=True "
+                "(the indexed data plane's scanned step has no probe "
+                "output slot)")
         if sum((fsdp, zero1, plan is not None)) > 1:
             raise ValueError(
                 "pass only one of plan=, fsdp=True, zero1=True — they are "
@@ -93,13 +166,18 @@ class DistributedTrainer(Trainer):
             raise ValueError(
                 "zero1_bucket_mb only applies with zero1=True (the "
                 "plan=zero1_plan(...) spelling carries its own bucket_mb)")
-        self.plan = plan or (fsdp_plan() if fsdp
-                             else zero1_plan(zero1_bucket_mb) if zero1
-                             else dp_plan())
-        # plan=zero1_plan() is the explicit spelling of zero1=True: the
-        # plan's sharded opt-state layout only exists if the optimizer
-        # is wrapped to produce it.
-        zero1 = zero1 or bool(getattr(self.plan, "zero1", False))
+        if not exchange.is_default:
+            from distkeras_tpu.parallel.sharding import ExchangePlan
+
+            self.plan = ExchangePlan(exchange, zero1=zero1)
+        else:
+            self.plan = plan or (fsdp_plan() if fsdp
+                                 else zero1_plan(zero1_bucket_mb) if zero1
+                                 else dp_plan())
+            # plan=zero1_plan() is the explicit spelling of zero1=True:
+            # the plan's sharded opt-state layout only exists if the
+            # optimizer is wrapped to produce it.
+            zero1 = zero1 or bool(getattr(self.plan, "zero1", False))
         if mesh is not None:
             self.mesh = mesh
         else:
@@ -112,8 +190,22 @@ class DistributedTrainer(Trainer):
                     "on-device anyway")
             self.mesh = make_mesh(MeshSpec(data=n), devices=devices[:n])
         self.num_workers = int(self.mesh.shape["data"])
+        if not exchange.is_default:
+            for ax, size in self.mesh.shape.items():
+                if ax != "data" and int(size) > 1:
+                    raise ValueError(
+                        "merge_rule/sync_every/compress compose with the "
+                        f"data axis only, but the mesh has {ax}="
+                        f"{int(size)}")
         self.zero1 = zero1
-        if zero1:
+        if zero1 and exchange.compress == "int8":
+            from distkeras_tpu.parallel.collectives import zero1_validate
+            from distkeras_tpu.parallel.exchange import exchange_optimizer
+
+            zero1_validate(self.mesh, worker_optimizer)
+            self.adapter.optimizer = exchange_optimizer(
+                self.adapter.optimizer, self.mesh, exchange, zero1=True)
+        elif zero1:
             from distkeras_tpu.parallel.collectives import zero1_enable
 
             # Wrap AFTER the adapter resolved the optimizer: the wrapper
@@ -122,6 +214,11 @@ class DistributedTrainer(Trainer):
             self.adapter.optimizer = zero1_enable(
                 self.adapter.optimizer, self.mesh, spec=worker_optimizer,
                 bucket_mb=self.plan.bucket_mb)
+        elif exchange.needs_grad_exchange:
+            from distkeras_tpu.parallel.exchange import exchange_optimizer
+
+            self.adapter.optimizer = exchange_optimizer(
+                self.adapter.optimizer, self.mesh, exchange)
 
     # ------------------------------------------------------------ helpers
 
@@ -129,9 +226,40 @@ class DistributedTrainer(Trainer):
         sh = self.plan.state_shardings(self.mesh, state, self.adapter.tv_paths)
         return jax.device_put(state, sh), sh
 
-    def _batch_sharding(self, leading_window: bool):
-        spec = (P(None, "data") if leading_window else P("data"))
+    def _batch_sharding(self, leading_window: bool,
+                        leading_sync: bool = False):
+        spec = (P(None, None, "data") if leading_sync
+                else P(None, "data") if leading_window else P("data"))
         return NamedSharding(self.mesh, spec)
+
+    def _stacked_local_vag(self):
+        """``jax.value_and_grad`` replacement for the gradient-exchange
+        configurations: per-replica gradients are computed inside a
+        shard_map over ``data`` and returned STACKED (leading replica
+        axis, sharded), for :func:`exchange_optimizer` to merge.  The
+        loss is pmean'd for reporting.  The LM analogue is
+        ``LMTrainer._stacked_local_value_and_grad``."""
+        from distkeras_tpu.parallel.compat import shard_map
+        mesh = self.mesh
+
+        def value_and_grad(loss, has_aux=True):
+            vag = jax.value_and_grad(loss, has_aux=has_aux)
+
+            def wrapped(tv, ntv, x, y):
+                def body(tv, ntv, x, y):
+                    (l, ntv2), g = vag(tv, ntv, x, y)
+                    g = jax.tree.map(lambda v: v[None], g)
+                    return (jax.lax.pmean(l, "data"), ntv2), g
+
+                return shard_map(
+                    body, mesh=mesh,
+                    in_specs=(P(), P(), P("data"), P("data")),
+                    out_specs=((P(), P()), P("data")),
+                    check_vma=False)(tv, ntv, x, y)
+
+            return wrapped
+
+        return value_and_grad
 
     # Batch staging shares one definition with LMTrainer
     # (parallel.mesh.global_batch): process-local slab assembly
@@ -151,17 +279,36 @@ class ADAG(DistributedTrainer):
     """
 
     _supports_device_data = True
+    _supports_exchange = True
 
     def __init__(self, keras_model, communication_window: int = 12, **kw):
         super().__init__(keras_model, **kw)
         self.communication_window = communication_window
+
+    def _accum_step_fn(self):
+        """The (un-jitted) round step for this exchange configuration:
+        local-SGD when ``sync_every > 1``, the stacked-local-gradient
+        accumulation step when a merge rule/codec needs per-replica
+        gradients, the plain accumulation step otherwise."""
+        ex = self.exchange
+        w = self.communication_window
+        if ex.sync_every > 1:
+            return self.adapter.make_localsgd_accum_step(
+                w, ex.sync_every, self.mesh, ex)
+        if ex.needs_grad_exchange:
+            return self.adapter.make_accum_train_step(
+                w, value_and_grad=self._stacked_local_vag(),
+                grad_axis_size=self.num_workers,
+                probe=self.probe_metrics)
+        return self.adapter.make_accum_train_step(
+            w, probe=self.probe_metrics)
 
     def _jit_accum_step(self, state_sh, batch_sh):
         """THE jitted accumulation step of the streaming path — built
         here once so ``_fit`` and :meth:`traced_for_analysis` can never
         drift apart (the IR lint must audit the program that trains)."""
         return jax.jit(
-            self.adapter.make_accum_train_step(self.communication_window),
+            self._accum_step_fn(),
             in_shardings=(state_sh, batch_sh, batch_sh),
             out_shardings=(state_sh, NamedSharding(self.mesh, P())),
             donate_argnums=0,
@@ -193,6 +340,7 @@ class ADAG(DistributedTrainer):
         from distkeras_tpu.analysis.ir_lint import TraceSpec
 
         w = self.communication_window
+        H = self.exchange.sync_every
         state = jax.eval_shape(self.adapter.init_state)
         state_sh = self.plan.state_shardings(self.mesh, state,
                                              self.adapter.tv_paths)
@@ -200,6 +348,9 @@ class ADAG(DistributedTrainer):
         Y = dataset[self.label_col]
         name = type(self).__name__.lower()
         variant = "zero1" if self.zero1 else "dp"
+        if not self.exchange.is_default:
+            label = self.exchange.label()
+            variant = f"zero1_{label}" if self.zero1 else label
         pbytes = int(sum(np.prod(v.shape) * v.dtype.itemsize
                          for v in jax.tree.leaves(state.tv)))
         global_bs = self.batch_size * self.num_workers
@@ -213,13 +364,15 @@ class ADAG(DistributedTrainer):
                     jax.ShapeDtypeStruct((w, global_bs), np.int32))
             variant += "_device_data"
         else:
-            batch_sh = self._batch_sharding(leading_window=True)
+            batch_sh = self._batch_sharding(leading_window=True,
+                                            leading_sync=H > 1)
             step = self._jit_accum_step(state_sh, batch_sh)
+            lead = (H, w) if H > 1 else (w,)
             args = (state,
-                    jax.ShapeDtypeStruct((w, global_bs) + X.shape[1:],
-                                         X.dtype),
-                    jax.ShapeDtypeStruct((w, global_bs) + Y.shape[1:],
-                                         Y.dtype))
+                    jax.ShapeDtypeStruct(lead + (global_bs,)
+                                         + X.shape[1:], X.dtype),
+                    jax.ShapeDtypeStruct(lead + (global_bs,)
+                                         + Y.shape[1:], Y.dtype))
         return [TraceSpec(name=f"{name}_{variant}/accum_step", fn=step,
                           args=args, donate_argnums=(0,),
                           params_bytes=pbytes)]
@@ -228,46 +381,61 @@ class ADAG(DistributedTrainer):
         if self.device_data:
             return self._fit_device_data(dataset)
         w = self.communication_window
+        H = self.exchange.sync_every
         state = self.adapter.init_state()
         state, state_sh = self._shard_state(state)
-        batch_sh = self._batch_sharding(leading_window=True)
+        batch_sh = self._batch_sharding(leading_window=True,
+                                        leading_sync=H > 1)
 
         step = self._jit_accum_step(state_sh, batch_sh)
 
         # Global batch = num_workers * batch_size rows per microbatch;
-        # one jitted call consumes `window` microbatches.  Each process
-        # feeds its share of the global batch from its dataset shard;
-        # the balance check keeps hosts from deadlocking the all-reduce
+        # one jitted call consumes `window` microbatches (x sync_every
+        # local rounds under local-SGD).  Each process feeds its share
+        # of the global batch from its dataset shard; the balance check
+        # keeps hosts from deadlocking the all-reduce
         # (mesh.equal_across_hosts: raise-before-loop, on every host).
         feed_bs = per_host_rows(self.batch_size * self.num_workers)
-        equal_across_hosts(len(dataset) // (feed_bs * w),
-                           f"step counts ({feed_bs * w}-row windows)")
+        equal_across_hosts(len(dataset) // (feed_bs * w * H),
+                           f"step counts ({feed_bs * w * H}-row windows)")
 
         def stream():
             for _ in range(self.num_epoch):
                 for xs, ys in dataset.batches(
                         feed_bs, features_col=self.features_col,
-                        label_col=self.label_col, window=w):
+                        label_col=self.label_col, window=w * H):
+                    if H > 1:
+                        # [H*w, feed, ...] -> [H, w, feed, ...]: the
+                        # first w microbatches are local round 1 — the
+                        # same rows, in the same order, the synchronous
+                        # path would consume.
+                        xs = xs.reshape((H, w) + xs.shape[1:])
+                        ys = ys.reshape((H, w) + ys.shape[1:])
                     with self.step_timer.phase("h2d"):
                         args = (self._global_batch(xs, batch_sh),
                                 self._global_batch(ys, batch_sh))
                     yield args
 
-        return self._run_rounds(state, step, stream(), feed_bs * w,
+        return self._run_rounds(state, step, stream(), feed_bs * w * H,
                                 dataset)
 
     def _run_rounds(self, state, step, rounds, rows_per_round, dataset):
         """ONE round-loop driver for the streaming and device-resident
         paths: resume skipping, loss/checkpoint/eval bookkeeping, and
         the end-of-run guards must not drift between them."""
-        losses, rnd = [], 0
+        losses, probes, rnd = [], [], 0
         state, start = self._restore_or(state)
         for args in rounds:
             rnd += 1
             if rnd <= start:
                 continue
             with self.step_timer.phase("step"):
-                state, loss = step(state, *args)
+                state, out = step(state, *args)
+            if self.probe_metrics:
+                loss, aux = out
+                probes.append(aux)
+            else:
+                loss = out
             losses.append(loss)
             self._checkpoint(state, rnd)
             self._eval_hook(state, rnd)
@@ -275,8 +443,30 @@ class ADAG(DistributedTrainer):
             return state
         self._require_steps(losses, rows_per_round, len(dataset))
         self._record(losses)
+        self._record_probes(probes, state)
         self._checkpoint(state, rnd, final=True)
         return state
+
+    def _record_probes(self, probes, state) -> None:
+        """Retire the in-graph probe scalars (one device->host sync at
+        END of run, never per step) and the exchange layer's residual
+        diagnostic into obs."""
+        if probes:
+            self.probe_history = [
+                {k: float(v) for k, v in p.items()} for p in probes]
+            from distkeras_tpu import obs
+
+            last = self.probe_history[-1]
+            for k, v in last.items():
+                obs.gauge(f"train.{k}", v, trainer=type(self).__name__)
+        if self.exchange.compress is not None:
+            from distkeras_tpu import obs
+            from distkeras_tpu.parallel.exchange import residual_norm_of
+
+            rn = residual_norm_of(state.opt_state)
+            if rn is not None:
+                obs.gauge("exchange.residual_norm", rn)
+                self.residual_norm = rn
 
 
     def _fit_device_data(self, dataset: Dataset):

@@ -89,6 +89,54 @@ def _with_ema(opt, decay: float):
     return optax.GradientTransformation(init, update)
 
 
+def _make_localsgd_step(cfg: tfm.TransformerConfig, optimizer, mesh,
+                        config):
+    """Local-SGD train step for the pure-DP LM (docs/lowcomm.md):
+    ``step((params, opt), tokens[H, B, S+1])`` runs, per replica inside
+    a shard_map over ``data``, ``H = config.sync_every`` purely-local
+    optimizer steps on this replica's batch shards, then ONE
+    cross-replica merge — parameter deltas by the configured rule
+    (mean / adasum per fusion bucket) and floating optimizer-state
+    leaves averaged (momentum-aware).  1/H the collective frequency of
+    the synchronous step; pinned by the collective census."""
+    from distkeras_tpu.parallel.exchange import (merge_local_params,
+                                                 sync_local_tree)
+
+    def step(carry, tokens, dropout_rng=None, segment_ids=None):
+        if dropout_rng is not None or segment_ids is not None:
+            raise ValueError(
+                "sync_every > 1 does not support dropout or packed "
+                "segments (replica-local loss)")
+        params, opt_state = carry
+        n_data = int(mesh.shape["data"])
+
+        def local_run(params, opt_state, tokens):
+            grad_fn = jax.value_and_grad(tfm.lm_loss)
+
+            def local_step(c, tok):
+                p, s = c
+                loss, g = grad_fn(p, tok, cfg, None, None, None, None,
+                                  None)
+                u, s = optimizer.update(g, s, p)
+                p = jax.tree.map(lambda a, b: a + b, p, u)
+                return (p, s), loss
+
+            (p, s), losses = jax.lax.scan(
+                local_step, (params, opt_state), tokens)
+            with jax.named_scope("exchange/localsgd_sync"):
+                p = merge_local_params(params, p, config, "data", n_data)
+                s = sync_local_tree(s, config, "data", n_data)
+                loss = jax.lax.pmean(jnp.mean(losses), "data")
+            return (p, s), loss
+
+        return shard_map(local_run, mesh=mesh,
+                         in_specs=(P(), P(), P(None, "data", None)),
+                         out_specs=((P(), P()), P()),
+                         check_vma=False)(params, opt_state, tokens)
+
+    return step
+
+
 class LMTrainer(CheckpointingBase):
     """Train a causal transformer LM over a device mesh.
 
@@ -122,6 +170,19 @@ class LMTrainer(CheckpointingBase):
     only; ``fsdp=True`` (ZeRO-3) is the alternative when parameter
     memory itself must shard.
 
+    **Gradient-exchange policy** (docs/lowcomm.md; pure-DP meshes, no
+    dropout/MoE/segments): ``merge_rule="adasum"`` merges replica
+    gradients by pairwise adaptive summation instead of the mean
+    (arXiv 2006.02924); ``sync_every=H`` switches to local-SGD — H
+    purely-local optimizer steps then one momentum-aware parameter
+    merge, 1/H the collective frequency (the WAN-tolerant mode for the
+    cluster substrate); ``compress="int8"``/``"topk"`` applies an
+    error-feedback codec per fusion bucket (~4x fewer gradient wire
+    bytes for int8, pinned by the collective census).
+    ``compress="int8"`` composes with ``zero1=True`` by compressing
+    the reduce-scatter leg.  ``probe_metrics=True`` adds an in-graph
+    grad-norm probe (``probe_history``; zero extra compiled programs).
+
     ``ema_decay``: maintain a Polyak/EMA average of the weights inside
     the optimizer state (decay per optimizer step); after ``train``,
     ``self.ema_params`` holds the servable averaged tree.  Composes
@@ -148,6 +209,9 @@ class LMTrainer(CheckpointingBase):
                  zero1: bool = False, zero1_bucket_mb: float | None = None,
                  device_data: bool = False,
                  grad_accum: int = 1, grad_clip_norm: float | None = None,
+                 merge_rule: str = "mean", sync_every: int = 1,
+                 compress: str | None = None, topk_frac: float = 0.01,
+                 probe_metrics: bool = False,
                  tokens_col: str = "tokens", seed: int = 0,
                  shuffle: bool = False, eval_every: int = 0,
                  profile_dir: str | None = None, profile_steps: int = 3,
@@ -285,6 +349,62 @@ class LMTrainer(CheckpointingBase):
         if zero1_bucket_mb is not None and not zero1:
             raise ValueError(
                 "zero1_bucket_mb only applies with zero1=True")
+        from distkeras_tpu.parallel.exchange import ExchangeConfig
+
+        exchange = ExchangeConfig(
+            merge_rule=merge_rule, sync_every=sync_every,
+            compress=compress, topk_frac=topk_frac,
+            # Under zero1 x int8 the exchange's bucket layout IS the
+            # zero1 layout, so the one bucket knob governs both.
+            **({} if zero1_bucket_mb is None
+               else {"bucket_mb": zero1_bucket_mb}))
+        self.exchange = exchange
+        self.probe_metrics = probe_metrics
+        self.probe_history: list[dict] = []
+        if not exchange.is_default:
+            pure_dp = (n_model == 1 and n_seq == 1 and n_pipe == 1
+                       and int(self.mesh.shape["expert"]) == 1
+                       and not fsdp and not cfg.num_experts)
+            if not pure_dp:
+                raise ValueError(
+                    "merge_rule/sync_every/compress compose with the "
+                    "pure data-parallel mesh only (no model/seq/"
+                    "pipeline/expert axes, no fsdp, no MoE): the "
+                    "exchange layer computes per-replica gradients in "
+                    "a shard_map over the data axis")
+            if cfg.dropout > 0:
+                raise ValueError(
+                    "merge_rule/sync_every/compress do not compose "
+                    "with cfg.dropout > 0: the dropout mask stream is "
+                    "a global-batch quantity a replica-local loss "
+                    "would draw differently")
+            if device_data:
+                raise ValueError(
+                    "merge_rule/sync_every/compress do not compose "
+                    "with device_data=True: the staged data plane "
+                    "does not route through the local-gradient "
+                    "shard_map")
+            if zero1 and not (exchange.compress == "int8"
+                              and exchange.sync_every == 1):
+                raise ValueError(
+                    "zero1=True composes with compress='int8' only "
+                    "(the chunked codec compresses the reduce-scatter "
+                    "leg); adasum and local-SGD replace the exchange "
+                    "zero1 shards")
+            if exchange.sync_every > 1 and grad_accum > 1:
+                raise ValueError(
+                    "sync_every > 1 with grad_accum > 1 is not "
+                    "supported: the local-SGD period already scans "
+                    "sync_every microbatches per call")
+        if probe_metrics and exchange.sync_every > 1:
+            raise ValueError(
+                "probe_metrics with sync_every > 1 is not supported: "
+                "the local-SGD period has no single per-step global "
+                "gradient to probe")
+        if probe_metrics and device_data:
+            raise ValueError(
+                "probe_metrics does not compose with device_data=True "
+                "(the staged-stream step has no probe output slot)")
         if zero1:
             if fsdp:
                 raise ValueError(
@@ -292,17 +412,33 @@ class LMTrainer(CheckpointingBase):
                     "(ZeRO-3) are exclusive: fsdp already scatters the "
                     "optimizer state along with the parameters")
             from distkeras_tpu.parallel.collectives import (
-                DEFAULT_BUCKET_MB, zero1_enable)
+                DEFAULT_BUCKET_MB, zero1_enable, zero1_validate)
 
             self._zero1_bucket_mb = (DEFAULT_BUCKET_MB
                                      if zero1_bucket_mb is None
                                      else zero1_bucket_mb)
-            # Wrap LAST, outside clip/EMA/weight-decay chains: the whole
-            # chain then runs on shard views (the EMA shadow and adam
-            # moments scatter too — the memory win covers them all).
-            self.optimizer = zero1_enable(
-                self.optimizer, self.mesh, spec=optimizer,
-                bucket_mb=self._zero1_bucket_mb)
+            if exchange.compress == "int8":
+                from distkeras_tpu.parallel.exchange import (
+                    exchange_optimizer)
+
+                # zero1 x int8-EF: the exchange optimizer both shards
+                # the update AND compresses the reduce-scatter leg.
+                zero1_validate(self.mesh, optimizer)
+                self.optimizer = exchange_optimizer(
+                    self.optimizer, self.mesh, exchange, zero1=True)
+            else:
+                # Wrap LAST, outside clip/EMA/weight-decay chains: the
+                # whole chain then runs on shard views (the EMA shadow
+                # and adam moments scatter too — the memory win covers
+                # them all).
+                self.optimizer = zero1_enable(
+                    self.optimizer, self.mesh, spec=optimizer,
+                    bucket_mb=self._zero1_bucket_mb)
+        elif exchange.needs_grad_exchange:
+            from distkeras_tpu.parallel.exchange import exchange_optimizer
+
+            self.optimizer = exchange_optimizer(
+                self.optimizer, self.mesh, exchange)
 
         # segments (packed sequences) ride EVERY trunk: the default
         # flash attention, the ring (seq-axis) path — make_ring_attention
@@ -346,17 +482,37 @@ class LMTrainer(CheckpointingBase):
                           and int(self.mesh.shape["expert"]) == 1
                           and not fsdp and not zero1
                           and not cfg.num_experts)
-        self._vag = (self._dp_local_value_and_grad() if dp_local_grads
-                     else None)
+        if exchange.needs_grad_exchange:
+            # Exchange configurations (adasum / EF codecs, zero1 x int8
+            # included) feed the exchange optimizer STACKED per-replica
+            # gradients instead of pmean'd ones.
+            self._vag = self._stacked_local_value_and_grad()
+        elif dp_local_grads:
+            self._vag = self._dp_local_value_and_grad()
+        else:
+            self._vag = None
         # _fwd_kw captures the mesh-specific forward once; the step and
         # eval builders (and LoRATrainer's overrides) share it.
-        self._step_builder = lambda opt: tfm.make_train_step(
-            cfg, opt, grad_accum=grad_accum,
-            value_and_grad=self._vag, **self._fwd_kw)
+        if exchange.sync_every > 1:
+            self._step_builder = lambda opt: _make_localsgd_step(
+                cfg, opt, self.mesh, exchange)
+        else:
+            self._step_builder = lambda opt: tfm.make_train_step(
+                cfg, opt, grad_accum=grad_accum,
+                value_and_grad=self._vag, probe=self.probe_metrics,
+                **self._fwd_kw)
         self._nll_fn = lambda p, t, seg=None: tfm.lm_nll(
             p, t, cfg,
             segment_ids=seg,
             **self._fwd_kw)
+
+    @property
+    def _feed_block(self) -> int:
+        """Leading microbatch-block size of the fed token array: the
+        grad-accum depth, or the local-SGD period (mutually exclusive
+        by construction); 1 = a flat [B, S+1] batch."""
+        return (self.grad_accum if self.grad_accum > 1
+                else self.exchange.sync_every)
 
     def _dp_local_value_and_grad(self):
         """``jax.value_and_grad`` replacement for the replicated-DP
@@ -394,6 +550,44 @@ class LMTrainer(CheckpointingBase):
                 return shard_map(local_grads, mesh=mesh,
                                  in_specs=(P(), P("data", None)),
                                  out_specs=(P(), P()),
+                                 check_vma=False)(params, tokens)
+
+            return wrapped
+
+        return value_and_grad
+
+    def _stacked_local_value_and_grad(self):
+        """``jax.value_and_grad`` replacement for the gradient-exchange
+        configurations (parallel/exchange.py): per-replica gradients
+        are computed inside a ``shard_map`` over ``data`` and returned
+        STACKED — global ``[n, *leaf]`` sharded ``P("data")`` — for the
+        exchange optimizer to merge (adasum / EF codecs; the
+        compiler's pmean never runs).  The loss is pmean'd for
+        reporting.  Dropout and packed segments are rejected at
+        construction/train time, so the trace-time guard here is
+        belt-and-braces."""
+        mesh = self.mesh
+
+        def value_and_grad(loss):
+            vag = jax.value_and_grad(loss)
+
+            def wrapped(params, tokens, cfg, attention_fn, apply_fn,
+                        rng, hidden_fn, segment_ids=None):
+                if rng is not None or segment_ids is not None:
+                    raise ValueError(
+                        "gradient-exchange configurations do not "
+                        "support dropout or packed segments "
+                        "(replica-local loss)")
+
+                def local_grads(p, t):
+                    l, g = vag(p, t, cfg, attention_fn, apply_fn,
+                               None, hidden_fn, None)
+                    g = jax.tree.map(lambda v: v[None], g)
+                    return jax.lax.pmean(l, "data"), g
+
+                return shard_map(local_grads, mesh=mesh,
+                                 in_specs=(P(), P("data", None)),
+                                 out_specs=(P(), P("data")),
                                  check_vma=False)(params, tokens)
 
             return wrapped
@@ -512,6 +706,15 @@ class LMTrainer(CheckpointingBase):
         """
         psh = self.plan.tree_shardings(self.mesh, params)
         rep = NamedSharding(self.mesh, P())
+        if self.exchange.needs_grad_exchange:
+            # Exchange state: error-feedback residuals shard over
+            # their replica axis (and shard views under zero1 x int8);
+            # inner moments replicate like the (pure-DP) params.
+            from distkeras_tpu.parallel.exchange import (
+                exchange_state_shardings)
+
+            return psh, exchange_state_shardings(
+                params, opt_state, self.mesh, zero1=self.zero1)
         if self.zero1:
             from distkeras_tpu.parallel.collectives import (
                 zero1_state_shardings)
@@ -534,9 +737,10 @@ class LMTrainer(CheckpointingBase):
         never a reimplementation.  Returns ``(step, step_sh, tok_sh)``
         (the fed block's and the flat token rows' shardings)."""
         tok_sh = NamedSharding(self.mesh, P("data", None))
-        # With accumulation the fed block is [accum, B, S+1]: the
-        # microbatch axis leads, batch still shards over data.
-        step_sh = (tok_sh if self.grad_accum == 1
+        # With accumulation (or a local-SGD period) the fed block is
+        # [accum|sync_every, B, S+1]: the microbatch axis leads, batch
+        # still shards over data.
+        step_sh = (tok_sh if self._feed_block == 1
                    else NamedSharding(self.mesh, P(None, "data", None)))
         rep = NamedSharding(self.mesh, P())
         jit_kw = {}
@@ -615,6 +819,9 @@ class LMTrainer(CheckpointingBase):
         name = type(self).__name__.lower()
         variant = ("zero1" if self.zero1
                    else "fsdp" if self.fsdp else "dp")
+        if not self.exchange.is_default:
+            label = self.exchange.label()
+            variant = f"zero1_{label}" if self.zero1 else label
         pbytes = int(sum(np.prod(v.shape) * v.dtype.itemsize
                          for v in jax.tree.leaves(params)))
         # Shapes are the GLOBAL avals the jitted step consumes — the
@@ -632,8 +839,8 @@ class LMTrainer(CheckpointingBase):
                 else (sub,), jnp.int32)
             args = ((params, opt_state), X, idx, rng, None)
         else:
-            shape = ((self.grad_accum, self.batch_size, seq + 1)
-                     if self.grad_accum > 1
+            block = self._feed_block
+            shape = ((block, self.batch_size, seq + 1) if block > 1
                      else (self.batch_size, seq + 1))
             args = ((params, opt_state),
                     jax.ShapeDtypeStruct(shape, jnp.int32), rng, None)
@@ -679,6 +886,12 @@ class LMTrainer(CheckpointingBase):
         if eval_segments is not None and segments is None:
             raise ValueError("eval_segments without segments — pack "
                              "train and eval the same way")
+        if segments is not None and not self.exchange.is_default:
+            raise ValueError(
+                "packed segments do not compose with merge_rule/"
+                "sync_every/compress: the valid-target count is a "
+                "global-batch quantity a replica-local loss would "
+                "compute differently")
         # Multi-process SPMD: every process runs this same loop over its
         # OWN rows (feed tokens[process_index::process_count] or
         # Dataset.shard) — all hosts must pass the same row count or
@@ -825,11 +1038,14 @@ class LMTrainer(CheckpointingBase):
                     jax.block_until_ready(
                         nll(params, eval_chunks[0]))
 
-            carry, losses = (params, opt_state), []
+            carry, losses, probes = (params, opt_state), [], []
             # Multi-process: ``tokens`` holds only this host's rows, so
             # each step consumes 1/n_proc of the global row count and
             # the global batch is assembled shard-wise (_global_batch).
-            rows_per_step = global_bs * self.grad_accum // n_proc
+            # A local-SGD period (sync_every) consumes a block exactly
+            # like grad_accum does — one leading microbatch axis.
+            blk = self._feed_block
+            rows_per_step = global_bs * blk // n_proc
             n_rows = len(tokens) - (len(tokens) % rows_per_step)
             if not n_rows:
                 raise ValueError(
@@ -879,8 +1095,8 @@ class LMTrainer(CheckpointingBase):
                                     seg_block.shape[1])
                             seg_batch = self._global_batch(seg_block,
                                                            step_sh)
-                        if self.grad_accum > 1:
-                            block = block.reshape(self.grad_accum,
+                        if blk > 1:
+                            block = block.reshape(blk,
                                                   global_bs // n_proc,
                                                   block.shape[1])
                         with self.step_timer.phase("h2d"):
@@ -893,11 +1109,16 @@ class LMTrainer(CheckpointingBase):
                            if dropping else None)
                     with self.step_timer.phase("step"):
                         if self.device_data:
-                            carry, loss = step(carry, *step_args, rng,
-                                               seg_dev)
+                            carry, out = step(carry, *step_args, rng,
+                                              seg_dev)
                         else:
-                            carry, loss = step(carry, *step_args, rng,
-                                               seg_batch)
+                            carry, out = step(carry, *step_args, rng,
+                                              seg_batch)
+                    if self.probe_metrics:
+                        loss, probe_aux = out
+                        probes.append(probe_aux)
+                    else:
+                        loss = out
                     if (profiling
                             and rnd >= prof_start - 1 + self.profile_steps):
                         # Flush async device work ONCE, when the profile
@@ -937,7 +1158,11 @@ class LMTrainer(CheckpointingBase):
             self._close_checkpoints()
         params, opt_state = carry
         if self._ema:
-            self._ema_params = opt_state[1]
+            # Under a grad-exchange wrapper the state nests one level
+            # deeper: (ema_state, ExchangeState).
+            ema_src = (opt_state[0] if self.exchange.needs_grad_exchange
+                       else opt_state)
+            self._ema_params = ema_src[1]
             if self.zero1:
                 # The shadow rode the optimizer state as scattered
                 # shard views; hand the user back a params-layout tree.
@@ -949,6 +1174,20 @@ class LMTrainer(CheckpointingBase):
                 self._ema_params = layout.unview(self._ema_params)
         jax.block_until_ready(jax.tree.leaves(params)[0])
         self.history = [float(l) for l in losses]
+        # Probe scalars and the exchange residual diagnostic retire in
+        # ONE device->host pass at end of run, never per step.
+        if probes:
+            self.probe_history = [
+                {k: float(v) for k, v in p.items()} for p in probes]
+            for k, v in self.probe_history[-1].items():
+                obs.gauge(f"train.{k}", v, trainer=type(self).__name__)
+        if self.exchange.compress is not None:
+            from distkeras_tpu.parallel.exchange import residual_norm_of
+
+            rn = residual_norm_of(opt_state)
+            if rn is not None:
+                obs.gauge("exchange.residual_norm", rn)
+                self.residual_norm = rn
         self.training_time = time.perf_counter() - t0
         self._record_run_metrics()
         return params
@@ -1002,6 +1241,16 @@ class LoRATrainer(LMTrainer):
                 "the ~1000x-smaller adapter leaves, so there is nothing "
                 "worth sharding — and the frozen base must stay whole "
                 "for the in-step merge")
+        if (kw.get("merge_rule", "mean") != "mean"
+                or kw.get("sync_every", 1) != 1
+                or kw.get("compress") is not None
+                or kw.get("probe_metrics")):
+            raise ValueError(
+                "merge_rule/sync_every/compress/probe_metrics are not "
+                "supported on LoRATrainer: the packed (adapters, base) "
+                "gradient is ~1000x smaller than the base, so the "
+                "exchange is never the bottleneck — and the builders "
+                "here bypass the exchange-aware step construction")
         super().__init__(cfg, **kw)
         self.optimizer = optax.masked(self.optimizer, lora_mask)
         self._base_host = base_params

@@ -739,6 +739,147 @@ def bench_zero1_update(batch_unused=None, iters=30):
     }
 
 
+def bench_lowcomm_convergence(**opts):
+    """Convergence-vs-baseline row for one gradient-exchange variant
+    (docs/lowcomm.md): train the toy LM twice on the same seeded rows —
+    replicated-DP baseline, then the variant — and report both final
+    losses against the DECLARED tolerance (the same bound
+    tests/test_exchange.py::TOL_LOSS enforces; the row makes the margin
+    visible, the test makes it binding).  Wire-bytes/collective-count
+    claims live in the compiled census (scripts/comm_budget.json), not
+    here — this row is the convergence half of the lowcomm contract.
+    """
+    def run(batch=16, seq=16, n_rows=128, epochs=2, tol=0.05):
+        import jax
+        import numpy as np
+        from distkeras_tpu.models import transformer as tfm
+        from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+        from distkeras_tpu.trainers.lm import LMTrainer
+
+        cfg = tfm.TransformerConfig(vocab_size=64, d_model=32,
+                                    n_heads=2, n_layers=2, d_ff=64,
+                                    max_len=seq + 1)
+        rows = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (n_rows, seq + 1)).astype(np.int32)
+        mesh = make_mesh(MeshSpec(data=len(jax.devices())))
+
+        def train(**kw):
+            t = LMTrainer(cfg, learning_rate=1e-2, batch_size=batch,
+                          num_epoch=epochs, mesh=mesh, **kw)
+            t0 = time.perf_counter()
+            t.train(rows)
+            return t, time.perf_counter() - t0
+
+        base, _ = train()
+        t, wall = train(**opts)
+        steps = len(t.history)
+        delta = abs(t.history[-1] - base.history[-1])
+        # One row == one sync round; under local-SGD a round carries
+        # sync_every optimizer steps' worth of tokens.
+        tokens = n_rows * seq * epochs
+        return tokens / wall, wall / steps, 0.0, {
+            **opts,
+            "final_loss": round(t.history[-1], 5),
+            "baseline_loss": round(base.history[-1], 5),
+            "loss_delta": round(delta, 5),
+            "tolerance": tol,
+            "within_tolerance": bool(delta <= tol),
+            "rounds": steps, "baseline_rounds": len(base.history)}
+    return run
+
+
+def bench_lowcomm_update(iters=10, d_model=512, n_layers=4,
+                         vocab=32768):
+    """The gradient-exchange + update path in isolation, per variant
+    (docs/lowcomm.md): feed a fixed synthetic STACKED per-replica
+    gradient of the flagship short transformer config through
+    ``exchange_optimizer`` for each merge rule / codec, so the measured
+    wall is exactly merge collectives + inner update — the thing the
+    exchange layer changes.  Reports per-variant update time and the
+    analytic per-step gradient wire bytes (``exchange.wire_bytes`` —
+    the same formula the obs gauges carry; the compiled census pins the
+    claim), so the ~4x int8-EF byte reduction and its CPU-mesh cost
+    show up side by side.  (Model dims overridable so CPU smoke tests
+    can shrink them; the flagship default is chip-sized.)"""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.parallel import exchange as ex
+    from distkeras_tpu.parallel.collectives import Zero1Layout
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshSpec(data=n_dev))
+    cfg = tfm.TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=4,
+        n_layers=n_layers, d_ff=4 * d_model, max_len=1025,
+        dtype="bfloat16")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    rep = NamedSharding(mesh, P())
+    stk = NamedSharding(mesh, P("data"))
+    params = jax.device_put(params, jax.tree.map(lambda _: rep, params))
+    # Per-replica contributions: the mean over the leading axis equals
+    # the replicated-baseline gradient, so every variant does real work.
+    stacked = jax.device_put(
+        jax.tree.map(lambda p: jnp.broadcast_to(
+            (p * 1e-3)[None], (n_dev,) + p.shape), params),
+        jax.tree.map(lambda _: stk, params))
+    layout = Zero1Layout.for_tree(
+        jax.tree.map(lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype),
+                     params), n_dev, ex.ExchangeConfig().bucket_mb)
+
+    def measure(config, zero1=False):
+        opt = ex.exchange_optimizer(optax.adamw(3e-4), mesh, config,
+                                    zero1=zero1)
+        osh = ex.exchange_state_shardings(
+            params, jax.eval_shape(opt.init, params), mesh, zero1=zero1)
+        state = jax.jit(opt.init, out_shardings=osh)(params)
+
+        def upd(g, s, p):
+            u, s2 = opt.update(g, s, p)
+            return optax.apply_updates(p, u), s2
+
+        psh = jax.tree.map(lambda _: rep, params)
+        gsh = jax.tree.map(lambda _: stk, params)
+        step = jax.jit(upd, donate_argnums=(1, 2),
+                       in_shardings=(gsh, osh, psh),
+                       out_shardings=(psh, osh))
+        p = jax.tree.map(jnp.copy, params)
+        for _ in range(3):
+            p, state = step(stacked, state, p)
+        jax.block_until_ready(p)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, state = step(stacked, state, p)
+        jax.block_until_ready(p)
+        return (time.perf_counter() - t0) / iters
+
+    variants = {
+        "mean": (ex.ExchangeConfig(), False),
+        "adasum": (ex.ExchangeConfig(merge_rule="adasum"), False),
+        "int8ef": (ex.ExchangeConfig(compress="int8"), False),
+        "topk": (ex.ExchangeConfig(compress="topk", topk_frac=0.01),
+                 False),
+        "zero1_int8ef": (ex.ExchangeConfig(compress="int8"), True),
+    }
+    extras = {"n_devices": n_dev}
+    walls = {}
+    for name, (config, zero1) in variants.items():
+        walls[name] = measure(config, zero1)
+        f32_b, wire_b = ex.wire_bytes(layout, config, zero1)
+        extras[f"update_ms_{name}"] = round(walls[name] * 1e3, 3)
+        extras[f"grad_wire_bytes_{name}"] = wire_b
+        if name == "mean":
+            extras["grad_f32_bytes"] = f32_b
+        else:
+            extras[f"compression_{name}"] = round(f32_b / max(wire_b, 1),
+                                                  2)
+    return 1.0 / walls["int8ef"], walls["int8ef"], 0.0, extras
+
+
 def bench_lm_e2e(device_data):
     """End-to-end ``LMTrainer.train()`` throughput over real host rows,
     streaming vs ``device_data=True`` — the LM flagship's input-plane
@@ -824,6 +965,16 @@ BENCHES = {
     "lm_e2e_stream": (bench_lm_e2e(False), "tokens/sec/chip"),
     "lm_e2e_device_data": (bench_lm_e2e(True), "tokens/sec/chip"),
     "zero1_update": (bench_zero1_update, "updates/sec"),
+    "lowcomm_adasum": (bench_lowcomm_convergence(merge_rule="adasum"),
+                       "tokens/sec/chip"),
+    "lowcomm_localsgd4": (bench_lowcomm_convergence(sync_every=4),
+                          "tokens/sec/chip"),
+    "lowcomm_int8ef": (bench_lowcomm_convergence(compress="int8"),
+                       "tokens/sec/chip"),
+    "lowcomm_zero1_int8ef": (
+        bench_lowcomm_convergence(zero1=True, compress="int8"),
+        "tokens/sec/chip"),
+    "lowcomm_update": (bench_lowcomm_update, "updates/sec"),
 }
 
 

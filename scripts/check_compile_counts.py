@@ -71,12 +71,15 @@ class _count:
         self.n = _COMPILES["n"] - self.start
 
 
-def session_adag(zero1: bool = False, device_data: bool = False):
+def session_adag(zero1: bool = False, device_data: bool = False,
+                 rounds: int = 4, **opts):
     """Two ADAG rounds; every round after the first must hit the cache
     (one accum-step program; shapes are static by construction).
     ``device_data`` exercises the HBM-staged indexed path instead —
     its per-round traffic is one index block, so extra programs mean
-    the staged plane regressed."""
+    the staged plane regressed.  ``opts`` select exchange-layer
+    variants (adasum / local-SGD): their shard_map merges must compile
+    into the ONE step program, never per round."""
     import numpy as np
 
     import distkeras_tpu as dk
@@ -93,15 +96,16 @@ def session_adag(zero1: bool = False, device_data: bool = False):
     t = dk.ADAG(model, loss="sparse_categorical_crossentropy",
                 worker_optimizer="adam", learning_rate=0.05,
                 batch_size=4, num_epoch=2, communication_window=2,
-                zero1=zero1, device_data=device_data)
+                zero1=zero1, device_data=device_data, **opts)
     t.train(ds)
-    assert len(t.history) == 4, t.history
+    assert len(t.history) == rounds, t.history
 
 
-def session_lm(zero1: bool = False, device_data: bool = False):
+def session_lm(zero1: bool = False, device_data: bool = False, **opts):
     """Four LMTrainer optimizer steps, one compiled step program
     (zero1: the sharded update must not add per-round programs;
-    device_data: nor must the staged-stream gather)."""
+    device_data: nor must the staged-stream gather; int8-EF: nor must
+    the codec's residual carry)."""
     import numpy as np
 
     import distkeras_tpu as dk
@@ -112,7 +116,7 @@ def session_lm(zero1: bool = False, device_data: bool = False):
     rows = np.random.default_rng(0).integers(
         0, 64, (32, 17)).astype(np.int32)
     t = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=8, num_epoch=1,
-                     zero1=zero1, device_data=device_data)
+                     zero1=zero1, device_data=device_data, **opts)
     t.train(rows)
     assert len(t.history) == 4, t.history
 
@@ -213,9 +217,13 @@ SESSIONS = {
     "adag": lambda: session_adag(),
     "adag_zero1": lambda: session_adag(zero1=True),
     "adag_device_data": lambda: session_adag(device_data=True),
+    "adag_adasum": lambda: session_adag(merge_rule="adasum"),
+    # sync_every=2 consumes 2x the rows per round: 2 rounds total.
+    "adag_localsgd": lambda: session_adag(sync_every=2, rounds=2),
     "lm_trainer": lambda: session_lm(),
     "lm_zero1": lambda: session_lm(zero1=True),
     "lm_device_data": lambda: session_lm(device_data=True),
+    "lm_int8ef": lambda: session_lm(compress="int8"),
     "serving": session_serving,
     "speculative": session_speculative,
     "serving_elastic": session_serving_elastic,

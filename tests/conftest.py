@@ -218,6 +218,23 @@ SLOW = MULTIPROCESS | {
     "test_zero1::test_lm_zero1_checkpoint_resume",
     "test_zero1::test_lm_zero1_clip_ema_matches_dp",
     "test_zero1::test_lm_zero1_grad_accum_matches_dp",
+    # Exchange-layer LM legs: the fast gate keeps the ADAG family's
+    # full variant matrix (convergence, determinism, residual
+    # diagnostics, pickle checkpoint resume, Supervisor bit-for-bit);
+    # the LM spellings — same merge rules on the bigger model, whose
+    # ~21-program compiles dominate wall time — run in the merge gate.
+    "test_exchange::test_lm_int8ef_converges_and_is_deterministic",
+    "test_exchange::test_lm_sync_every_1_and_4_converge",
+    "test_exchange::test_lm_adasum_and_zero1_int8_converge",
+    "test_exchange::test_lm_int8ef_checkpoint_resume",
+    "test_exchange::test_lm_zero1_int8_shards_opt_memory",
+    # The 2-process coordinated-restart smoke joins its full-ladder
+    # sibling in the merge gate: the fast gate keeps every in-process
+    # cluster protocol test (driver restart protocol, flap ladder,
+    # watchdog, torn-checkpoint selection), and the tier-1 wall-clock
+    # budget goes to the exchange-layer matrix instead of a second
+    # spawned-subprocess collective run.
+    "test_cluster::test_two_process_kill_one_host_coordinated_restart",
 }
 
 

@@ -294,6 +294,89 @@ def test_driver_coordinated_restart_protocol(tmp_path):
     assert rcs[0] == 137 and rcs[-1] == 0
 
 
+# A stdlib-only flapping child: increments a counter file, dies with
+# EXIT_RESTART for the first three runs (after `uptime` seconds of
+# "healthy training"), completes on the fourth.  No cluster imports at
+# all — with one host the driver protocol needs no member.
+FLAP_CHILD = """
+import os, sys, time
+path, uptime = sys.argv[1], float(sys.argv[2])
+n = int(open(path).read()) if os.path.exists(path) else 0
+open(path, "w").write(str(n + 1))
+if n < 3:
+    time.sleep(uptime)
+    os._exit(75)
+"""
+
+
+def _flap_driver(tmp_path, uptime, **kw):
+    counter = str(tmp_path / "count")
+    sup = cluster.ClusterSupervisor(
+        str(tmp_path / "coord"), 0, 1,
+        [sys.executable, "-c", FLAP_CHILD, counter, str(uptime)],
+        poll=0.05, barrier_timeout=10.0, **kw)
+    return sup
+
+
+@pytest.mark.multiprocess
+def test_flap_dampening_refunds_restart_budget(tmp_path):
+    """The 3-flap ladder (ROADMAP carried follow-up): three healthy-
+    then-dead attempts against max_restarts=1.  Without the refund the
+    budget burns on flap 2; with ``healthy_uptime`` below each flap's
+    uptime, every healthy attempt refunds the budget and the job
+    completes with the counter never exceeding 1."""
+    sup = _flap_driver(tmp_path, uptime=0.5, max_restarts=1,
+                       healthy_uptime=0.2)
+    summary = sup.run()
+    attempts = [a for a in sup.history if a["event"] == "attempt"]
+    refunds = [a for a in sup.history if a["event"] == "refund"]
+    assert [a["rc"] for a in attempts] == [75, 75, 75, 0]
+    assert len(refunds) == 2            # flaps 2 and 3 were forgiven
+    assert summary["restarts"] == 1     # never exceeded the budget
+    assert summary["epochs"] == 4
+
+
+@pytest.mark.multiprocess
+def test_flap_ladder_exhausts_without_refund(tmp_path):
+    """Same ladder with the refund disabled: the pre-dampening
+    behavior — three flaps burn max_restarts=1 and the driver gives
+    up — pinned so the refund stays opt-in."""
+    sup = _flap_driver(tmp_path, uptime=0.5, max_restarts=1,
+                       healthy_uptime=None)
+    with pytest.raises(cluster.ClusterGivenUp):
+        sup.run()
+
+
+@pytest.mark.multiprocess
+def test_rapid_crash_loop_still_exhausts_with_refund(tmp_path):
+    """A genuine crash loop (uptime below ``healthy_uptime``) must
+    still exhaust the budget — the refund forgives flaps, not loops."""
+    sup = _flap_driver(tmp_path, uptime=0.0, max_restarts=1,
+                       healthy_uptime=30.0)
+    with pytest.raises(cluster.ClusterGivenUp):
+        sup.run()
+    assert not [a for a in sup.history if a["event"] == "refund"]
+
+
+@pytest.mark.multiprocess
+def test_hung_child_timeout_kills_never_refund(tmp_path):
+    """A deterministically hung child always outlives ``healthy_uptime``,
+    so attempt-timeout kills must NOT refund the budget — otherwise the
+    supervisor would kill and relaunch the same hang forever and
+    ClusterGivenUp would be unreachable."""
+    sup = cluster.ClusterSupervisor(
+        str(tmp_path / "coord"), 0, 1,
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        poll=0.05, barrier_timeout=10.0, max_restarts=1,
+        attempt_timeout=0.3, healthy_uptime=0.1)
+    with pytest.raises(cluster.ClusterGivenUp):
+        sup.run()
+    attempts = [a for a in sup.history if a["event"] == "attempt"]
+    assert all(a["reason"] == "attempt timeout" for a in attempts)
+    assert len(attempts) == 2           # max_restarts=1 bounded it
+    assert not [a for a in sup.history if a["event"] == "refund"]
+
+
 def test_member_from_env_round_trip(tmp_path, monkeypatch):
     monkeypatch.setenv("DKT_CLUSTER_DIR", str(tmp_path))
     monkeypatch.setenv("DKT_CLUSTER_HOST", "1")
