@@ -86,7 +86,7 @@ from distkeras_tpu.resilience import (ClusterMember, ClusterSupervisor,
                                        EngineClosed, FaultPlan, Preempted,
                                        QueueFull, RequestResult,
                                        Supervisor)
-from distkeras_tpu.serving import (ContinuousBatcher,
+from distkeras_tpu.serving import (ContinuousBatcher, PrefixPool,
                                    SpeculativeBatcher)
 from distkeras_tpu.evaluators import (Evaluator, AccuracyEvaluator,
                                        PerplexityEvaluator)
@@ -163,5 +163,6 @@ __all__ = [
     "LMTrainer",
     "ContinuousBatcher",
     "SpeculativeBatcher",
+    "PrefixPool",
     "LoRATrainer",
 ]

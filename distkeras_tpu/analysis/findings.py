@@ -32,6 +32,10 @@ class Finding:
     message: str
     hint: str = ""
     suppressed: bool = False
+    # Covered by the checked-in warn baseline (scripts/lint_baseline
+    # .json): known debt that no longer gates but can only RATCHET
+    # down — new findings beyond the recorded count still fail.
+    baselined: bool = False
 
     def __post_init__(self):
         if self.severity not in SEVERITIES:
@@ -41,12 +45,15 @@ class Finding:
 
     @property
     def gating(self) -> bool:
-        """Does this finding fail CI?  Unsuppressed error/warn only."""
-        return not self.suppressed and self.severity != "info"
+        """Does this finding fail CI?  Unsuppressed, unbaselined
+        error/warn only."""
+        return (not self.suppressed and not self.baselined
+                and self.severity != "info")
 
     def format(self) -> str:
         loc = f"{self.path}:{self.line}" if self.line else self.path
-        sup = " (suppressed)" if self.suppressed else ""
+        sup = (" (suppressed)" if self.suppressed
+               else " (baselined)" if self.baselined else "")
         hint = f" — {self.hint}" if self.hint else ""
         return f"{loc}: {self.severity} [{self.rule}]{sup} {self.message}{hint}"
 
@@ -86,5 +93,79 @@ def format_findings(findings) -> str:
     return "\n".join(lines)
 
 
+# ------------------------------------------------------ warn baselines
+#
+# Per-finding baselines let `warn` rules RATCHET: a checked-in file
+# records how many warn findings each (rule, path) pair is allowed,
+# existing debt stops gating, and any NEW warn — a higher count at a
+# recorded key, or any unrecorded key — still fails CI.  Errors are
+# never baselineable (they are correctness violations, not debt), and
+# re-recording with fewer findings tightens the ledger, so the only
+# stable direction is down.
+
+def baseline_key(finding: Finding) -> str:
+    """The ledger key: rule + path (no line numbers — they churn on
+    every unrelated edit, which would make the baseline useless)."""
+    return f"{finding.rule}:{finding.path.replace(chr(92), '/')}"
+
+
+def warn_counts(findings) -> dict:
+    """Current unsuppressed-warn census, keyed by :func:`baseline_key`
+    — what ``--update-baseline`` records."""
+    counts: dict[str, int] = {}
+    for f in findings:
+        if f.severity == "warn" and not f.suppressed:
+            counts[baseline_key(f)] = counts.get(baseline_key(f), 0) + 1
+    return counts
+
+
+def apply_baseline(findings, baseline: dict) -> list:
+    """Mark warn findings covered by ``baseline`` (a
+    ``{key: allowed_count}`` dict) as ``baselined``.  At most the
+    recorded count per key is covered, in encounter order — the excess
+    (and every unrecorded key) keeps gating, which is exactly the
+    ratchet: counts can only shrink."""
+    remaining = dict(baseline)
+    out = []
+    for f in findings:
+        if f.severity == "warn" and not f.suppressed:
+            key = baseline_key(f)
+            if remaining.get(key, 0) > 0:
+                remaining[key] -= 1
+                f = dataclasses.replace(f, baselined=True)
+        out.append(f)
+    return out
+
+
+def save_baseline(path: str, findings) -> dict:
+    """Write the current warn census to ``path``; returns it."""
+    import json
+
+    counts = warn_counts(findings)
+    with open(path, "w") as fh:
+        json.dump({"comment": "allowed warn findings per rule:path — "
+                              "the ratchet ledger; re-record with "
+                              "scripts/graph_lint.py --update-baseline "
+                              "and review the diff (counts should "
+                              "only go DOWN)",
+                   "warn_counts": counts}, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return counts
+
+
+def load_baseline(path: str) -> dict:
+    """Read the warn ledger; a missing/empty file is an empty ledger
+    (every warn gates — the pre-baseline behavior)."""
+    import json
+    import os
+
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        return dict(json.load(fh).get("warn_counts", {}))
+
+
 __all__ = ["Finding", "SEVERITIES", "suppressed_rules",
-           "apply_suppressions", "format_findings"]
+           "apply_suppressions", "format_findings", "baseline_key",
+           "warn_counts", "apply_baseline", "save_baseline",
+           "load_baseline"]

@@ -59,8 +59,9 @@ _WALLCLOCK = {("time", "time"), ("time", "perf_counter"),
               ("time", "monotonic"), ("time", "process_time"),
               ("datetime", "now"), ("datetime", "utcnow")}
 _SYNC_CALLS = {"block_until_ready", "device_get"}
-_HOT_PATH_DIRS = (os.path.join("distkeras_tpu", "trainers"),)
-_HOT_PATH_FILES = ("serving.py",)
+_HOT_PATH_DIRS = (os.path.join("distkeras_tpu", "trainers"),
+                  os.path.join("distkeras_tpu", "serving"))
+_HOT_PATH_FILES = ("serving.py",)  # pre-split path; tests still use it
 _STEP_NAME_HINT = ("step", "train", "update")
 
 

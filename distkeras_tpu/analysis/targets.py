@@ -91,6 +91,12 @@ def lm_targets() -> list[TraceSpec]:
 
 
 def serving_targets() -> list[TraceSpec]:
+    """Both serving engines' real jitted programs, reached through the
+    split package (serving/lanes.py, serving/speculative.py): the
+    decode/draft-verify steps AND the admission chunk programs (the
+    round-10 engine builds — chunked-prefill continuations and
+    prefix-pool gathers share the admission program shape, so the
+    pooled ContinuousBatcher variant below covers the gather path)."""
     import jax
 
     import distkeras_tpu as dk
@@ -101,12 +107,17 @@ def serving_targets() -> list[TraceSpec]:
     cb = dk.ContinuousBatcher(params, cfg, lanes=2,
                               per_request_sampling=True,
                               prompt_buckets=(8,))
+    pool = dk.PrefixPool(cfg, slots=2)
+    cbp = dk.ContinuousBatcher(params, cfg, lanes=2,
+                               prompt_buckets=(8,), prefill_chunk=8,
+                               prefix_pool=pool)
     draft = tfm.TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
                                   n_layers=1, d_ff=32, max_len=16)
     dparams = tfm.init_params(jax.random.key(1), draft)
     sb = dk.SpeculativeBatcher(params, dparams, cfg, draft, lanes=2,
                                n_draft=2, temperature=0.7)
-    return cb.traced_for_analysis() + sb.traced_for_analysis()
+    return (cb.traced_for_analysis() + cbp.traced_for_analysis()
+            + sb.traced_for_analysis())
 
 
 def _pair(specs: list[TraceSpec]) -> list[TraceSpec]:

@@ -1,0 +1,52 @@
+"""Continuous batching: lane-based serving engines over the decode step.
+
+Round 10 split the five-PR serving monolith into this package; the
+public API is unchanged — ``from distkeras_tpu.serving import
+ContinuousBatcher`` (and every other name below) works exactly as it
+did against the old ``serving.py``.  Layout:
+
+- :mod:`~distkeras_tpu.serving.engine` — the shared host lane
+  machinery (`_LaneEngine`): lane table, emission loop, chunked-
+  prefill scheduler, and the single-lane admission program factories.
+- :mod:`~distkeras_tpu.serving.lanes` — :class:`ContinuousBatcher`,
+  the plain/per-request-sampling/rolling/kv-int8 engine.
+- :mod:`~distkeras_tpu.serving.admission` — the admission-control
+  mixin (deadlines, bounded queue + :class:`QueueFull` backpressure,
+  structured :class:`RequestResult`, drain-then-shutdown) and the
+  re-exported result/exception types.
+- :mod:`~distkeras_tpu.serving.speculative` —
+  :class:`SpeculativeBatcher`, draft-assisted lanes.
+- :mod:`~distkeras_tpu.serving.elastic` — elastic lane tiers
+  (pre-compiled load-driven resizing).
+- :mod:`~distkeras_tpu.serving.prefix` — :class:`PrefixPool`, the
+  refcounted multi-prefix KV pool (round 10).
+
+The reference has no serving story at all (its ModelPredictor runs the
+training forward over a static batch — reference:
+distkeras/predictors.py); this package is TPU-first surplus on the
+serving axis.  Start at docs/serving_guide.md.
+
+Contract (both engines): every request's emitted tokens are EXACTLY
+what its solo ``generate``/``speculative_generate`` run would emit —
+per-lane PRNG streams are position/iteration-keyed, lane-local
+positions start at the request's prefix offset, and stale cache slots
+from a lane's previous occupant are masked until overwritten.  Pinned
+by tests/test_serving.py and tests/test_speculative.py.
+"""
+
+from distkeras_tpu.serving.admission import (EngineClosed, QueueFull,
+                                             RequestResult)
+from distkeras_tpu.serving.lanes import (KV_INT8_LANE_ADVISORY,
+                                         ContinuousBatcher)
+from distkeras_tpu.serving.prefix import PrefixPool
+from distkeras_tpu.serving.speculative import SpeculativeBatcher
+
+__all__ = [
+    "ContinuousBatcher",
+    "SpeculativeBatcher",
+    "PrefixPool",
+    "RequestResult",
+    "QueueFull",
+    "EngineClosed",
+    "KV_INT8_LANE_ADVISORY",
+]

@@ -1,0 +1,237 @@
+"""Shared lane machinery for the serving engines.
+
+``_LaneEngine`` is the host-side core both engines build on: the lane
+table (free/running/drain), the per-step emission loop, the chunked-
+prefill scheduler, and — via the mixins it composes — admission
+control (:mod:`distkeras_tpu.serving.admission`) and elastic lane
+tiers (:mod:`distkeras_tpu.serving.elastic`).  The compiled-program
+factories for single-lane admission live here too, shared by
+:class:`~distkeras_tpu.serving.lanes.ContinuousBatcher` and
+:class:`~distkeras_tpu.serving.speculative.SpeculativeBatcher`.
+
+Everything in this module is host bookkeeping or a jit factory; the
+decode-step programs themselves are each engine's own.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu import obs
+from distkeras_tpu.models.generate import _decode_chunk
+from distkeras_tpu.serving.admission import _AdmissionMixin
+from distkeras_tpu.serving.elastic import _ElasticMixin
+
+
+@dataclasses.dataclass
+class _Lane:
+    request_id: int
+    prompt_len: int
+    max_new: int
+    key: object          # per-request PRNG key (None for greedy)
+    tokens: list         # host-side transcript, prompt included
+    done: bool = False
+    eos: object = None   # per-request eos token (engine default)
+    deadline: float | None = None  # absolute clock() time; None = none
+    managed: bool = False  # admitted via enqueue(): auto-collected
+    born: float | None = None  # clock() at admission (obs latency)
+    # Chunked prefill (round-10): remaining (start, rows) admission
+    # chunks; non-None means the lane is still ADMITTING — parked out
+    # of the emission loop until the last chunk lands.
+    chunks: list | None = None
+    # Shared-prefix bookkeeping: the request's prefix length (0 =
+    # none) and its PrefixPool id (refcount released at vacation).
+    off: int = 0
+    prefix_id: int | None = None
+
+
+def _make_lane_admit(model_params, model_cfg, prefix_lane=None,
+                     pooled: bool = False, seed: bool = True):
+    """ONE-lane admission program factory shared by both engines:
+    prefill ``rows`` (bucket-padded) into a single lane's cache slice
+    at traced start position ``off``, seeded from the engine's static
+    ``prefix_lane``, from a :class:`PrefixPool` slab gather
+    (``pooled=True`` — the program takes ``(slab, slot)``; ``slot < 0``
+    means "no prefix", seeding zeros), or from zeros — a fresh
+    occupant must never see the previous request's K/V beyond its own
+    positions.  ``seed=False`` builds the CONTINUATION program for
+    chunked prefill: the chunk lands on the lane's existing cache
+    (earlier chunks) untouched.
+
+    ``off`` is traced, so one program per bucket-padded ``rows`` shape
+    serves every prefix length and every chunk offset.
+    """
+    def admit(cache, rows, lane, off, *pool):
+        lane_cache = jax.tree.map(
+            lambda a: jax.lax.dynamic_slice_in_dim(a, lane, 1, axis=1),
+            cache)
+        if seed:
+            if pooled:
+                slab, slot = pool
+                # Gather the segment; slot < 0 selects the zero seed
+                # (the gather still runs — admission is off the decode
+                # hot path and a branch would compile both sides
+                # anyway).
+                seg = jax.tree.map(
+                    lambda a: jnp.take(a, jnp.maximum(slot, 0), axis=0),
+                    slab)
+                lane_cache = jax.tree.map(
+                    lambda z, pre: jnp.where(slot >= 0,
+                                             pre.astype(z.dtype),
+                                             jnp.zeros_like(z)),
+                    lane_cache, seg)
+            elif prefix_lane is not None:
+                # prefill() returns a full-max_len cache with the
+                # prefix slots filled and the rest zero — exactly the
+                # fresh-lane seed we need.
+                lane_cache = jax.tree.map(
+                    lambda z, pre: pre.astype(z.dtype),
+                    lane_cache, prefix_lane)
+            else:
+                lane_cache = jax.tree.map(jnp.zeros_like, lane_cache)
+        _, lane_cache = _decode_chunk(
+            model_params, lane_cache, rows,
+            jnp.reshape(off, (1,)).astype(jnp.int32), model_cfg,
+            uniform_pos=True)
+        return jax.tree.map(
+            lambda a, u: jax.lax.dynamic_update_slice_in_dim(
+                a, u, lane, axis=1), cache, lane_cache)
+    return jax.jit(admit, donate_argnums=0)
+
+
+def _make_lane_reseed(prefix_lane=None, pooled: bool = False):
+    """Prefix copy into one lane WITHOUT an admission chunk (1-token
+    prompts skip the chunk but still need the prefix K/V)."""
+    def reseed(cache, lane, *pool):
+        if pooled:
+            slab, slot = pool
+            pre = jax.tree.map(lambda a: jnp.take(a, slot, axis=0), slab)
+        else:
+            pre = prefix_lane
+        return jax.tree.map(
+            lambda a, p: jax.lax.dynamic_update_slice_in_dim(
+                a, p.astype(a.dtype), lane, axis=1), cache, pre)
+    return jax.jit(reseed, donate_argnums=0)
+
+
+class _LaneEngine(_AdmissionMixin, _ElasticMixin):
+    """Host-side lane machinery shared by the serving engines: the
+    lane table, free/running/drain, the per-step emission loop (append
+    to the transcript, stop at budget or the lane's eos), and the
+    chunked-prefill scheduler.
+
+    Also composes the admission-control layer (resilience subsystem —
+    deadlines/TTLs, the bounded FIFO queue with :class:`QueueFull`
+    backpressure, structured :class:`RequestResult` reporting, the
+    drain-then-shutdown lifecycle) and the elastic-tier bookkeeping.
+    All of it is host bookkeeping — the compiled decode programs and
+    their exact-parity contract are untouched (an evicted lane just
+    stops being read; its rows keep burning compute until admission
+    reseeds them, same as any done lane)."""
+
+    # Engines without a pool leave this None; ContinuousBatcher /
+    # SpeculativeBatcher set it from their ``prefix_pool=`` argument.
+    _prefix_pool = None
+
+    def free_lanes(self):
+        return [i for i, s in enumerate(self._lane_state) if s is None]
+
+    def running(self):
+        return [i for i, s in enumerate(self._lane_state)
+                if s is not None and not s.done]
+
+    def drain(self, lane):
+        """Return the finished lane's [prompt + generation] tokens and
+        free the lane; raises if the lane is still running."""
+        st = self._lane_state[lane]
+        if st is None:
+            raise ValueError(f"lane {lane} is empty")
+        if not st.done:
+            raise ValueError(f"lane {lane} is still decoding")
+        self._vacate(lane)
+        self._obs_request_done("ok", st.born)
+        return np.asarray(st.tokens, np.int32)
+
+    def _vacate(self, lane) -> None:
+        """THE one lane-release path (drain, reap, eviction, shutdown
+        cancellation): frees the lane slot, drops it from the chunked-
+        admission queue, and releases its prefix-pool pin."""
+        st = self._lane_state[lane]
+        self._lane_state[lane] = None
+        if st is None:
+            return
+        if st.chunks is not None:
+            try:
+                self._admitting.remove(lane)
+            except ValueError:  # pragma: no cover — defensive
+                pass
+        if st.prefix_id is not None and self._prefix_pool is not None:
+            self._prefix_pool.release(st.prefix_id)
+
+    def _emit(self, lane_tokens):
+        """Feed each live lane's new tokens (``lane_tokens(lane)``)
+        through the transcript/budget/eos bookkeeping; returns the
+        ``{lane: [emitted...]}`` step result.  The ONE site that
+        counts emitted tokens (``serving.tokens``) — every step path
+        funnels through here, so the throughput metric is
+        structurally complete.  Lanes still ADMITTING (pending prefill
+        chunks) are parked: their decode rows are burnt compute, never
+        emission."""
+        out = {}
+        for lane, st in enumerate(self._lane_state):
+            if st is None or st.done or st.chunks is not None:
+                continue
+            emitted = []
+            for tok in lane_tokens(lane):
+                st.tokens.append(int(tok))
+                emitted.append(int(tok))
+                budget = len(st.tokens) - st.prompt_len >= st.max_new
+                if budget or (st.eos is not None and tok == st.eos):
+                    st.done = True
+                    break
+            out[lane] = emitted
+        if obs.active() is not None:
+            obs.count("serving.tokens",
+                      sum(len(v) for v in out.values()))
+        return out
+
+    # --------------------------------------------- chunked admission
+
+    def _run_pending_chunk(self) -> None:
+        """Execute ONE pending admission chunk (FIFO across admitting
+        lanes) — called at the top of every ``step()``, so a long
+        prompt's prefill interleaves with decode at one chunk per step
+        and the other lanes' inter-token gap stays bounded by one
+        chunk.  Completing the last chunk un-parks the lane: its
+        position/current-token are set and it joins THIS step's decode
+        (the same "admission then the next step processes the final
+        prompt token" convention as monolithic admission)."""
+        if not self._admitting:
+            return
+        lane = self._admitting[0]
+        st = self._lane_state[lane]
+        start, rows = st.chunks.pop(0)
+        with obs.span("serving.admit_chunk", bucket=rows.shape[1],
+                      remaining=len(st.chunks)):
+            self._exec_chunk(lane, start, rows)
+        if not st.chunks:
+            self._admitting.popleft()
+            st.chunks = None
+            self._finish_admission(lane, st)
+
+    def _exec_chunk(self, lane, start, rows):  # pragma: no cover
+        raise NotImplementedError(
+            "this engine does not support chunked prefill")
+
+    def _finish_admission(self, lane, st):  # pragma: no cover
+        raise NotImplementedError(
+            "this engine does not support chunked prefill")
+
+
+__all__ = ["_Lane", "_LaneEngine", "_make_lane_admit",
+           "_make_lane_reseed"]

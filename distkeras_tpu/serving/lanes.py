@@ -1,0 +1,915 @@
+"""ContinuousBatcher: lane-based continuous batching over one jitted
+decode step.
+
+Static-shape serving loop for interactive workloads: requests arrive
+at different times, but the chip wants one fixed-shape program.  The
+engine holds ``lanes`` decode rows in ONE KV cache and ONE jitted
+per-row-position decode step; a new request is admitted into any free
+lane mid-flight with a bucket-padded chunked prefill of just that
+lane, while the other lanes keep decoding.  No compiled shape ever
+depends on arrival times.
+
+Contract: every request's emitted tokens are EXACTLY what
+``generate(params, prompt, cfg, max_new_tokens, ...)`` would emit for
+it alone — the per-lane PRNG stream is position-keyed like generate's
+(``fold_in(request_key, pos)``), lane-local positions start at 0 per
+request, and stale cache slots from the lane's previous occupant are
+masked until overwritten (the ``_decode_chunk`` staleness argument).
+Pinned by tests/test_serving.py against solo ``generate`` runs,
+including staggered admission and lane reuse.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu import obs
+from distkeras_tpu.resilience import chaos
+
+from distkeras_tpu.models.generate import (
+    _decode_chunk,
+    _device_tree,
+    _resolve_prompt_cache,
+    init_cache,
+    min_p_mask,
+    rolling_eligible,
+    top_k_mask,
+    top_p_mask,
+)
+from distkeras_tpu.models.transformer import TransformerConfig
+from distkeras_tpu.serving.elastic import _ElasticLanesMixin
+from distkeras_tpu.serving.engine import (_Lane, _LaneEngine,
+                                          _make_lane_admit,
+                                          _make_lane_reseed)
+
+# The measured cache-bound crossover for the int8 KV cache: +33% at
+# b64, -15% at b8 (docs/serving_guide.md's byte-lever table).  Engines
+# built with kv_int8 below this lane count get a construction-time
+# advisory — the cache-byte saving cannot pay for the dequant cost at
+# batch sizes where weights, not cache, dominate the step's traffic.
+KV_INT8_LANE_ADVISORY = 16
+
+
+class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
+    """Lane-based continuous batching over one jitted decode step.
+
+    Args mirror ``generate``'s sampling surface: ``temperature``,
+    ``top_k`` / ``top_p`` / ``min_p``, ``eos_token``, ``exact_top_k``
+    — fixed per engine (they are compiled into the step).  Per-request
+    PRNG keys arrive with ``submit``.
+
+    ``per_request_sampling=True`` compiles the vectorized step instead
+    (per-lane temperature/top_p/min_p carried as [lanes] device
+    arrays): ``submit`` then takes per-request ``temperature`` /
+    ``top_p`` / ``min_p`` / ``eos_token`` overrides — greedy and
+    sampled requests mix in one batch, each still matching its solo
+    ``generate`` run exactly.  The constructor values become the
+    per-request DEFAULTS.  Off by default because the general program
+    pays the nucleus sort and the sampling draw every step even for a
+    greedy-only workload; ``top_k`` stays engine-level either way (a
+    static shape baked into the program).
+
+    ``lanes``: decode rows held by the engine; ``prompt_buckets``:
+    admission pad widths (a prompt of length P uses the smallest
+    bucket >= P - 1; one admission program compiles per bucket).
+
+    Full-cache configs, or rope + ``attention_window`` configs — the
+    latter run ROLLING lanes: every lane decodes past ``max_len`` on
+    the ring-buffer cache with no total-length cap (prompts still must
+    fit the ring), each request matching its solo rolling
+    ``generate()`` run exactly.  No quantized-tree restriction — int8
+    weights decode on the same chunk path — and every engine shape
+    takes ``kv_int8=True`` (int8 KV cache; parity vs
+    ``generate(kv_int8=True, use_prefill=False)``), rolling ring
+    lanes included (round-5: the scale slabs ride the same ring-slot
+    updates as the K/V).
+
+    **Chunked prefill** (round-10, ``prefill_chunk=``): admission of a
+    prompt longer than ``prefill_chunk`` tokens no longer runs as one
+    monolithic chunk that stalls every lane — it is split into
+    fixed-size, bucket-padded chunks, the first executed at ``submit``
+    and the rest interleaved one per ``step()`` between decode
+    dispatches, so concurrently decoding lanes' inter-token gap is
+    bounded by ONE chunk's compute.  The parked lane joins decode the
+    step its last chunk lands; emitted tokens are identical to
+    monolithic admission (the chunks write exactly the same K/V).
+    Full-cache configs only, and every chunk program compiles at
+    construction (the ``serving_chunked`` compile session pins a
+    zero-recompile serve phase).  The ``prefill_chunk`` width is added
+    to ``prompt_buckets``.
+
+    **Prefix pool** (round-10, ``prefix_pool=``): attach a
+    :class:`~distkeras_tpu.serving.PrefixPool` and ``submit`` /
+    ``enqueue`` take ``prefix_id=`` — the lane is seeded from the
+    pooled prefilled segment by a device gather, so the prefix tokens
+    cost ZERO prefill work per request, across N distinct prefixes on
+    one engine (the generalization of the single ``prompt_cache=``
+    prefix, ``kv_int8`` layouts included — the pool's quantization
+    must match the engine's).  Requests pin their entry (refcount)
+    until the lane is vacated; queued requests do not pin, so a prefix
+    evicted while its request queues surfaces as a structured
+    ``"error"`` result.  Parity: a pooled request matches
+    ``generate(tail, prompt_cache=(segment, P))`` exactly, greedy and
+    sampled.  Mutually exclusive with ``prompt_cache`` and with
+    rolling (windowed) engines.
+
+    **Elastic lane tiers** (round-7, resilience subsystem):
+    ``lane_tiers=(2, 4, 8)`` starts the engine at 2 lanes and moves it
+    between the declared tiers under load — ``scale_up_after``
+    consecutive queue overflows step the tier up (the overflowing
+    enqueue is absorbed instead of raising :class:`QueueFull`);
+    ``scale_down_after`` consecutive steps with the queue empty and
+    occupancy fitting the next tier down step it back (free lanes burn
+    a decode row per step — shrinking recovers that compute).  EVERY
+    tier's programs — each ``step_windows`` decode window, each
+    admission bucket, the inter-tier resize gathers — compile at
+    construction, so no request ever pays a recompile
+    (``scripts/check_compile_counts.py``'s ``serving_elastic`` budget
+    pins it).  A resize compacts occupied lanes; lane ids are
+    therefore unstable, so elastic engines admit through the id-keyed
+    :meth:`enqueue` surface only (bare ``submit`` rejects).
+    ``serving.lanes_tier`` / ``serving.resizes`` /
+    ``serving.resize`` events expose the tier trajectory through obs,
+    and ``tier_epoch`` counts resizes for drain/debug correlation.
+
+    ``step_windows`` declares the ``step(n)`` window sizes to
+    pre-compile.  Elastic engines are restricted to the declared set;
+    chunked-prefill and prefix-pool engines warm the declared set at
+    construction (undeclared windows still compile lazily); plain
+    engines ignore it beyond validation.
+    """
+
+    def __init__(self, params, cfg: TransformerConfig, lanes: int = 8,
+                 temperature: float = 0.0, top_k=None, top_p=None,
+                 min_p=None, eos_token=None, exact_top_k: bool = False,
+                 prompt_buckets=(8, 32, 128, 512), prompt_cache=None,
+                 kv_int8: bool = False,
+                 per_request_sampling: bool = False,
+                 max_queue: int = 0, clock=None,
+                 lane_tiers=None, scale_up_after: int = 2,
+                 scale_down_after: int = 8, step_windows=(1,),
+                 prefill_chunk: int | None = None, prefix_pool=None):
+        # Windowed configs: the engine runs ROLLING lanes — each lane
+        # decodes past max_len on the ring-buffer cache (the unbounded
+        # streaming-chat shape), which needs rope (positions beyond
+        # max_len have no learned-table embedding) and a window that
+        # fits the ring.  Non-rope windowed configs have no rolling
+        # semantics, so they stay rejected rather than silently
+        # becoming bounded.
+        self._rolling = False
+        if cfg.attention_window is not None:
+            if not rolling_eligible(cfg):
+                raise ValueError(
+                    "windowed continuous batching runs rolling lanes, "
+                    "which needs rope=True and attention_window <= "
+                    "max_len (full-cache configs need no window)")
+            if prompt_cache is not None:
+                raise ValueError("prompt_cache requires a full-cache "
+                                 "config (no attention_window)")
+            if prefix_pool is not None:
+                raise ValueError("prefix_pool requires a full-cache "
+                                 "config (no attention_window)")
+            if prefill_chunk is not None:
+                raise ValueError(
+                    "chunked prefill (prefill_chunk=) requires a "
+                    "full-cache config: a rolling ring has no parking "
+                    "slot whose garbage writes stay masked, and ring "
+                    "prompts are already bounded by the ring size")
+            # kv_int8 composes: the int8 ring slab is the same
+            # slot-addressed slab update with scale slabs riding along.
+            self._rolling = True
+        # Elastic lane tiers (resilience subsystem): the engine starts
+        # at the smallest tier and moves between PRE-COMPILED tiers
+        # under load — every tier's programs compile at construction,
+        # so no request ever pays a recompile (the admission-latency
+        # analogue of the prompt-bucket contract).
+        _tiers = None
+        _windows = tuple(sorted({int(n) for n in step_windows}))
+        if not _windows or _windows[0] < 1:
+            raise ValueError(
+                f"step_windows must be positive ints, got "
+                f"{step_windows}")
+        if lane_tiers is not None:
+            _tiers = tuple(sorted({int(t) for t in lane_tiers}))
+            if len(_tiers) < 2:
+                raise ValueError(
+                    f"lane_tiers needs >= 2 distinct tiers, got "
+                    f"{lane_tiers} (a single fixed size is just lanes=)")
+            if _tiers[0] < 1:
+                raise ValueError(f"lane tiers must be >= 1, got {_tiers}")
+            if scale_up_after < 1 or scale_down_after < 1:
+                raise ValueError(
+                    "scale_up_after/scale_down_after must be >= 1 "
+                    f"(got {scale_up_after}, {scale_down_after})")
+            if 1 not in _windows:
+                raise ValueError(
+                    "step_windows must include 1 — drain/shutdown "
+                    "steps one token at a time")
+            if max_queue < 1:
+                raise ValueError(
+                    "lane_tiers needs max_queue >= 1: the queue "
+                    "overflow IS the scale-up signal")
+            lanes = _tiers[0]
+        if lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes}")
+        if prompt_cache is not None and prefix_pool is not None:
+            raise ValueError(
+                "pass prompt_cache (ONE engine-level prefix, baked "
+                "into admission) OR prefix_pool (per-request pooled "
+                "prefixes), not both")
+        if prompt_cache is not None and prompt_cache[1] >= cfg.max_len:
+            raise ValueError(
+                f"shared prefix length {prompt_cache[1]} must leave "
+                f"room under max_len={cfg.max_len}")
+        if (temperature <= 0
+                and (top_k
+                     or (top_p is not None and top_p < 1.0)
+                     or (min_p is not None and min_p > 0.0))
+                and not per_request_sampling):
+            # With per-request sampling the constructor values are only
+            # DEFAULTS; a filter default alongside a greedy default
+            # temperature is legal (it applies to requests that
+            # override the temperature).  The explicit no-op values
+            # (top_p=1.0 / min_p=0.0) are legal everywhere — the same
+            # round-6 contract as generate and submit().
+            raise ValueError(
+                "top_k/top_p/min_p need temperature > 0 (greedy always "
+                "takes the argmax)")
+        # Eager range checks: the scalar step validates these lazily at
+        # first trace, but the per-request path bakes them into device
+        # arrays where a bad value would sample silent garbage
+        # (log of a negative min_p is NaN, which masks every token).
+        if temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        # min_p=0.0 is the explicit "no filter" value on EVERY engine
+        # mode (round-6: same contract as generate and submit()).
+        if min_p is not None and not 0.0 <= min_p <= 1.0:
+            raise ValueError(f"min_p must be in [0, 1], got {min_p}")
+        if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
+            raise ValueError(
+                f"eos_token {eos_token} outside vocab [0, "
+                f"{cfg.vocab_size})")
+        self.params = _device_tree(params)
+        self.cfg = cfg
+        self.lanes = lanes
+        # Shared prefix (system prompt): every lane's request decodes
+        # past a common prefilled prefix — same contract as
+        # generate(prompt_cache=...); admission seeds the lane from the
+        # prefix instead of zeros and all positions shift by its length.
+        self._off = 0
+        self._prefix_lane = None
+        if prompt_cache is not None:
+            # The ONE prompt_cache contract (generate's helper): batch
+            # must be 1 here (b=1), the prefix quantization must match
+            # the engine cache (build it with prefill(kv_int8=...)),
+            # and the loosest budget (p=1, one new token) must fit;
+            # per-request budgets are re-checked at submit.
+            pc, self._off = _resolve_prompt_cache(
+                prompt_cache, cfg, b=1, p=1, max_new_tokens=1,
+                kv_int8=kv_int8, use_prefill=None)
+            self._prefix_lane = jax.tree.map(jnp.asarray, pc)
+        if prefix_pool is not None:
+            if prefix_pool.draft_cfg is not None:
+                raise ValueError(
+                    "this pool holds (target, draft) speculative "
+                    "pairs; build a plain PrefixPool(cfg, ...) for "
+                    "ContinuousBatcher")
+            if prefix_pool.kv_int8 != kv_int8:
+                raise ValueError(
+                    "prefix_pool quantization must match kv_int8= "
+                    "(build the pool with the engine's kv_int8)")
+            want = jax.eval_shape(
+                lambda: init_cache(cfg, 1, kv_int8=kv_int8))
+            got = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+                prefix_pool.slab)
+            if (jax.tree.structure(want) != jax.tree.structure(got)
+                    or jax.tree.leaves(want) != jax.tree.leaves(got)):
+                raise ValueError(
+                    f"prefix_pool was built for a different config "
+                    f"(pool segment {got}, engine cache {want})")
+        self._prefix_pool = prefix_pool
+        self.eos_token = eos_token
+        self.temperature = temperature
+        self.top_p = top_p
+        self.min_p = min_p
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {prefill_chunk}")
+            if self._off + prefill_chunk > cfg.max_len:
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} exceeds the cache "
+                    f"slots past the prefix "
+                    f"({cfg.max_len - self._off})")
+        self.prefill_chunk = prefill_chunk
+        # Buckets clamp to the cache slots past the shared prefix and
+        # always include the largest legal width (and the chunk width,
+        # so chunked admission's full chunks have an exact program).
+        cap = cfg.max_len - self._off
+        self._buckets = tuple(sorted(
+            {min(int(w), cap) for w in prompt_buckets} | {cap}
+            | ({prefill_chunk} if prefill_chunk else set())))
+        self._lane_state: list[_Lane | None] = [None] * lanes
+        self._next_id = 0
+        # Admission control (resilience subsystem): ``max_queue`` bounds
+        # the enqueue() backlog (0 = no queue: enqueue needs a free
+        # lane); ``clock`` is the deadline clock (monotonic seconds;
+        # injectable for deterministic chaos tests).
+        self._init_admission(max_queue, clock)
+        if _tiers is not None:
+            self.lane_tiers = _tiers
+            self.scale_up_after = scale_up_after
+            self.scale_down_after = scale_down_after
+        self._step_windows = _windows
+
+        # Device state: one cache, per-lane next-position, per-lane
+        # current token (the one the next step processes), per-lane key.
+        # ``kv_int8``: the cache stores int8 K/V + f32 scales — halves
+        # the dominant HBM term at batch where cache bytes rule
+        # (+33% measured at b64, a LOSS at b8; see perf_serving.md) —
+        # and every request still matches its solo
+        # ``generate(kv_int8=True, use_prefill=False)`` run exactly:
+        # both the admission chunk and the sequential path attend the
+        # ALREADY-QUANTIZED cache position by position, unlike
+        # prefill() which attends the prompt in full precision.
+        # (Stored for introspection only, like ``lanes``; the runtime
+        # switch is the ``k_scale`` leaf in ``self.cache``.)
+        self.kv_int8 = kv_int8
+        if kv_int8 and max(_tiers or (lanes,)) < KV_INT8_LANE_ADVISORY:
+            # Construction-time advisory (round-10 satellite): at small
+            # lane counts decode is weight-bound and the int8 cache is
+            # a measured LOSS (-15% at b8); the lever pays only where
+            # cache bytes dominate.  See docs/serving_guide.md's
+            # byte-lever table for the regime boundary.
+            msg = (f"kv_int8=True with {max(_tiers or (lanes,))} lanes:"
+                   f" the int8 KV cache is a measured loss below "
+                   f"~{KV_INT8_LANE_ADVISORY} lanes (-15% at b8; "
+                   "docs/serving_guide.md byte-lever table) — decode "
+                   "is weight-bound there, so the cache-byte saving "
+                   "cannot pay for the dequant")
+            warnings.warn(msg, RuntimeWarning, stacklevel=2)
+            obs.event("serving.advisory", kind="kv_int8_small_lanes",
+                      lanes=max(_tiers or (lanes,)), detail=msg)
+        self.per_request_sampling = per_request_sampling
+        self.cache = init_cache(cfg, lanes, kv_int8=kv_int8)
+        self.pos = jnp.zeros((lanes,), jnp.int32)
+        self.cur = jnp.zeros((lanes,), jnp.int32)
+        sampling = temperature > 0 or per_request_sampling
+        self.keys = (jnp.stack([jax.random.key(0)] * lanes)
+                     if sampling else None)
+        # Per-lane sampling params (per_request_sampling only):
+        # constructor values are the defaults; submit() overrides the
+        # admitted lane's slots.  top_p 1.0 / min_p 0.0 are exact
+        # no-ops in the row-wise masks.
+        if per_request_sampling:
+            # Explicit dtype: weak-typed f32 and plain f32 are distinct
+            # jit avals, and the elastic warmup's dummy states must hit
+            # the exact programs the live state will use.
+            self.temps = jnp.full((lanes,), float(temperature),
+                                  jnp.float32)
+            self.tps = jnp.full((lanes,), float(top_p or 1.0),
+                                jnp.float32)
+            self.mps = jnp.full((lanes,), float(min_p or 0.0),
+                                jnp.float32)
+        else:
+            # Placeholder args keep one step signature across modes
+            # (allocated once — step() is the latency-floor hot loop).
+            self.temps = self.tps = self.mps = jnp.zeros((lanes,),
+                                                         jnp.float32)
+        if self.keys is None:
+            self.keys = jnp.zeros((lanes,), jnp.int32)  # unused filler
+            self._keyed = False
+        else:
+            self._keyed = True
+
+        def pick(k, row, q):
+            return jax.random.categorical(
+                jax.random.fold_in(k, q), row)
+
+        def one_step(cache, cur, pos, keys, temps, tps, mps):
+            logits, cache = _decode_chunk(
+                self.params, cache, cur[:, None], pos, cfg)
+            logits = logits[:, 0]                      # [lanes, V]
+            if per_request_sampling:
+                # Vectorized per-lane params: greedy lanes (t <= 0)
+                # take the argmax of the RAW logits; the sampled draw
+                # is computed for every lane (one static program) and
+                # selected per lane.
+                safe_t = jnp.where(temps > 0, temps, 1.0)
+                scaled = logits / safe_t[:, None]
+                if top_k is not None:
+                    scaled = top_k_mask(scaled, top_k, exact=exact_top_k)
+                # tps == 1.0 rows bypass the nucleus mask entirely:
+                # float cumsum can overshoot 1.0 and mask an
+                # underflowed-tail token that solo generate (which
+                # skips the mask when top_p is None) could sample —
+                # the bypass keeps the exact-parity contract.
+                # min_p's 0.0 no-op is exact as-is (log 0 = -inf).
+                scaled = jnp.where(tps[:, None] >= 1.0, scaled,
+                                   top_p_mask(scaled, tps[:, None]))
+                scaled = min_p_mask(scaled, mps[:, None])
+                nxt = jnp.where(temps > 0,
+                                jax.vmap(pick)(keys, scaled, pos),
+                                logits.argmax(axis=-1))
+            elif temperature > 0:
+                scaled = logits / temperature
+                if top_k is not None:
+                    scaled = top_k_mask(scaled, top_k, exact=exact_top_k)
+                # top_p >= 1.0 bypasses the mask, like the per-request
+                # path and generate's scalar path (round-6 parity fix):
+                # the sorted cumsum can float-overshoot 1.0 and mask an
+                # underflowed tail token "no filter" could sample.
+                if top_p is not None and top_p < 1.0:
+                    scaled = top_p_mask(scaled, top_p)
+                # min_p 0.0 likewise means "no filter" (and the scalar
+                # mask rejects a concrete 0.0 outright).
+                if min_p is not None and min_p > 0.0:
+                    scaled = min_p_mask(scaled, min_p)
+                nxt = jax.vmap(pick)(keys, scaled, pos)
+            else:
+                nxt = logits.argmax(axis=-1)
+            # Device-side invariant (full-cache engines): pos NEVER
+            # exceeds max_len - 1.  Free/done lanes keep decoding (the
+            # price of one static program) and would otherwise advance
+            # unboundedly; the clamp pins them to re-processing the
+            # last slot — their outputs are discarded and admission
+            # reseeds the lane, so correctness no longer leans on
+            # dynamic_update_slice's start-clamping.  Live lanes are
+            # unaffected: submit() budgets guarantee they finish at
+            # pos <= max_len - 1.  Chunk-ADMITTING lanes park here too:
+            # their garbage writes pin to the last slot, which the
+            # request's own final decode step rewrites.  ROLLING
+            # (windowed) engines are the exception by design: pos is
+            # unbounded (the ring slot is pos % max_len), for idle
+            # lanes too — harmless, since their writes land in slots
+            # admission reseeds and the all-idle early-out in step()
+            # stops the clock entirely.
+            nxt_pos = (pos + 1 if self._rolling
+                       else jnp.minimum(pos + 1, cfg.max_len - 1))
+            return cache, nxt.astype(jnp.int32), nxt_pos
+
+        def make_step(n):
+            def step_n(cache, cur, pos, keys, temps, tps, mps):
+                def body(carry, _):
+                    cache, cur, pos = carry
+                    cache, cur, pos = one_step(cache, cur, pos, keys,
+                                               temps, tps, mps)
+                    return (cache, cur, pos), cur
+                (cache, cur, pos), toks = jax.lax.scan(
+                    body, (cache, cur, pos), None, length=n)
+                return cache, cur, pos, toks.T        # [lanes, n]
+            return jax.jit(step_n, donate_argnums=0)
+
+        self._make_step, self._steps = make_step, {}
+
+        # Admission: prefill `width` positions of ONE lane (lane-sliced
+        # cache write; padded tail slots stay masked until the decode
+        # loop overwrites them).  ONE jitted program per bucket shape —
+        # the start offset and pool slot are traced, so every prefix
+        # length and chunk offset shares it.
+        pooled = prefix_pool is not None
+        self._admit = _make_lane_admit(self.params, cfg,
+                                       prefix_lane=self._prefix_lane,
+                                       pooled=pooled)
+        # Chunked prefill: the continuation program lands chunk k > 0
+        # on the lane's existing cache (no reseed — that would erase
+        # the earlier chunks).
+        self._admit_cont = (_make_lane_admit(self.params, cfg,
+                                             seed=False)
+                            if prefill_chunk is not None else None)
+        self._reseed = (_make_lane_reseed(prefix_lane=self._prefix_lane)
+                        if self._prefix_lane is not None else None)
+        self._reseed_pool = (_make_lane_reseed(pooled=True)
+                             if pooled else None)
+
+        if self.lane_tiers is not None:
+            def resize(cache, cur, pos, keys, temps, tps, mps, idx):
+                # Gather lanes idx[j] -> j across the WHOLE device
+                # state; jit specializes one program per (from, to)
+                # tier pair, all warmed below.
+                cache = jax.tree.map(
+                    lambda a: jnp.take(a, idx, axis=1), cache)
+                g = lambda a: jnp.take(a, idx, axis=0)
+                return (cache, g(cur), g(pos), g(keys), g(temps),
+                        g(tps), g(mps))
+
+            # No donation: the gathered output has a different lane
+            # count, so nothing could be reused in place anyway (and
+            # XLA would warn on every tier pair).
+            self._resize = jax.jit(resize)
+            self._compile_tiers()
+        elif prefill_chunk is not None or pooled:
+            # Chunked/pooled engines make the elastic construction-time
+            # promise too: every admission bucket (seeded + chunk
+            # continuation + pool gather) and every DECLARED step
+            # window compiles here, so the serve phase is recompile-
+            # free (the serving_chunked / serving_prefix_pool compile
+            # sessions assert it).  Undeclared step(n) windows still
+            # compile lazily, as on a plain engine.
+            with obs.span("serving.compile_warm", lanes=lanes):
+                self._warm_tier(lanes)
+
+    # ------------------------------------------------------------ API
+
+    def _validate_budget(self, p: int, max_new_tokens: int,
+                         off: int | None = None) -> None:
+        off = self._off if off is None else off
+        if (not self._rolling
+                and off + p + max_new_tokens > self.cfg.max_len):
+            # Rolling engines have no total-length cap: lanes decode
+            # past max_len on the ring (the admission bucket check
+            # below still caps the PROMPT at the ring size — a longer
+            # prompt's chunk would wrap mid-write).
+            raise ValueError(
+                f"prefix ({off}) + prompt ({p}) + "
+                f"max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_len={self.cfg.max_len}")
+        warm = p - 1
+        if warm:
+            # Every chunk of the admission plan must have a padded
+            # write that fits the cache (dynamic_update_slice would
+            # otherwise clamp the start and clobber earlier slots).
+            self._chunk_plan(off, warm)
+
+    def _bucket_for(self, width: int, start: int) -> int:
+        """Smallest admission bucket >= ``width`` whose padded write at
+        ``start`` stays inside the cache."""
+        b = next((w for w in self._buckets
+                  if w >= width and start + w <= self.cfg.max_len),
+                 None)
+        if b is None:
+            raise ValueError(
+                f"no admission bucket fits {width} prompt tokens at "
+                f"cache offset {start} (buckets {self._buckets}, "
+                f"max_len={self.cfg.max_len}); raise prompt_buckets "
+                "or add a finer width")
+        return b
+
+    def _chunk_plan(self, off: int, warm: int) -> list:
+        """The admission plan for ``warm`` prompt tokens decoding past
+        ``off`` cached positions: a list of ``(start, width)`` — rows
+        are materialized at execution.  Monolithic (one bucket-padded
+        chunk at ``off``) unless chunked prefill is on and the warm
+        length exceeds the chunk width; then full ``W``-wide chunks on
+        the ``off + k*W`` grid plus a bucket-padded tail whose start
+        backs up so its padded end lands exactly at the warm frontier
+        (re-prefilling the overlap is idempotent — same tokens, same
+        cache prefix, same K/V).  Raises if any padded write would
+        overflow the cache."""
+        if warm == 0:
+            return []
+        w_chunk = self.prefill_chunk
+        if self._rolling or w_chunk is None or warm <= w_chunk:
+            return [(off, self._bucket_for(warm, off))]
+        m, rem = divmod(warm, w_chunk)
+        plan = [(off + k * w_chunk, w_chunk) for k in range(m)]
+        if plan[-1][0] + w_chunk > self.cfg.max_len:
+            raise ValueError(
+                f"chunked admission grid overflows the cache (chunk at "
+                f"{plan[-1][0]} + {w_chunk} > {self.cfg.max_len})")
+        if rem:
+            # The chunk width is always a bucket (the constructor adds
+            # it), so the smallest bucket >= rem is <= w_chunk < warm:
+            # the backed-up start always lands inside the grid, never
+            # before off, and its end off + warm fits by budget.
+            b = next(w for w in self._buckets if w >= rem)
+            plan.append((off + warm - b, b))
+        return plan
+
+    def _chunk_rows(self, prompt, off: int, start: int,
+                    width: int) -> np.ndarray:
+        """Bucket-padded token rows for the chunk covering positions
+        ``[start, start + width)`` (real tokens up to the warm
+        frontier, zero pad beyond — masked until overwritten)."""
+        warm = prompt.size - 1
+        rows = np.zeros((1, width), np.int32)
+        lo = start - off
+        hi = min(lo + width, warm)
+        rows[0, :hi - lo] = prompt[lo:hi]
+        return rows
+
+    def _exec_chunk(self, lane, start, rows):
+        self.cache = self._admit_cont(self.cache, jnp.asarray(rows),
+                                      jnp.int32(lane), jnp.int32(start))
+
+    def _finish_admission(self, lane, st):
+        """Last chunk landed: un-park the lane — set its decode
+        position past the warm prompt and hand it the final prompt
+        token, exactly where monolithic admission leaves a lane."""
+        self.pos = self.pos.at[lane].set(st.off + st.prompt_len - 1)
+        self.cur = self.cur.at[lane].set(
+            int(st.tokens[st.prompt_len - 1]))
+
+    def submit(self, prompt, max_new_tokens: int, key=None,
+               temperature=None, top_p=None, min_p=None, eos_token=None,
+               ttl=None, deadline=None, prefix_id=None):
+        """Admit one request; returns its lane id, or None if the
+        engine is full.  ``prompt``: 1-D int tokens; ``key``: per-
+        request PRNG key (required iff THIS request samples).
+
+        ``temperature`` / ``top_p`` / ``min_p`` / ``eos_token``:
+        per-request overrides of the engine defaults — engines built
+        with ``per_request_sampling=True`` only (``eos_token`` is
+        host-side bookkeeping and works on every engine).  Pass
+        ``top_p=1.0`` / ``min_p=0.0`` (the explicit no-op values) for
+        an unfiltered request on an engine whose default filters.
+        ``top_p=1.0`` means "no nucleus filter" EVERYWHERE — here,
+        the engine scalar path, and solo ``generate`` all bypass the
+        mask at >= 1.0 (round-6 parity fix), so a request copying its
+        solo call's ``top_p=1.0`` replays that run exactly.
+
+        ``ttl`` (seconds from now) / ``deadline`` (absolute ``clock()``
+        time): the request's deadline.  A request that is already
+        expired never occupies a lane — its structured timeout result
+        is recorded (see :meth:`results`) and None is returned; one
+        that expires mid-decode is evicted at the next ``step()`` the
+        same way.  Deadline-carrying requests report through
+        ``poll``/``take``/``results``, not ``drain``; this request's id
+        is exposed as ``self.last_request_id`` (the queue-level
+        :meth:`enqueue` API wraps all of this and returns the request
+        id directly).
+
+        ``prefix_id``: decode past a pooled prefilled prefix
+        (``prefix_pool=`` engines) — the lane is seeded from the
+        pool's device slab, the prefix tokens run no prefill work, and
+        the output matches ``generate(prompt, cfg, n,
+        prompt_cache=(segment, P))`` exactly.  The entry is pinned
+        until the lane is vacated.
+
+        On a ``prefill_chunk=`` engine, a prompt longer than the chunk
+        width returns its lane immediately but PARKED: the remaining
+        prefill chunks run one per ``step()`` interleaved with decode,
+        and the lane starts emitting when the last chunk lands.
+
+        Elastic engines (``lane_tiers=``) reject bare ``submit``: lane
+        indices are not stable across tier resizes, so requests must go
+        through the id-keyed :meth:`enqueue` surface.
+
+        The whole admission runs under the engine lock, so a submit
+        racing ``begin_shutdown`` either lands its lane before the
+        drain looks (and is drained) or raises EngineClosed — the same
+        contract :meth:`enqueue` documents.
+        """
+        with self._admission_lock:
+            return self._submit_locked(prompt, max_new_tokens, key,
+                                       temperature, top_p, min_p,
+                                       eos_token, ttl, deadline,
+                                       prefix_id)
+
+    def _submit_locked(self, prompt, max_new_tokens, key, temperature,
+                       top_p, min_p, eos_token, ttl, deadline,
+                       prefix_id=None):
+        if self.lane_tiers is not None and not self._admitting_internal:
+            raise ValueError(
+                "elastic engines (lane_tiers=...) admit through "
+                "enqueue(): a tier resize compacts lanes, so the lane "
+                "id submit() would return can dangle")
+        self._check_open()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = prompt.size
+        if p < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if ((temperature is not None or top_p is not None
+             or min_p is not None) and not self.per_request_sampling):
+            raise ValueError(
+                "per-request temperature/top_p/min_p need "
+                "ContinuousBatcher(per_request_sampling=True) — the "
+                "default engine compiles the constructor's sampling "
+                "params into the step")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if min_p is not None and not 0.0 <= min_p <= 1.0:
+            # 0.0 is the explicit "no min-p filter" override.
+            raise ValueError(f"min_p must be in [0, 1], got {min_p}")
+        if temperature is not None and temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
+        if eos_token is not None and not (
+                0 <= eos_token < self.cfg.vocab_size):
+            raise ValueError(
+                f"eos_token {eos_token} outside vocab [0, "
+                f"{self.cfg.vocab_size})")
+        eff_t = self.temperature if temperature is None else temperature
+        if eff_t <= 0 and ((top_p is not None and top_p < 1.0)
+                           or (min_p is not None and min_p > 0.0)):
+            # The explicit no-op values (top_p=1.0 / min_p=0.0) stay
+            # legal on greedy requests — they turn a default filter OFF.
+            raise ValueError(
+                "per-request top_p/min_p need a sampling temperature "
+                f"(effective temperature is {eff_t})")
+        off, slot = self._off, None
+        if prefix_id is not None:
+            # Pin FIRST (see _pin_prefix): from here on, a concurrent
+            # pool.put can never evict this entry, so the slot stays
+            # ours through the slab gather below.  Every non-admission
+            # exit must release the pin.
+            off, slot, _ = self._pin_prefix(prefix_id)
+        try:
+            self._validate_budget(p, max_new_tokens, off=off)
+            if (key is None) == (eff_t > 0):
+                raise ValueError(
+                    "pass a per-request key iff this request samples "
+                    f"(effective temperature={eff_t})")
+            dl = self._deadline_of(ttl, deadline)
+            if self._expired_on_arrival(dl, prompt, p):
+                # The acceptance contract: an already-dead request
+                # never occupies a lane; its timeout is a structured
+                # result.
+                if prefix_id is not None:
+                    self._prefix_pool.release(prefix_id)
+                return None
+            free = self.free_lanes()
+            if not free:
+                self._decline_full()
+                if prefix_id is not None:
+                    self._prefix_pool.release(prefix_id)
+                return None
+            lane = free[0]
+            chaos.probe("serving.admit")
+
+            warm = p - 1
+            plan = self._chunk_plan(off, warm)
+            chunks = None
+            if plan:
+                start0, width0 = plan[0]
+                rows = self._chunk_rows(prompt, off, start0, width0)
+                with obs.span("serving.admit", bucket=width0,
+                              chunks=len(plan)):
+                    if slot is not None:
+                        self.cache = self._admit(
+                            self.cache, jnp.asarray(rows),
+                            jnp.int32(lane), jnp.int32(start0),
+                            self._prefix_pool.slab, jnp.int32(slot))
+                    elif self._prefix_pool is not None:
+                        # Pooled engine, plain request: the gather
+                        # program takes slot -1 = "seed zeros".
+                        self.cache = self._admit(
+                            self.cache, jnp.asarray(rows),
+                            jnp.int32(lane), jnp.int32(start0),
+                            self._prefix_pool.slab, jnp.int32(-1))
+                    else:
+                        self.cache = self._admit(
+                            self.cache, jnp.asarray(rows),
+                            jnp.int32(lane), jnp.int32(start0))
+                if len(plan) > 1:
+                    chunks = [(s, self._chunk_rows(prompt, off, s, w))
+                              for s, w in plan[1:]]
+            elif slot is not None:
+                # 1-token prompt on a pooled prefix: no admission
+                # chunk runs, but the lane still needs the prefix K/V.
+                self.cache = self._reseed_pool(
+                    self.cache, jnp.int32(lane),
+                    self._prefix_pool.slab, jnp.int32(slot))
+            elif self._prefix_lane is not None:
+                # 1-token prompt: no admission chunk runs, but the
+                # lane still needs the shared prefix's K/V
+                # (code-review regression: skipping this read zeros
+                # where the prefix belongs).
+                self.cache = self._reseed(self.cache, jnp.int32(lane))
+            # else: 1-token prompt, no prefix — stale slots stay
+            # masked until the decode loop overwrites them.
+            if chunks is None:
+                self.pos = self.pos.at[lane].set(off + warm)
+                self.cur = self.cur.at[lane].set(int(prompt[-1]))
+            else:
+                # Parked: the lane burns decode rows at the clamp slot
+                # until its last chunk lands (one_step's clamp note).
+                self.pos = self.pos.at[lane].set(self.cfg.max_len - 1)
+                self.cur = self.cur.at[lane].set(0)
+            if self._keyed and key is not None:
+                self.keys = self.keys.at[lane].set(key)
+            if self.per_request_sampling:
+                self.temps = self.temps.at[lane].set(float(eff_t))
+                self.tps = self.tps.at[lane].set(float(
+                    (self.top_p or 1.0) if top_p is None else top_p))
+                self.mps = self.mps.at[lane].set(float(
+                    (self.min_p or 0.0) if min_p is None else min_p))
+
+            # The pin taken above becomes the lane's reference here.
+            self._lane_state[lane] = _Lane(
+                request_id=self._admitted_id(), prompt_len=p,
+                max_new=max_new_tokens, key=key, tokens=list(prompt),
+                eos=self.eos_token if eos_token is None else eos_token,
+                deadline=dl, born=self._clock(), chunks=chunks,
+                off=off, prefix_id=prefix_id)
+        except Exception:
+            # Any failure between pin and lane commit (validation, a
+            # chaos-injected admit fault, a dispatch error) must not
+            # leak the prefix reference.
+            if prefix_id is not None:
+                self._prefix_pool.release(prefix_id)
+            raise
+        if chunks is not None:
+            self._admitting.append(lane)
+        return lane
+
+    def traced_for_analysis(self):
+        """Trace targets for the IR lint (analysis/ir_lint.py): the
+        jitted single-token decode step over the engine's live lane
+        state, plus the admission chunk program at the smallest bucket
+        (the round-10 engine builds — chunked continuations and pool
+        gathers ride the same program shape).  Nothing executes — the
+        lint traces and lowers only."""
+        from distkeras_tpu.analysis.ir_lint import TraceSpec
+
+        if 1 not in self._steps:
+            self._steps[1] = self._make_step(1)
+        mode = ("per_request" if self.per_request_sampling
+                else "sampled" if self.temperature > 0 else "greedy")
+        if self._prefix_pool is not None:
+            mode += "_pooled"
+        rows = jnp.zeros((1, self._buckets[0]), jnp.int32)
+        admit_args = (self.cache, rows, jnp.int32(0),
+                      jnp.int32(self._off))
+        if self._prefix_pool is not None:
+            admit_args += (self._prefix_pool.slab, jnp.int32(0))
+        return [
+            TraceSpec(
+                name=f"continuousbatcher_{mode}/decode_step",
+                fn=self._steps[1],
+                args=(self.cache, self.cur, self.pos, self.keys,
+                      self.temps, self.tps, self.mps),
+                donate_argnums=(0,)),
+            TraceSpec(
+                name=f"continuousbatcher_{mode}/admit_b"
+                     f"{self._buckets[0]}",
+                fn=self._admit, args=admit_args, donate_argnums=(0,)),
+        ]
+
+    def step(self, n: int = 1):
+        """Advance every lane ``n`` tokens in ONE device round-trip;
+        returns ``{lane: [tokens...]}`` for lanes that emitted.
+
+        ``n > 1`` amortizes the per-dispatch host/relay latency (the
+        measured floor is ~1.6 ms — comparable to a whole decode step
+        at batch 8) at the cost of admission granularity: new requests
+        wait for the window to finish, and a lane that hits its
+        eos/budget mid-window keeps decoding privately — the surplus
+        tokens are discarded here, identical to truncating generate()'s
+        sticky-fill output.  Emitted tokens are EXACTLY step(1)'s.
+
+        Chunked prefill runs here too: at most ONE pending admission
+        chunk executes per call (FIFO across parked lanes) before the
+        decode dispatch, so a long prompt admitting never inserts more
+        than one chunk's compute between any two decode rounds.
+
+        Runs under the engine lock end to end: a concurrent
+        ``enqueue`` can trigger a tier resize (scale-up), and the
+        device state this step captures must not be swapped and
+        compacted under it mid-round-trip.
+        """
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        if self.lane_tiers is not None and n not in self._step_windows:
+            raise ValueError(
+                f"elastic engines pre-compile their decode windows; "
+                f"step({n}) is not in step_windows={self._step_windows}"
+                " — declare it at construction (a lazy compile here "
+                "would break the no-recompile contract across tiers)")
+        with self._admission_lock:
+            self.pump()
+            # Tier hysteresis BEFORE the idle early-out: an idle
+            # elastic engine must still step its lane count back down.
+            self._maybe_scale_down()
+            self._run_pending_chunk()
+            # Idle engine (every lane empty, finished-but-undrained,
+            # or still admitting): nothing can emit, so skip the
+            # device round-trip entirely instead of burning a full
+            # decode window.  Reap first: a parked (admitting) lane
+            # whose deadline expired must still be evicted promptly,
+            # not only once decode resumes.
+            if all(s is None or s.done or s.chunks is not None
+                   for s in self._lane_state):
+                self._reap()
+                return {}
+            chaos.probe("serving.step")
+            if obs.active() is not None:  # running() is O(lanes)
+                obs.gauge("serving.lanes_busy", len(self.running()))
+            if n not in self._steps:
+                self._steps[n] = self._make_step(n)
+            with obs.span("serving.step", n=n):
+                self.cache, self.cur, self.pos, toks = self._steps[n](
+                    self.cache, self.cur, self.pos, self.keys,
+                    self.temps, self.tps, self.mps)
+                toks = np.asarray(toks)
+            out = self._emit(lambda lane: toks[lane].tolist())
+            # Deadline granularity is one step window: tokens emitted
+            # in the window that straddles the deadline are kept in
+            # the partial result.
+            self._reap()
+            return out
+
+
+__all__ = ["ContinuousBatcher", "KV_INT8_LANE_ADVISORY"]

@@ -681,6 +681,289 @@ def bench_engine_load(lanes, offered_rps):
     return run
 
 
+def bench_engine_load_elastic(tiers, offered_rps):
+    """Open-loop Poisson load against an ELASTIC engine (the PR-5
+    follow-up): requests go through enqueue/poll (lane ids are
+    unstable across tier resizes), QueueFull is retried at the next
+    loop tick (the shed-or-retry contract), and the row reports
+    achieved throughput + request-latency percentiles plus the tier
+    trajectory (the obs snapshot on the row carries
+    serving.lanes_tier / serving.resizes — main() attaches it)."""
+    def run(n_req=48, p_len=64, new=128, window=4):
+        import numpy as np
+        from distkeras_tpu.serving import ContinuousBatcher, QueueFull
+
+        cfg = _cfg()
+        params = _params()
+        rng = np.random.default_rng(0)
+        arrivals = np.cumsum(rng.exponential(1.0 / offered_rps, n_req))
+        prompts = rng.integers(0, cfg.vocab_size,
+                               (n_req, p_len)).astype(np.int32)
+        eng = ContinuousBatcher(params, cfg, lane_tiers=tiers,
+                                max_queue=4, scale_up_after=2,
+                                scale_down_after=8,
+                                step_windows=(1, window))
+        done_t = np.full(n_req, np.nan)
+        rid_of = {}
+        next_req = 0
+        t0 = time.perf_counter()
+        while np.isnan(done_t).any():
+            now = time.perf_counter() - t0
+            while next_req < n_req and arrivals[next_req] <= now:
+                try:
+                    rid_of[next_req] = eng.enqueue(prompts[next_req],
+                                                   new)
+                except QueueFull:
+                    break                  # retry at the next tick
+                next_req += 1
+            if not eng.running() and not eng.queued:
+                if next_req < n_req:
+                    time.sleep(max(0.0, arrivals[next_req]
+                                   - (time.perf_counter() - t0)))
+                continue
+            eng.step(window)
+            now = time.perf_counter() - t0
+            for req, rid in rid_of.items():
+                if np.isnan(done_t[req]) and eng.poll(rid) is not None:
+                    done_t[req] = now
+        results = eng.results()
+        ok = sum(r.ok for r in results.values())
+        makespan = float(np.nanmax(done_t))
+        total_tokens = sum(len(r.generated) for r in results.values())
+        lat = done_t - arrivals
+        pct = lambda a, q: round(float(np.percentile(a, q)) * 1e3, 1)
+        extras = {
+            "lane_tiers": list(tiers), "offered_rps": offered_rps,
+            "n_requests": n_req, "ok": ok, "new_tokens": new,
+            "step_window": window, "final_lanes": eng.lanes,
+            "tier_epoch": eng.tier_epoch,
+            "achieved_rps": round(n_req / makespan, 2),
+            "request_p50_ms": pct(lat, 50),
+            "request_p99_ms": pct(lat, 99),
+        }
+        return total_tokens / makespan, makespan / max(total_tokens,
+                                                       1), 0.0, extras
+    return run
+
+
+def bench_engine_load_spec(lanes, offered_rps):
+    """Open-loop Poisson load against the SpeculativeBatcher (the
+    PR-5 follow-up): same arrival process as engine_load_*, draft =
+    the int8-quantized target (the high-acceptance self-draft), TTFT/
+    TPOT percentiles per offered load.  Each step advances a lane up
+    to n_draft + 1 tokens, so TPOT granularity is a speculative
+    round, not a token."""
+    def run(n_req=48, p_len=64, new=128, n_draft=3):
+        import numpy as np
+        from distkeras_tpu.models.quant import quantize_params
+        from distkeras_tpu.serving import SpeculativeBatcher
+
+        cfg = _cfg()
+        params = _params()
+        draft = quantize_params(params)
+        rng = np.random.default_rng(0)
+        arrivals = np.cumsum(rng.exponential(1.0 / offered_rps, n_req))
+        prompts = rng.integers(0, cfg.vocab_size,
+                               (n_req, p_len)).astype(np.int32)
+        eng = SpeculativeBatcher(params, draft, cfg, cfg, lanes=lanes,
+                                 n_draft=n_draft)
+        warm = eng.submit(prompts[0], new)
+        while warm in eng.running():
+            eng.step()
+        eng.drain(warm)
+
+        lane_req: dict[int, int] = {}
+        first_t = np.full(n_req, np.nan)
+        done_t = np.full(n_req, np.nan)
+        tokens_of = np.zeros(n_req, np.int64)
+        next_rid = 0
+        t0 = time.perf_counter()
+        while np.isnan(done_t).any():
+            now = time.perf_counter() - t0
+            while (next_rid < n_req and arrivals[next_rid] <= now
+                   and eng.free_lanes()):
+                lane = eng.submit(prompts[next_rid], new)
+                lane_req[lane] = next_rid
+                next_rid += 1
+            if not eng.running():
+                if next_rid < n_req:
+                    time.sleep(max(0.0, arrivals[next_rid]
+                                   - (time.perf_counter() - t0)))
+                continue
+            out = eng.step()
+            now = time.perf_counter() - t0
+            for lane, toks in out.items():
+                rid = lane_req[lane]
+                if toks and np.isnan(first_t[rid]):
+                    first_t[rid] = now
+                tokens_of[rid] += len(toks)
+            for lane, rid in list(lane_req.items()):
+                if lane not in eng.running() and np.isnan(done_t[rid]):
+                    done_t[rid] = now
+                    eng.drain(lane)
+                    del lane_req[lane]
+        makespan = float(np.nanmax(done_t))
+        total_tokens = int(tokens_of.sum())
+        ttft = first_t - arrivals
+        tpot = (done_t - first_t) / np.maximum(tokens_of - 1, 1)
+        pct = lambda a, q: round(float(np.percentile(a, q)) * 1e3, 1)
+        extras = {
+            "lanes": lanes, "offered_rps": offered_rps,
+            "n_requests": n_req, "prompt_len": p_len,
+            "new_tokens": new, "n_draft": n_draft,
+            "achieved_rps": round(n_req / makespan, 2),
+            "ttft_p50_ms": pct(ttft, 50), "ttft_p99_ms": pct(ttft, 99),
+            "tpot_p50_ms": pct(tpot, 50), "tpot_p99_ms": pct(tpot, 99),
+            "degraded": eng.degraded,
+        }
+        return total_tokens / makespan, makespan / total_tokens, 0.0, \
+            extras
+    return run
+
+
+def bench_longprompt(prefill_chunk):
+    """The chunked-prefill claim, measured: 7 lanes decode steadily
+    while ONE long prompt (1024 warm tokens) is admitted mid-flight.
+    Reports the decoding lanes' inter-token step gap p50/p99 over the
+    run and the gap of the single worst step (monolithic admission:
+    the whole 1024-token prefill lands between two steps; chunked:
+    bounded by one chunk).  Value = aggregate tokens/s (the chunked
+    row pays the same total prefill compute, spread out)."""
+    def run(p_short=64, p_long=1017, new=160, long_new=8):
+        import numpy as np
+        from distkeras_tpu.serving import ContinuousBatcher
+
+        cfg = _cfg()
+        params = _params()
+        if p_long + long_new > cfg.max_len:
+            p_long = cfg.max_len - long_new
+        # Self-scale to the config (the bench-contract tests drive
+        # this through a tiny model): the chunk is ~1/8 of the cache,
+        # capped at the requested width.
+        chunk = (None if prefill_chunk is None
+                 else min(prefill_chunk, max(1, cfg.max_len // 8)))
+        rng = np.random.default_rng(0)
+        shorts = rng.integers(0, cfg.vocab_size,
+                              (7, p_short)).astype(np.int32)
+        long_p = rng.integers(0, cfg.vocab_size,
+                              (p_long,)).astype(np.int32)
+        eng = ContinuousBatcher(
+            params, cfg, lanes=8,
+            prompt_buckets=(p_short, chunk or 128, p_long),
+            prefill_chunk=chunk)
+        lanes = [eng.submit(s, new) for s in shorts]
+        for _ in range(4):                    # warm the step program
+            eng.step()
+        gaps = []
+        t0 = time.perf_counter()
+        injected = None
+        steps = 0
+        while any(l in eng.running() for l in lanes):
+            if steps == 2:
+                injected = eng.submit(long_p, long_new)
+            t1 = time.perf_counter()
+            eng.step()
+            gaps.append(time.perf_counter() - t1)
+            steps += 1
+        dt = time.perf_counter() - t0
+        for lane in lanes:
+            eng.drain(lane)
+        if injected is not None:
+            while injected in eng.running():
+                eng.step()
+            eng.drain(injected)
+        gaps = np.asarray(gaps)
+        pct = lambda q: round(float(np.percentile(gaps, q)) * 1e3, 2)
+        total = 7 * new
+        extras = {
+            "lanes": 8, "prompt_len_long": int(p_long),
+            "prefill_chunk": chunk, "new_tokens": new,
+            "step_gap_p50_ms": pct(50), "step_gap_p99_ms": pct(99),
+            "step_gap_max_ms": round(float(gaps.max()) * 1e3, 2),
+        }
+        return total / dt, dt / total, 0.0, extras
+    return run
+
+
+def bench_prefix_reuse(n_prefixes):
+    """The multi-prefix KV pool, measured: ``n_prefixes`` distinct
+    512-token prefixes pooled device-side, 32 requests with 32-token
+    tails round-robin across them.  Value = pooled tokens/s over the
+    full serve; ``noreuse_tok_s`` re-runs the same workload with the
+    full prefix+tail prompt re-prefilled per request (the v1
+    behavior), so the ratio is what the pool is worth at this prefix
+    length.  1/4/16 prefixes sweep the pool-size axis."""
+    def run(prefix_len=512, tail_len=32, n_req=32, new=32):
+        import jax as _jax
+        import numpy as np
+        from distkeras_tpu.models.generate import prefill
+        from distkeras_tpu.serving import ContinuousBatcher, PrefixPool
+
+        cfg = _cfg()
+        params = _params()
+        rng = np.random.default_rng(0)
+        prefixes = rng.integers(0, cfg.vocab_size,
+                                (n_prefixes, prefix_len)
+                                ).astype(np.int32)
+        tails = rng.integers(0, cfg.vocab_size,
+                             (n_req, tail_len)).astype(np.int32)
+        pool = PrefixPool(cfg, slots=n_prefixes)
+        pf = _jax.jit(lambda pp, pr: prefill(pp, pr, cfg,
+                                             last_logits=False)[0])
+        pids = []
+        for i in range(n_prefixes):
+            pids.append(pool.put(pf(params, prefixes[i][None]),
+                                 prefix_len))
+
+        def serve(eng, use_pool):
+            order = []
+            t0 = time.perf_counter()
+            done = 0
+            nxt = 0
+            lane_req = {}
+            while done < n_req:
+                while nxt < n_req and eng.free_lanes():
+                    if use_pool:
+                        lane = eng.submit(tails[nxt], new,
+                                          prefix_id=pids[nxt
+                                                         % n_prefixes])
+                    else:
+                        full = np.concatenate(
+                            [prefixes[nxt % n_prefixes], tails[nxt]])
+                        lane = eng.submit(full, new)
+                    lane_req[lane] = nxt
+                    nxt += 1
+                eng.step(4)
+                for lane in [l for l in lane_req
+                             if l not in eng.running()]:
+                    eng.drain(lane)
+                    del lane_req[lane]
+                    done += 1
+            return time.perf_counter() - t0
+
+        pooled_eng = ContinuousBatcher(params, cfg, lanes=8,
+                                       prompt_buckets=(tail_len,),
+                                       prefix_pool=pool,
+                                       step_windows=(1, 4))
+        serve(pooled_eng, True)               # warm
+        dt_pool = serve(pooled_eng, True)
+        plain_eng = ContinuousBatcher(
+            params, cfg, lanes=8,
+            prompt_buckets=(tail_len, prefix_len + tail_len))
+        serve(plain_eng, False)               # warm
+        dt_plain = serve(plain_eng, False)
+        total = n_req * new
+        extras = {
+            "n_prefixes": n_prefixes, "prefix_len": prefix_len,
+            "tail_len": tail_len, "n_requests": n_req,
+            "new_tokens": new,
+            "noreuse_tok_s": round(total / dt_plain, 1),
+            "reuse_speedup": round(dt_plain / dt_pool, 3),
+        }
+        return total / dt_pool, dt_pool / total, 0.0, extras
+    return run
+
+
 BENCHES = {
     "decode_greedy_b1": (bench_greedy(1), "tokens/sec/chip"),
     "decode_greedy_b8": (bench_greedy(8), "tokens/sec/chip"),
@@ -729,11 +1012,33 @@ BENCHES = {
     "engine_load_4l_mid": (bench_engine_load(4, 32.0), "tokens/sec/chip"),
     "engine_load_16l_mid": (bench_engine_load(16, 32.0),
                             "tokens/sec/chip"),
+    # Round-10 rows.  Elastic + speculative load sweeps (the PR-5
+    # follow-up), each row shipping its obs snapshot:
+    "engine_load_elastic_mid": (bench_engine_load_elastic((4, 8, 16),
+                                                          32.0),
+                                "tokens/sec/chip"),
+    "engine_load_elastic_high": (bench_engine_load_elastic((4, 8, 16),
+                                                           64.0),
+                                 "tokens/sec/chip"),
+    "engine_load_spec_mid": (bench_engine_load_spec(8, 32.0),
+                             "tokens/sec/chip"),
+    # Chunked-vs-monolithic long-prompt admission (inter-token gap):
+    "engine_longprompt_monolithic": (bench_longprompt(None),
+                                     "tokens/sec/chip"),
+    "engine_longprompt_chunked": (bench_longprompt(128),
+                                  "tokens/sec/chip"),
+    # Multi-prefix KV pool reuse at 1/4/16 distinct prefixes:
+    "engine_prefix_pool_1": (bench_prefix_reuse(1), "tokens/sec/chip"),
+    "engine_prefix_pool_4": (bench_prefix_reuse(4), "tokens/sec/chip"),
+    "engine_prefix_pool_16": (bench_prefix_reuse(16),
+                              "tokens/sec/chip"),
 }
 
 
 def main(names):
     import jax
+
+    from distkeras_tpu import obs
 
     unknown = set(names) - set(BENCHES)
     if unknown:
@@ -743,15 +1048,26 @@ def main(names):
           file=sys.stderr)
     for name in names or BENCHES:
         fn, unit = BENCHES[name]
+        # Each config runs under its own obs session (metrics only) so
+        # the row ships its serving telemetry — lanes_busy, queue
+        # depth, tier resizes, spec accept rate — alongside the
+        # number (bench_suite.py's round-10 convention).
+        sess = obs.enable()
         try:
             rate, step_s, _, extra = fn()
         except Exception as e:
             print(json.dumps({"metric": name, "error": repr(e)[:200]}))
             continue
-        print(json.dumps({
+        finally:
+            snapshot = sess.registry.compact()
+            obs.disable()
+        line = {
             "metric": name, "value": round(rate, 1), "unit": unit,
             "ms_per_token": round(step_s * 1e3, 3), **extra,
-        }))
+        }
+        if snapshot:
+            line["obs"] = snapshot
+        print(json.dumps(line))
 
 
 if __name__ == "__main__":

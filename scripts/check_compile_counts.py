@@ -213,6 +213,131 @@ def session_serving_elastic():
         "tier compiles must all happen at construction")
 
 
+def session_serving_chunked():
+    """Chunked-prefill ContinuousBatcher session: every admission
+    program (seeded + continuation per bucket) and the declared step
+    window compile at CONSTRUCTION; the serve phase — a long prompt
+    admitting in chunks interleaved with a short lane decoding — must
+    be COMPILE-FREE (asserted, not just budgeted: a compile here means
+    some chunk shape was missed and a request paid it)."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.serving import ContinuousBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    eng = ContinuousBatcher(params, cfg, lanes=2, prefill_chunk=8,
+                            prompt_buckets=(8,))
+    built = _COMPILES["n"]
+    rng = np.random.default_rng(0)
+    short = eng.submit(rng.integers(0, 64, (4,)).astype(np.int32), 8)
+    eng.step()
+    long_ = eng.submit(rng.integers(0, 64, (21,)).astype(np.int32), 4)
+    for lane in (long_, short):
+        while lane in eng.running():
+            eng.step()
+        eng.drain(lane)
+    serve = _COMPILES["n"] - built
+    assert serve == 0, (
+        f"chunked serve phase compiled {serve} program(s); chunk "
+        "programs must all compile at construction")
+
+
+def session_serving_prefix_pool():
+    """PrefixPool ContinuousBatcher session: pool construction + puts
+    + engine construction compile everything (the pool's slab write,
+    the pooled admission gathers, the reseed, the step window); the
+    serve phase — two requests reusing pooled prefixes plus a plain
+    request — must be COMPILE-FREE, proving prefix reuse runs zero
+    prefill work and zero fresh programs."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.models.generate import prefill
+    from distkeras_tpu.serving import ContinuousBatcher, PrefixPool
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    pool = PrefixPool(cfg, slots=2)
+    for n in (6, 10):
+        pref = rng.integers(0, 64, (1, n)).astype(np.int32)
+        cache, _ = prefill(params, pref, cfg, last_logits=False)
+        pool.put(cache, n)
+    eng = ContinuousBatcher(params, cfg, lanes=2, prefix_pool=pool,
+                            prompt_buckets=(8,))
+    built = _COMPILES["n"]
+    pids = pool.ids()
+    tail = rng.integers(0, 64, (4,)).astype(np.int32)
+    lanes = [eng.submit(tail, 4, prefix_id=pids[0]),
+             eng.submit(tail, 4, prefix_id=pids[1])]
+    for lane in lanes:
+        while lane in eng.running():
+            eng.step()
+        eng.drain(lane)
+    plain = eng.submit(tail, 4)
+    while plain in eng.running():
+        eng.step()
+    eng.drain(plain)
+    serve = _COMPILES["n"] - built
+    assert serve == 0, (
+        f"prefix-pool serve phase compiled {serve} program(s); the "
+        "pooled gather must ride the construction-compiled admission")
+
+
+def session_spec_prefix():
+    """SpeculativeBatcher + prefix pool: admission/step programs
+    compile lazily on the FIRST request cycle (the recorded budget);
+    the second cycle — reusing the pooled prefix AND a fresh plain
+    request — must be compile-free."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.models.generate import prefill
+    from distkeras_tpu.serving import PrefixPool, SpeculativeBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32)
+    draft = tfm.TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
+                                  n_layers=1, d_ff=32, max_len=32)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    dparams = tfm.init_params(jax.random.key(1), draft)
+    rng = np.random.default_rng(0)
+    pref = rng.integers(0, 64, (1, 6)).astype(np.int32)
+    tc, _ = prefill(params, pref, cfg, last_logits=False)
+    dc, _ = prefill(dparams, pref, draft, last_logits=False)
+    pool = PrefixPool(cfg, slots=1, draft_cfg=draft)
+    pid = pool.put((tc, dc), 6, last_token=int(pref[0, -1]))
+    eng = SpeculativeBatcher(params, dparams, cfg, draft, lanes=2,
+                             n_draft=2, prompt_buckets=(8,),
+                             prefix_pool=pool)
+    tail = rng.integers(0, 64, (4,)).astype(np.int32)
+
+    def cycle():
+        lanes = [eng.submit(tail, 4, prefix_id=pid),
+                 eng.submit(tail, 4)]
+        for lane in lanes:
+            while lane in eng.running():
+                eng.step()
+            eng.drain(lane)
+
+    cycle()                       # warm: buckets + step compile here
+    warm = _COMPILES["n"]
+    cycle()                       # steady state: prefix reuse is free
+    serve = _COMPILES["n"] - warm
+    assert serve == 0, (
+        f"speculative prefix reuse compiled {serve} program(s) in "
+        "steady state; re-admission must hit the warm jit caches")
+
+
 SESSIONS = {
     "adag": lambda: session_adag(),
     "adag_zero1": lambda: session_adag(zero1=True),
@@ -227,6 +352,9 @@ SESSIONS = {
     "serving": session_serving,
     "speculative": session_speculative,
     "serving_elastic": session_serving_elastic,
+    "serving_chunked": session_serving_chunked,
+    "serving_prefix_pool": session_serving_prefix_pool,
+    "spec_prefix": session_spec_prefix,
 }
 
 

@@ -18,7 +18,14 @@ Exit 0 iff there are zero unsuppressed error/warn findings.  Usage::
     python scripts/graph_lint.py --source-only    # AST rules only, fast
     python scripts/graph_lint.py --ir-only        # IR rules + budgets
     python scripts/graph_lint.py --update-budgets # re-record the census
+    python scripts/graph_lint.py --update-baseline # re-record warn ledger
     python scripts/graph_lint.py -v               # also print censuses
+
+``warn`` findings ratchet through ``scripts/lint_baseline.json``: the
+recorded count per (rule, path) stops gating, anything beyond it (or
+at a new location) still fails, and ``--update-baseline`` re-records
+the ledger — review the diff; counts should only go DOWN.  Errors are
+never baselineable.
 
 See docs/graph_lint.md for the rule catalogue and the
 ``# dkt: ignore[rule]`` suppression syntax.
@@ -40,6 +47,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 BUDGET_PATH = os.path.join(REPO, "scripts", "comm_budget.json")
+BASELINE_PATH = os.path.join(REPO, "scripts", "lint_baseline.json")
 
 
 def run_source(findings):
@@ -89,10 +97,23 @@ def main(argv):
     ap.add_argument("--source-only", action="store_true")
     ap.add_argument("--ir-only", action="store_true")
     ap.add_argument("--update-budgets", action="store_true")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="re-record scripts/lint_baseline.json from "
+                         "the current warn findings (ratchet ledger)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
-    from distkeras_tpu.analysis.findings import format_findings
+    from distkeras_tpu.analysis.findings import (apply_baseline,
+                                                 format_findings,
+                                                 load_baseline,
+                                                 save_baseline)
+
+    if args.update_baseline and (args.source_only or args.ir_only):
+        # The ledger covers BOTH lint layers; re-recording from a
+        # half-census would drop the other layer's keys and start
+        # failing its previously-baselined warns on the next full run.
+        ap.error("--update-baseline needs the full run (drop "
+                 "--source-only/--ir-only)")
 
     findings = []
     if not args.ir_only:
@@ -100,6 +121,14 @@ def main(argv):
     if not args.source_only:
         run_ir(findings, update=args.update_budgets,
                verbose=args.verbose)
+    if args.update_baseline:
+        counts = save_baseline(BASELINE_PATH, findings)
+        print(f"wrote {BASELINE_PATH} ({sum(counts.values())} warn "
+              f"finding(s) across {len(counts)} key(s))")
+        # Fall through: the fresh ledger covers every current warn by
+        # construction, but ERROR findings are never baselineable and
+        # must still be reported and gate this very invocation.
+    findings = apply_baseline(findings, load_baseline(BASELINE_PATH))
     print(format_findings(findings))
     return 1 if any(f.gating for f in findings) else 0
 

@@ -123,3 +123,68 @@ def test_engine_load_fields_mean_what_they_say(monkeypatch):
     for key in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
                 "tpot_p99_ms", "achieved_rps"):
         assert key in extras
+
+
+def _tiny_serving_cfg():
+    from distkeras_tpu.models import transformer as tfm
+
+    return tfm.TransformerConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_len=48, dtype="float32", rope=True)
+
+
+def test_bench_longprompt_rows_report_step_gap(monkeypatch):
+    """Round-10 rows: engine_longprompt_{monolithic,chunked} report
+    the decoding lanes' step-gap percentiles and self-scale the chunk
+    width to the config (the flagship's 128 would not even construct
+    on a small cache)."""
+    import bench_serving as bs
+
+    monkeypatch.setattr(bs, "_cfg", lambda window=None:
+                        _tiny_serving_cfg())
+    for chunk in (None, 128):
+        run = bs.bench_longprompt(chunk)
+        rate, step_s, _, extras = run(p_short=6, p_long=30, new=12,
+                                      long_new=4)
+        assert rate > 0 and abs(rate * step_s - 1.0) < 1e-9
+        for key in ("step_gap_p50_ms", "step_gap_p99_ms",
+                    "step_gap_max_ms", "prefill_chunk"):
+            assert key in extras
+        if chunk is not None:
+            # Self-scaled: 48 // 8 = 6, never the flagship 128.
+            assert extras["prefill_chunk"] == 6
+
+
+def test_bench_prefix_reuse_reports_speedup(monkeypatch):
+    import bench_serving as bs
+
+    monkeypatch.setattr(bs, "_cfg", lambda window=None:
+                        _tiny_serving_cfg())
+    run = bs.bench_prefix_reuse(2)
+    rate, step_s, _, extras = run(prefix_len=8, tail_len=4, n_req=6,
+                                  new=4)
+    assert rate > 0
+    assert extras["n_prefixes"] == 2
+    assert extras["noreuse_tok_s"] > 0
+    assert extras["reuse_speedup"] > 0
+
+
+def test_bench_load_elastic_and_spec_rows(monkeypatch):
+    """The PR-5 load-sweep follow-ups: the elastic row drives the
+    enqueue/poll flow (QueueFull retried, tier trajectory reported),
+    the speculative row reports TTFT/TPOT percentiles."""
+    import bench_serving as bs
+
+    monkeypatch.setattr(bs, "_cfg", lambda window=None:
+                        _tiny_serving_cfg())
+    rate, _, _, extras = bs.bench_engine_load_elastic(
+        (1, 2), 400.0)(n_req=4, p_len=6, new=5, window=1)
+    assert rate > 0 and extras["ok"] == 4
+    assert extras["final_lanes"] in (1, 2)
+    for key in ("request_p50_ms", "request_p99_ms", "tier_epoch"):
+        assert key in extras
+    rate, _, _, extras = bs.bench_engine_load_spec(
+        2, 400.0)(n_req=3, p_len=6, new=5, n_draft=2)
+    assert rate > 0 and not extras["degraded"]
+    for key in ("ttft_p99_ms", "tpot_p50_ms", "n_draft"):
+        assert key in extras
