@@ -31,6 +31,14 @@ axis-name          error  a mesh-axis string in ``P(...)`` or an
 loop-jit           warn   ``jax.jit(...)`` lexically inside a for/while
                           body — a fresh jit wrapper (and cache entry) per
                           iteration
+jax-free           error  any ``import jax`` (or ``from jax ...``),
+                          anywhere in a module on the JIT-FREE ledger
+                          (``_JAX_FREE_FILES``: the live telemetry plane
+                          ``obs/live.py``/``obs/slo.py`` and the offline
+                          obs modules) — these run on scrape/ticker
+                          threads or under obs_report.py's no-framework
+                          stub loader, where touching jax would mean
+                          device work on a telemetry path
 =================  =====  ==================================================
 
 Traced functions are found structurally: defs decorated with
@@ -63,6 +71,15 @@ _HOT_PATH_DIRS = (os.path.join("distkeras_tpu", "trainers"),
                   os.path.join("distkeras_tpu", "serving"))
 _HOT_PATH_FILES = ("serving.py",)  # pre-split path; tests still use it
 _STEP_NAME_HINT = ("step", "train", "update")
+# The JIT-FREE ledger: modules that must never import jax, even
+# lazily — the live telemetry plane (scrape/SLO threads must not be
+# able to trigger device work or compilation) and the offline obs
+# modules (obs_report.py imports them through a no-framework stub
+# loader on hosts with no jax installed).
+_JAX_FREE_FILES = tuple(
+    os.path.join("distkeras_tpu", "obs", f)
+    for f in ("live.py", "slo.py", "metrics.py", "trace.py",
+              "report.py"))
 
 
 def _attr_chain(node) -> list[str]:
@@ -243,6 +260,36 @@ class _Linter(ast.NodeVisitor):
         return (any(d.replace(os.sep, "/") in norm
                     for d in _HOT_PATH_DIRS)
                 or any(norm.endswith(f) for f in _HOT_PATH_FILES))
+
+    def _jax_free(self) -> bool:
+        norm = self.path.replace(os.sep, "/")
+        return any(norm.endswith(f.replace(os.sep, "/"))
+                   for f in _JAX_FREE_FILES)
+
+    def _check_jax_free_import(self, node, modules) -> None:
+        if not self._jax_free():
+            return
+        for mod in modules:
+            root = (mod or "").split(".")[0]
+            if root == "jax":
+                self.add("jax-free", "error", node,
+                         f"`{mod}` imported in a jit-free module "
+                         f"({os.path.basename(self.path)})",
+                         "the live telemetry plane and the offline "
+                         "obs modules must never touch jax — a "
+                         "scrape or report must not be able to "
+                         "trigger device work; move the dependency "
+                         "out or read the data through the registry/"
+                         "trace instead")
+                return
+
+    def visit_Import(self, node: ast.Import):
+        self._check_jax_free_import(node, [a.name for a in node.names])
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        self._check_jax_free_import(node, [node.module])
+        self.generic_visit(node)
 
     def visit_FunctionDef(self, node: ast.FunctionDef):
         if not node.name.startswith("_"):

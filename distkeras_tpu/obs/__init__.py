@@ -41,6 +41,8 @@ from distkeras_tpu.obs.metrics import (Counter, Gauge, Histogram,
                                         DEFAULT_TIME_BUCKETS,
                                         percentile_from_buckets)
 from distkeras_tpu.obs.trace import EventTrace, read_trace
+from distkeras_tpu.obs.slo import SloEngine, SloRule
+from distkeras_tpu.obs.live import HeartbeatHealth, TelemetryServer
 
 _ACTIVE = None
 
@@ -52,31 +54,72 @@ class ObsSession:
     On close the registry snapshot is appended to the trace as its
     final ``metrics`` record, so the JSONL file alone is enough for
     ``scripts/obs_report.py`` (latency percentiles included).
+
+    **Live telemetry plane** (round 11): ``serve_port=`` starts a
+    :class:`~distkeras_tpu.obs.live.TelemetryServer` on the session's
+    registry (``/metrics``, ``/snapshot.json``, ``/healthz``,
+    ``/trace/tail``, ``/metrics/cluster`` — port 0 = ephemeral, read
+    ``sess.server.port``); ``slo_rules=`` starts the rolling-window
+    :class:`~distkeras_tpu.obs.slo.SloEngine` ticker (also started,
+    rule-less, whenever the server runs, so ``/metrics`` always
+    carries the ``slo_windowed`` gauges).  Both are stdlib daemon
+    threads that only READ the registry: enabling them cannot touch
+    compile counts (the ``obs_live`` compile session pins it).
     """
 
     def __init__(self, trace_path: str | None = None,
-                 run_id: str | None = None):
+                 run_id: str | None = None,
+                 serve_port: int | None = None,
+                 serve_host: str = "127.0.0.1", health=None,
+                 slo_rules=None, slo_tick_s: float = 1.0):
         self.registry = MetricsRegistry()
         self.trace = (EventTrace(trace_path, run_id=run_id)
                       if trace_path else None)
         self.run_id = self.trace.run_id if self.trace else run_id
+        self.slo = None
+        self.server = None
+        try:
+            if slo_rules is not None or serve_port is not None:
+                self.slo = SloEngine(
+                    self.registry, slo_rules or (), tick_s=slo_tick_s,
+                    emit=self.trace.event if self.trace else None
+                ).start()
+            if serve_port is not None:
+                self.server = TelemetryServer(
+                    self.registry, port=serve_port, bind=serve_host,
+                    trace_path=trace_path, health=health).start()
+        except BaseException:
+            # A failed live-plane start (e.g. the fixed serve_port is
+            # already bound) must not leak the already-running ticker
+            # thread or the open trace file: enable() re-raises with
+            # _ACTIVE still None, so nothing else could clean up.
+            self.close()
+            raise
 
     def close(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+        if self.slo is not None:
+            self.slo.stop()
         if self.trace is not None:
             self.trace.metrics(self.registry.snapshot())
             self.trace.close()
 
 
-def enable(trace_path: str | None = None,
-           run_id: str | None = None) -> ObsSession:
+def enable(trace_path: str | None = None, run_id: str | None = None,
+           **live_kw) -> ObsSession:
     """Activate telemetry; returns the session.  Pair with
-    :func:`disable`, or use :func:`session` for scoped enablement."""
+    :func:`disable`, or use :func:`session` for scoped enablement.
+    ``live_kw`` (``serve_port=`` / ``serve_host=`` / ``health=`` /
+    ``slo_rules=`` / ``slo_tick_s=``) opt into the live telemetry
+    plane — see :class:`ObsSession`."""
     global _ACTIVE
     if _ACTIVE is not None:
         raise RuntimeError(
             "an obs session is already active; telemetry sessions do "
             "not nest (disable() the current one first)")
-    _ACTIVE = ObsSession(trace_path=trace_path, run_id=run_id)
+    _ACTIVE = ObsSession(trace_path=trace_path, run_id=run_id,
+                         **live_kw)
     return _ACTIVE
 
 
@@ -89,9 +132,11 @@ def disable() -> None:
 
 
 @contextlib.contextmanager
-def session(trace_path: str | None = None, run_id: str | None = None):
-    """``with obs.session("run.jsonl") as sess: ...``"""
-    sess = enable(trace_path=trace_path, run_id=run_id)
+def session(trace_path: str | None = None, run_id: str | None = None,
+            **live_kw):
+    """``with obs.session("run.jsonl") as sess: ...`` (pass
+    ``serve_port=``/``slo_rules=`` for the live telemetry plane)."""
+    sess = enable(trace_path=trace_path, run_id=run_id, **live_kw)
     try:
         yield sess
     finally:
@@ -165,5 +210,6 @@ def span(name: str, **fields):
 __all__ = ["ObsSession", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "EventTrace", "read_trace",
            "percentile_from_buckets", "DEFAULT_TIME_BUCKETS",
+           "SloRule", "SloEngine", "TelemetryServer", "HeartbeatHealth",
            "enable", "disable", "session", "active",
            "count", "gauge", "observe", "event", "span"]

@@ -34,6 +34,7 @@ appends to its event trace on close (obs/trace.py).
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 
 # Log-ish spaced seconds: 100us .. 2min.  Wide enough for h2d dispatch
@@ -41,6 +42,19 @@ import threading
 DEFAULT_TIME_BUCKETS = (
     1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 120.0)
+
+# The exposition-format metric-name grammar (Prometheus text format).
+_PROM_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+
+
+def prom_name(name: str) -> str:
+    """Registry name -> Prometheus exposition name (``.``/``-`` map to
+    ``_``).  The mangling is lossy, so :class:`MetricsRegistry` rejects
+    two distinct registry names that would collide on the wire at
+    registration time (e.g. ``serving.queue_depth`` vs
+    ``serving_queue_depth`` — one would silently alias the other on
+    every scrape)."""
+    return name.replace(".", "_").replace("-", "_")
 
 
 def _label_key(labels: dict) -> tuple:
@@ -211,6 +225,10 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: dict[str, _Instrument] = {}
+        # prom_name -> registry name: the exposition mangling is lossy,
+        # so a wire-name collision is detected HERE, at registration,
+        # instead of silently interleaving two series on every scrape.
+        self._prom_names: dict[str, str] = {}
 
     def _get(self, cls, name: str, help: str, **kw) -> _Instrument:
         m = self._metrics.get(name)
@@ -218,7 +236,20 @@ class MetricsRegistry:
             with self._lock:
                 m = self._metrics.get(name)
                 if m is None:
+                    pname = prom_name(name)
+                    if not _PROM_NAME_RE.match(pname):
+                        raise ValueError(
+                            f"metric name {name!r} does not map to a "
+                            f"legal Prometheus name ({pname!r}); use "
+                            "[a-zA-Z0-9_.:-] only")
+                    other = self._prom_names.get(pname)
+                    if other is not None and other != name:
+                        raise ValueError(
+                            f"metric {name!r} collides with {other!r} "
+                            f"on the exposition name {pname!r} (the "
+                            "./- -> _ mangling is lossy); rename one")
                     m = cls(name, help, registry=self, **kw)
+                    self._prom_names[pname] = name
                     self._metrics[name] = m
         if not isinstance(m, cls):
             raise ValueError(
@@ -273,11 +304,17 @@ class MetricsRegistry:
             return (v.replace("\\", "\\\\").replace('"', '\\"')
                      .replace("\n", "\\n"))
 
+        def esc_help(v: str) -> str:
+            # HELP text escapes backslash and line feed ONLY (the
+            # text-format spec); a raw newline here would tear the
+            # exposition stream mid-metric.
+            return v.replace("\\", "\\\\").replace("\n", "\\n")
+
         lines = []
         for name, m in sorted(self.snapshot().items()):
-            pname = name.replace(".", "_").replace("-", "_")
+            pname = prom_name(name)
             if m["help"]:
-                lines.append(f"# HELP {pname} {m['help']}")
+                lines.append(f"# HELP {pname} {esc_help(m['help'])}")
             lines.append(f"# TYPE {pname} {m['kind']}")
             for s in m["series"]:
                 lab = ",".join(f'{k}="{esc(v)}"'
@@ -303,7 +340,10 @@ class MetricsRegistry:
     def compact(self) -> dict:
         """Small JSON-able view for attaching to bench/CI artifacts:
         counters/gauges as ``{name{labels}: value}``, histograms as
-        ``{count, mean, p50, p95, p99}``."""
+        ``{count, mean, min, max, p50, p95, p99}`` — min/max are the
+        EXACT observed extremes the snapshot already tracks, so bench
+        rows and SLO summaries see true worst-case latency, not just
+        the bucket-interpolated p99."""
         out = {}
         for name, m in sorted(self.snapshot().items()):
             for s in m["series"]:
@@ -316,6 +356,7 @@ class MetricsRegistry:
                     out[key] = {
                         "count": s["count"],
                         "mean": s["sum"] / s["count"],
+                        "min": s["min"], "max": s["max"],
                         "p50": percentile_from_buckets(s, 0.50),
                         "p95": percentile_from_buckets(s, 0.95),
                         "p99": percentile_from_buckets(s, 0.99),
@@ -323,5 +364,30 @@ class MetricsRegistry:
         return out
 
 
+def windowed_percentiles(new: dict, old: dict | None,
+                         qs=(0.5, 0.95, 0.99)) -> dict | None:
+    """Percentiles of the observations that landed BETWEEN two
+    histogram-series snapshots (the dicts :meth:`MetricsRegistry.
+    snapshot` emits): subtract the cumulative bucket counts and
+    interpolate on the difference.  ``old=None`` means "since the
+    beginning".  Returns ``{"count", qs...}`` or None when the window
+    saw nothing.  The exact min/max are cumulative, not windowed, so
+    the estimate is deliberately NOT clamped to them."""
+    counts = list(new["counts"])
+    count = new.get("count", 0)
+    if old is not None:
+        counts = [a - b for a, b in zip(counts, old["counts"])]
+        count -= old.get("count", 0)
+    if count <= 0:
+        return None
+    diff = {"count": count, "counts": counts,
+            "buckets": list(new["buckets"]), "min": None, "max": None}
+    out = {"count": count}
+    for q in qs:
+        out[f"p{int(round(q * 100))}"] = percentile_from_buckets(diff, q)
+    return out
+
+
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
-           "DEFAULT_TIME_BUCKETS", "percentile_from_buckets"]
+           "DEFAULT_TIME_BUCKETS", "percentile_from_buckets",
+           "windowed_percentiles", "prom_name"]

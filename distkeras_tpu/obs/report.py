@@ -102,6 +102,113 @@ def load_report(path: str) -> dict:
     return build_report(read_trace(path))
 
 
+# --------------------------------------------------- request waterfall
+
+
+def request_waterfall(records: list[dict], request_id: int) -> dict:
+    """One serving request's life, reconstructed from its trace
+    records (everything carrying ``request_id`` in its fields —
+    round-11 per-request propagation): ``serving.submit`` ->
+    ``serving.admit`` span (queue wait) -> ``serving.admit_chunk``
+    spans (chunked prefill) -> ``serving.emit`` events (decode; the
+    inter-token gaps) -> ``serving.finish``.
+
+    Returns a plain dict: ``{"request_id", "found", "submit_t",
+    "stages": [{"t", "name", "dur", ...}], "queue_wait_s", "ttft_s",
+    "total_s", "status", "tokens", "gaps": {...}}`` with every ``t``
+    relative to the submit event (or the earliest record seen)."""
+    mine_events, mine_spans = [], []
+    for r in records:
+        fields = r.get("fields") or {}
+        if fields.get("request_id") != request_id:
+            continue
+        if r.get("kind") == "event":
+            mine_events.append(r)
+        elif r.get("kind") == "span":
+            mine_spans.append(r)
+    if not mine_events and not mine_spans:
+        return {"request_id": request_id, "found": False}
+
+    def at(r):
+        return r["t"] if r.get("kind") == "event" else r["t0"]
+
+    submit = next((e for e in mine_events
+                   if e["name"] == "serving.submit"), None)
+    t0 = at(submit) if submit else min(at(r) for r in
+                                       mine_events + mine_spans)
+    stages = []
+    for sp in mine_spans:
+        stages.append({"t": sp["t0"] - t0, "name": sp["name"],
+                       "dur_s": sp["dur"], **{
+                           k: v for k, v in sp["fields"].items()
+                           if k != "request_id"}})
+    emits = sorted((e for e in mine_events
+                    if e["name"] == "serving.emit"),
+                   key=lambda e: e["t"])
+    for e in emits:
+        stages.append({"t": e["t"] - t0, "name": "serving.emit",
+                       "n": e["fields"].get("n"),
+                       "first": e["fields"].get("first")})
+    finish = next((e for e in mine_events
+                   if e["name"] == "serving.finish"), None)
+    if finish is not None:
+        stages.append({"t": finish["t"] - t0, "name": "serving.finish",
+                       "status": finish["fields"].get("status")})
+    stages.sort(key=lambda s: s["t"])
+
+    admit = next((sp for sp in mine_spans
+                  if sp["name"] == "serving.admit"), None)
+    gaps = [b["t"] - a["t"] for a, b in zip(emits, emits[1:])]
+    gapstats = None
+    if gaps:
+        s = sorted(gaps)
+        gapstats = {"count": len(gaps), "p50_s": statistics.median(s),
+                    "max_s": s[-1]}
+    out = {
+        "request_id": request_id, "found": True,
+        "submit_t": t0,
+        "prompt_len": (submit or {}).get("fields", {}).get(
+            "prompt_len"),
+        "queue_wait_s": (admit["t0"] - t0) if admit and submit
+        else None,
+        "ttft_s": (emits[0]["t"] - t0) if emits and submit else None,
+        "prefill_chunks": sum(1 for sp in mine_spans
+                              if sp["name"] == "serving.admit_chunk"),
+        "tokens": sum(e["fields"].get("n") or 0 for e in emits),
+        "status": finish["fields"].get("status") if finish else None,
+        "total_s": (finish["t"] - t0) if finish else None,
+        "gaps": gapstats,
+        "stages": stages,
+    }
+    return out
+
+
+def render_waterfall(wf: dict) -> str:
+    """Human-readable waterfall for one request."""
+    rid = wf.get("request_id")
+    if not wf.get("found"):
+        return (f"request {rid}: no records carry request_id={rid} "
+                "(was the trace written with a round-11+ engine?)")
+    out = [f"request {rid}  prompt_len={wf.get('prompt_len')}  "
+           f"status={wf.get('status')}  "
+           f"total {_fmt_s(wf.get('total_s'))}"]
+    out.append(
+        f"  queue wait {_fmt_s(wf.get('queue_wait_s'))}   ttft "
+        f"{_fmt_s(wf.get('ttft_s'))}   prefill chunks "
+        f"{wf.get('prefill_chunks')}   tokens {wf.get('tokens')}")
+    g = wf.get("gaps")
+    if g:
+        out.append(f"  inter-token gaps: {g['count']}  p50 "
+                   f"{_fmt_s(g['p50_s'])}  max {_fmt_s(g['max_s'])}")
+    out.append("\n== waterfall ==")
+    for s in wf["stages"]:
+        extra = " ".join(f"{k}={v}" for k, v in s.items()
+                         if k not in ("t", "name", "dur_s"))
+        dur = f"  [{_fmt_s(s['dur_s'])}]" if "dur_s" in s else ""
+        out.append(f"  +{s['t']:>9.4f}s  {s['name']:<24}{dur}  {extra}")
+    return "\n".join(out)
+
+
 # ------------------------------------------------------ multi-host merge
 
 
@@ -291,4 +398,5 @@ def render_compare(base: dict, new: dict) -> str:
 
 
 __all__ = ["build_report", "load_report", "render_report",
-           "render_compare", "merge_traces", "render_merged"]
+           "render_compare", "merge_traces", "render_merged",
+           "request_waterfall", "render_waterfall"]

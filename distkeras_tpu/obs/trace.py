@@ -163,6 +163,47 @@ class EventTrace:
         self.close()
 
 
+def tail_trace(path: str, n: int, kinds=None) -> list[dict]:
+    """The last ``n`` records of a trace file, with the same
+    torn-final-line tolerance as :func:`read_trace` — safe against a
+    LIVE writer (the telemetry server's ``/trace/tail`` calls this
+    while the session is still appending; a half-flushed last line is
+    dropped, never an error).  Reads a bounded window from the end of
+    the file, not the whole trace.  ``kinds``: keep only these record
+    kinds (e.g. ``("event",)``)."""
+    if n <= 0:
+        return []
+    # Generous per-record bound: read enough tail bytes for n records
+    # plus one potentially-torn leading line, growing if the window
+    # started mid-file and yielded too few parseable lines.
+    window = max(n * 512, 8192)
+    records: list[dict] = []
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        while True:
+            start = max(size - window, 0)
+            f.seek(start)
+            chunk = f.read(size - start).decode("utf-8", "replace")
+            lines = chunk.splitlines()
+            if start > 0 and lines:
+                lines = lines[1:]  # first line may start mid-record
+            records = []
+            for line in lines:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn live write (tail) — lenient here
+                if kinds is None or rec.get("kind") in kinds:
+                    records.append(rec)
+            if len(records) >= n or start == 0:
+                break
+            window *= 4
+    return records[-n:]
+
+
 def read_trace(path: str) -> list[dict]:
     """Parse one JSONL trace file back into records (strict: a
     truncated final line — crashed writer — is tolerated, anything
@@ -182,4 +223,4 @@ def read_trace(path: str) -> list[dict]:
     return records
 
 
-__all__ = ["EventTrace", "Span", "read_trace"]
+__all__ = ["EventTrace", "Span", "read_trace", "tail_trace"]

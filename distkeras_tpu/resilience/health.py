@@ -65,6 +65,19 @@ def read_beat(directory: str, host: int) -> dict | None:
         return None
 
 
+def beat_age(directory: str, host: int,
+             clock=time.time) -> tuple[float, bool] | None:
+    """``(age_seconds, done)`` of the host's latest beat, or None when
+    it never beat.  The freshness primitive the live telemetry plane's
+    ``/healthz`` endpoint answers from (obs/live.py): fresh within the
+    window -> 200, stale -> 503, ``done`` -> clean completion, always
+    healthy."""
+    beat = read_beat(directory, host)
+    if beat is None:
+        return None
+    return clock() - beat.get("t", 0.0), bool(beat.get("done"))
+
+
 class HeartbeatWriter:
     """Daemon thread: publish a beat every ``interval`` seconds.
 
@@ -199,4 +212,5 @@ class HealthMonitor:
         return not self.stale_peers(epoch=epoch)
 
 
-__all__ = ["HeartbeatWriter", "HealthMonitor", "write_beat", "read_beat"]
+__all__ = ["HeartbeatWriter", "HealthMonitor", "write_beat",
+           "read_beat", "beat_age"]

@@ -57,7 +57,14 @@ class _AdmissionMixin:
         # even when the queue is also full.  Reentrant because
         # enqueue -> pump -> _admit_pending nests.
         self._admission_lock = threading.RLock()
-        self._admitting_internal = False  # pump() bypasses _closed
+        # Internal admission (enqueue -> pump -> submit) threads the
+        # request's ENQUEUE-TIME id through to submit, so every span/
+        # event the admission path emits carries the id the caller
+        # holds (per-request trace propagation, round 11).  Doubles as
+        # the "internal admission in progress" marker (the
+        # ``_admitting_internal`` property): ONE piece of state, so
+        # the id and the pump-bypasses-_closed behavior cannot drift.
+        self._admit_rid: int | None = None
         # Chunked-prefill scheduler state: lanes with pending admission
         # chunks, FIFO (see engine._run_pending_chunk).
         self._admitting = collections.deque()
@@ -85,6 +92,13 @@ class _AdmissionMixin:
             return self._clock() + ttl
         return deadline
 
+    @property
+    def _admitting_internal(self) -> bool:
+        """True while ``submit`` runs as internal admission (the
+        enqueue -> pump path): pump bypasses ``_closed`` and declines
+        register under the caller's id, not a fresh one."""
+        return self._admit_rid is not None
+
     def _check_open(self) -> None:
         if self._closed and not self._admitting_internal:
             obs.count("serving.rejected", reason="closed")
@@ -92,20 +106,27 @@ class _AdmissionMixin:
                 "engine is shutting down (begin_shutdown was called); "
                 "no new requests are admitted during drain")
 
-    def _obs_request_done(self, status: str, born) -> None:
+    def _obs_request_done(self, status: str, born,
+                          rid: int | None = None) -> None:
         """Terminal-request telemetry: status counter, deadline-miss
-        counter, and the request latency histogram (engine clock, so
-        chaos tests with an injected clock stay deterministic)."""
+        counter, the request latency histogram (engine clock, so
+        chaos tests with an injected clock stay deterministic), and
+        the ``serving.finish`` trace event closing the request's
+        submit -> admit -> emit -> finish story."""
         obs.count("serving.requests", status=status)
         if status == "timeout":
             obs.count("serving.deadline_misses")
-        if born is not None and obs.active() is not None:
-            obs.observe("serving.request_s", self._clock() - born,
-                        status=status)
+        if obs.active() is not None:
+            if born is not None:
+                obs.observe("serving.request_s", self._clock() - born,
+                            status=status)
+            if rid is not None:
+                obs.event("serving.finish", request_id=rid,
+                          status=status)
 
     def _finish(self, rid: int, tokens, status: str, prompt_len: int,
                 error: str | None = None, born=None):
-        self._obs_request_done(status, born)
+        self._obs_request_done(status, born, rid=rid)
         self._completed[rid] = RequestResult(
             request_id=rid, tokens=np.asarray(tokens, np.int32),
             status=status, prompt_len=prompt_len, error=error)
@@ -122,18 +143,23 @@ class _AdmissionMixin:
         if not self._admitting_internal:
             rid = self._next_id
             self._next_id += 1
+            obs.event("serving.submit", request_id=rid, prompt_len=p,
+                      expired_on_arrival=True)
             self._finish(rid, prompt, "timeout", p,
                          born=self._clock())
             self.last_request_id = rid
         return True
 
-    def _admitted_id(self) -> int:
-        """Allocate the admitted request's id; caller-facing submits
-        expose it as ``last_request_id``."""
+    def _claim_rid(self) -> int:
+        """The id this admission runs under: the enqueue-assigned id
+        when submit is running as internal admission (so the admit
+        span/events carry the id the caller holds), else a fresh
+        allocation.  No ``last_request_id`` side effect — caller-
+        facing submits publish it only once the lane commits."""
+        if self._admit_rid is not None:
+            return self._admit_rid
         rid = self._next_id
         self._next_id += 1
-        if not self._admitting_internal:
-            self.last_request_id = rid
         return rid
 
     def _decline_full(self) -> None:
@@ -186,6 +212,9 @@ class _AdmissionMixin:
             dl = self._deadline_of(ttl, deadline)
             rid = self._next_id
             self._next_id += 1
+            obs.event("serving.submit", request_id=rid,
+                      prompt_len=int(prompt.size),
+                      max_new=int(max_new_tokens))
             if dl is not None and dl <= self._clock():
                 # born=now: a ~0s latency observation, so the request_s
                 # histogram count agrees with the requests counter (the
@@ -272,17 +301,18 @@ class _AdmissionMixin:
         return e.length, e.slot, e.last_token
 
     def _admit_pending(self, pend) -> bool:
-        self._admitting_internal = True
+        self._admit_rid = pend.request_id
         try:
             lane = self.submit(pend.prompt, pend.max_new,
                                deadline=pend.deadline, **pend.submit_kw)
         finally:
-            self._admitting_internal = False
+            self._admit_rid = None
         if lane is None:
             return False
         st = self._lane_state[lane]
-        # submit() allocated a fresh id; the request keeps the one its
-        # caller holds (ids stay unique — the fresh one is just unused).
+        # submit() admitted under the enqueue-assigned id (_claim_rid)
+        # so its admit span/events already carry the id the caller
+        # holds; the assignment is belt and braces.
         st.request_id = pend.request_id
         st.managed = True
         if pend.born is not None:

@@ -48,6 +48,9 @@ class _Lane:
     # none) and its PrefixPool id (refcount released at vacation).
     off: int = 0
     prefix_id: int | None = None
+    # Engine-clock time of the lane's previous emission (TTFT/TPOT
+    # telemetry; None until the first token lands).
+    last_emit: float | None = None
 
 
 def _make_lane_admit(model_params, model_cfg, prefix_lane=None,
@@ -154,7 +157,7 @@ class _LaneEngine(_AdmissionMixin, _ElasticMixin):
         if not st.done:
             raise ValueError(f"lane {lane} is still decoding")
         self._vacate(lane)
-        self._obs_request_done("ok", st.born)
+        self._obs_request_done("ok", st.born, rid=st.request_id)
         return np.asarray(st.tokens, np.int32)
 
     def _vacate(self, lane) -> None:
@@ -179,10 +182,18 @@ class _LaneEngine(_AdmissionMixin, _ElasticMixin):
         ``{lane: [emitted...]}`` step result.  The ONE site that
         counts emitted tokens (``serving.tokens``) — every step path
         funnels through here, so the throughput metric is
-        structurally complete.  Lanes still ADMITTING (pending prefill
-        chunks) are parked: their decode rows are burnt compute, never
-        emission."""
+        structurally complete, and so are the per-request latency
+        signals it derives: ``serving.ttft_s`` (born -> first token,
+        queue wait included for managed requests) and
+        ``serving.tpot_s`` (inter-token gap per emitted token), plus
+        one ``serving.emit`` trace event per emitting lane carrying
+        its ``request_id`` — the decode leg of the request waterfall
+        (``scripts/obs_report.py --request``).  Lanes still ADMITTING
+        (pending prefill chunks) are parked: their decode rows are
+        burnt compute, never emission."""
         out = {}
+        active = obs.active() is not None
+        now = self._clock() if active else None
         for lane, st in enumerate(self._lane_state):
             if st is None or st.done or st.chunks is not None:
                 continue
@@ -195,7 +206,18 @@ class _LaneEngine(_AdmissionMixin, _ElasticMixin):
                     st.done = True
                     break
             out[lane] = emitted
-        if obs.active() is not None:
+            if active and emitted:
+                first = (len(st.tokens) - st.prompt_len
+                         == len(emitted))
+                if first and st.born is not None:
+                    obs.observe("serving.ttft_s", now - st.born)
+                elif st.last_emit is not None:
+                    obs.observe("serving.tpot_s",
+                                (now - st.last_emit) / len(emitted))
+                st.last_emit = now
+                obs.event("serving.emit", request_id=st.request_id,
+                          lane=lane, n=len(emitted), first=first)
+        if active:
             obs.count("serving.tokens",
                       sum(len(v) for v in out.values()))
         return out
@@ -217,7 +239,8 @@ class _LaneEngine(_AdmissionMixin, _ElasticMixin):
         st = self._lane_state[lane]
         start, rows = st.chunks.pop(0)
         with obs.span("serving.admit_chunk", bucket=rows.shape[1],
-                      remaining=len(st.chunks)):
+                      remaining=len(st.chunks),
+                      request_id=st.request_id):
             self._exec_chunk(lane, start, rows)
         if not st.chunks:
             self._admitting.popleft()

@@ -738,6 +738,13 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                 return None
             lane = free[0]
             chaos.probe("serving.admit")
+            # The request's id (enqueue-assigned for internal
+            # admission, fresh otherwise) — claimed BEFORE the
+            # admission chunk so every span/event below carries it.
+            rid = self._claim_rid()
+            if not self._admitting_internal:
+                obs.event("serving.submit", request_id=rid,
+                          prompt_len=p, max_new=int(max_new_tokens))
 
             warm = p - 1
             plan = self._chunk_plan(off, warm)
@@ -746,7 +753,8 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                 start0, width0 = plan[0]
                 rows = self._chunk_rows(prompt, off, start0, width0)
                 with obs.span("serving.admit", bucket=width0,
-                              chunks=len(plan)):
+                              chunks=len(plan), lane=lane,
+                              request_id=rid):
                     if slot is not None:
                         self.cache = self._admit(
                             self.cache, jnp.asarray(rows),
@@ -799,11 +807,13 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
 
             # The pin taken above becomes the lane's reference here.
             self._lane_state[lane] = _Lane(
-                request_id=self._admitted_id(), prompt_len=p,
+                request_id=rid, prompt_len=p,
                 max_new=max_new_tokens, key=key, tokens=list(prompt),
                 eos=self.eos_token if eos_token is None else eos_token,
                 deadline=dl, born=self._clock(), chunks=chunks,
                 off=off, prefix_id=prefix_id)
+            if not self._admitting_internal:
+                self.last_request_id = rid
         except Exception:
             # Any failure between pin and lane commit (validation, a
             # chaos-injected admit fault, a dispatch error) must not

@@ -450,6 +450,10 @@ class SpeculativeBatcher(_LaneEngine):
                 return None
             lane = free[0]
             chaos.probe("serving.admit")
+            rid = self._claim_rid()
+            if not self._admitting_internal:
+                obs.event("serving.submit", request_id=rid,
+                          prompt_len=p, max_new=int(max_new_tokens))
             warm = p - 1
             if warm:
                 # The budget check above bounds warm and the bucket
@@ -461,7 +465,8 @@ class SpeculativeBatcher(_LaneEngine):
                 rows = np.zeros((1, width), np.int32)
                 rows[0, :warm] = prompt[:-1]
                 rows_j = jnp.asarray(rows)
-                with obs.span("serving.admit", bucket=width):
+                with obs.span("serving.admit", bucket=width, lane=lane,
+                              request_id=rid):
                     if slot is not None:
                         t_slab, d_slab = self._prefix_pool.slab
                         self.tcache = self._admit_t(
@@ -511,11 +516,13 @@ class SpeculativeBatcher(_LaneEngine):
             self.iters = self.iters.at[lane].set(0)
             # The pin taken above becomes the lane's reference here.
             self._lane_state[lane] = _Lane(
-                request_id=self._admitted_id(), prompt_len=p,
+                request_id=rid, prompt_len=p,
                 max_new=max_new_tokens, key=key, tokens=list(prompt),
                 eos=self.eos_token if eos_token is None else eos_token,
                 deadline=dl, born=self._clock(), off=off,
                 prefix_id=prefix_id)
+            if not self._admitting_internal:
+                self.last_request_id = rid
         except Exception:
             if prefix_id is not None:
                 self._prefix_pool.release(prefix_id)

@@ -122,6 +122,12 @@ class StepTimer:
             dt = time.perf_counter() - t0
             s, c = self.phases.get(name, (0.0, 0))
             self.phases[name] = (s + dt, c + 1)
+            if obs.active() is not None:
+                # Per-phase latency histogram (e.g. ``train.step_s``):
+                # what the rolling-window SLO engine diffs for a LIVE
+                # step-time percentile, where the spans above only
+                # reconstruct offline.
+                obs.observe(f"{self.scope}.{name}_s", dt)
 
     def phase_s(self, name: str) -> float:
         """Total seconds accumulated under ``name`` (0.0 if unused)."""

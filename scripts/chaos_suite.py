@@ -45,6 +45,7 @@ import argparse
 import os
 import sys
 import tempfile
+import threading
 
 os.environ.setdefault("KERAS_BACKEND", "jax")
 
@@ -243,7 +244,16 @@ from distkeras_tpu.resilience import FaultPlan, Supervisor, cluster
 
 member = cluster.member_from_env()
 trace = os.path.join({tracedir!r}, f"host{{host}}.e{{epoch}}.jsonl")
-obs.enable(trace_path=trace)
+# Live telemetry plane (round 11): every host serves /metrics etc. on
+# an ephemeral port, published into the coord dir's telemetry/ ledger
+# via the DKT_CLUSTER_* env contract, so /metrics/cluster on ANY host
+# federates the fleet; the rolling SLO rule makes the ladder double as
+# a latency-regression canary (a breach event in any host's trace
+# fails the ladder unless expected).
+obs.enable(trace_path=trace, serve_port=0,
+           slo_rules=[obs.SloRule("train.step_s", percentile=0.99,
+                                  threshold=60.0, window_s=30.0)],
+           slo_tick_s=0.25)
 obs.event("cluster.child", host=host, epoch=epoch, phase="start")
 member.start()
 assert jax.process_count() == {nhosts}, jax.process_count()
@@ -298,12 +308,75 @@ def _free_port():
         return s.getsockname()[1]
 
 
+# SLO breach classes (metric names) the cluster ladder tolerates.
+# Empty on purpose: the in-child rule (train.step_s p99 < 60s over a
+# 30s window) is generous enough that ANY breach means a real latency
+# pathology — the ladder is a latency-regression canary, not just a
+# recovery proof.
+EXPECTED_BREACH_METRICS: frozenset = frozenset()
+
+
+class _FederationScraper(threading.Thread):
+    """Poll host 0's published telemetry address and scrape its
+    ``/metrics/cluster`` while a cluster scenario runs; each sample
+    records which hosts' series were present — how the ladder proves a
+    killed host's series disappear and return across the coordinated
+    restart."""
+
+    def __init__(self, coord_dir: str, poll: float = 0.2):
+        super().__init__(name="chaos-federation-scrape", daemon=True)
+        self.coord_dir = coord_dir
+        self.poll = poll
+        self.samples: list = []   # (wall_t, frozenset(hosts up))
+        # NOT _stop: threading.Thread owns a private _stop method.
+        self._halt = threading.Event()
+
+    def _scrape_once(self):
+        import json as _json
+        import urllib.request
+
+        addr_path = os.path.join(self.coord_dir, "telemetry",
+                                 "host0.addr")
+        try:
+            with open(addr_path, encoding="utf-8") as f:
+                addr = _json.load(f)["addr"]
+            with urllib.request.urlopen(
+                    f"http://{addr}/metrics/cluster",
+                    timeout=2.0) as resp:
+                text = resp.read().decode("utf-8")
+        except Exception:  # noqa: BLE001 — between epochs: no server
+            return None
+        up = set()
+        for line in text.splitlines():
+            if line.startswith("cluster_scrape_up{"):
+                name, _, value = line.rpartition(" ")
+                if value.strip().startswith("1"):
+                    host = name.split('host="', 1)[1].split('"', 1)[0]
+                    up.add(int(host))
+        return frozenset(up)
+
+    def run(self):
+        import time as _time
+
+        while not self._halt.wait(self.poll):
+            up = self._scrape_once()
+            if up is not None:
+                self.samples.append((_time.time(), up))
+
+    def stop(self) -> list:
+        self._halt.set()
+        self.join(timeout=5.0)
+        return self.samples
+
+
 def run_cluster_scenario(scenario, seed, workdir, window=2.0,
                          attempt_timeout=240.0, num_epoch=2,
                          kill_round=5):
     """One coordinated-restart scenario on 2 local hosts; returns
-    (summaries, out_npz_path, trace_paths).  ``scenario`` None = no
-    chaos (the uninterrupted reference run)."""
+    (summaries, out_npz_path, trace_paths, federation_samples) —
+    federation samples are the scraped ``/metrics/cluster`` host sets
+    (round 11).  ``scenario`` None = no chaos (the uninterrupted
+    reference run)."""
     import glob
 
     from distkeras_tpu.resilience.cluster import run_cluster_local
@@ -331,14 +404,19 @@ def run_cluster_scenario(scenario, seed, workdir, window=2.0,
         per_host_env = {1: {"DKT_CHAOS": "drop:cluster.heartbeat:0"}}
     elif scenario is not None:
         raise ValueError(f"unknown cluster scenario {scenario!r}")
-    summaries = run_cluster_local(
-        [sys.executable, script], num_hosts=2, coord_dir=coord,
-        per_host_env=per_host_env, base_port=_free_port(),
-        checkpoint_dirs=[ckdir], window=window, poll=0.2,
-        heartbeat_interval=0.4, grace=90.0, max_restarts=2,
-        attempt_timeout=attempt_timeout)
+    scraper = _FederationScraper(coord)
+    scraper.start()
+    try:
+        summaries = run_cluster_local(
+            [sys.executable, script], num_hosts=2, coord_dir=coord,
+            per_host_env=per_host_env, base_port=_free_port(),
+            checkpoint_dirs=[ckdir], window=window, poll=0.2,
+            heartbeat_interval=0.4, grace=90.0, max_restarts=2,
+            attempt_timeout=attempt_timeout)
+    finally:
+        samples = scraper.stop()
     return summaries, out, sorted(glob.glob(
-        os.path.join(tracedir, "*.jsonl")))
+        os.path.join(tracedir, "*.jsonl"))), samples
 
 
 def run_cluster_ladder(scenarios, seed, workdir):
@@ -353,14 +431,14 @@ def run_cluster_ladder(scenarios, seed, workdir):
 
     print("== cluster ladder: uninterrupted 2-host reference ==",
           flush=True)
-    _, ref_out, _ = run_cluster_scenario(None, seed, workdir)
+    _, ref_out, _, _ = run_cluster_scenario(None, seed, workdir)
     ref = np.load(ref_out)
 
     failures = 0
     for scenario in scenarios:
         print(f"== cluster scenario: {scenario} ==", flush=True)
         try:
-            summaries, out, traces = run_cluster_scenario(
+            summaries, out, traces, samples = run_cluster_scenario(
                 scenario, seed, workdir)
             assert all(s["epochs"] >= 2 for s in summaries), (
                 f"no coordinated restart happened: {summaries}")
@@ -370,8 +448,30 @@ def run_cluster_ladder(scenarios, seed, workdir):
             assert not mismatch, (
                 f"resumed weights differ from the uninterrupted run: "
                 f"{mismatch}")
+            # Federation (round 11): /metrics/cluster must have served
+            # BOTH hosts' series host=-labeled at some point, and on a
+            # host kill the dead host's series must visibly drop out
+            # and return across the coordinated restart.
+            hosts_seen = [up for _, up in samples]
+            assert any(up >= {0, 1} for up in hosts_seen), (
+                f"/metrics/cluster never federated both hosts "
+                f"(samples: {hosts_seen[:20]})")
+            if scenario == "kill":
+                both = next(i for i, up in enumerate(hosts_seen)
+                            if up >= {0, 1})
+                gone = next((i for i in range(both, len(hosts_seen))
+                             if 0 in hosts_seen[i]
+                             and 1 not in hosts_seen[i]), None)
+                assert gone is not None, (
+                    "killed host's series never dropped out of "
+                    "/metrics/cluster")
+                assert any(up >= {0, 1}
+                           for up in hosts_seen[gone:]), (
+                    "killed host's series never returned after the "
+                    "coordinated restart")
             print(f"  PASS  cluster/{scenario}: restart under epoch "
-                  f"{summaries[0]['epochs'] - 1}, weights bit-for-bit")
+                  f"{summaries[0]['epochs'] - 1}, weights bit-for-bit, "
+                  f"{len(samples)} federation scrape(s)")
         except Exception as e:  # noqa: BLE001 — report the ladder
             failures += 1
             print(f"  FAIL  cluster/{scenario}: "
@@ -386,6 +486,27 @@ def run_cluster_ladder(scenarios, seed, workdir):
             print(json.dumps({"t": round(e["t"], 4), "host": e["host"],
                               "run": e["run"], "event": e["name"],
                               **e["fields"]}))
+        # Per-host SLO/breach timeline (round 11): the in-child
+        # rolling SLO rule turns the ladder into a latency-regression
+        # canary — a breach class outside EXPECTED_BREACH_METRICS
+        # fails the scenario.
+        breaches = [e for e in merged["timeline"]
+                    if e["name"] == "slo.breach"]
+        print(f"--- per-host SLO/breach timeline ({scenario}) ---")
+        if not breaches:
+            print("  (no SLO breaches)")
+        for e in breaches:
+            print(f"  +{e['t']:.3f}s host {e['host']} BREACH "
+                  + json.dumps(e["fields"]))
+        unexpected = [e for e in breaches
+                      if e["fields"].get("metric")
+                      not in EXPECTED_BREACH_METRICS]
+        if unexpected:
+            failures += 1
+            print(f"  FAIL  cluster/{scenario}: {len(unexpected)} "
+                  f"unexpected SLO breach(es) — latency regressed "
+                  f"under chaos (classes: "
+                  f"{sorted({e['fields'].get('metric') for e in unexpected})})")
     return failures
 
 

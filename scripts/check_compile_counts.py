@@ -338,6 +338,57 @@ def session_spec_prefix():
         "steady state; re-admission must hit the warm jit caches")
 
 
+def session_obs_live():
+    """Live telemetry plane (round 11): a ContinuousBatcher serve
+    session with a running TelemetryServer + SLO ticker, scraped
+    mid-decode.  The server/ticker are stdlib threads that only READ
+    the registry, so the live phase — decode steps interleaved with
+    /metrics and /metrics/cluster scrapes, /healthz probes, and
+    explicit SLO ticks — must add ZERO compiled programs (asserted
+    here; the recorded budget is the engine's own warm-up)."""
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from distkeras_tpu import obs
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.serving import ContinuousBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    eng = ContinuousBatcher(params, cfg, lanes=2, max_queue=4,
+                            prompt_buckets=(8,))
+    # Warm every program OUTSIDE the live phase.
+    rid = eng.enqueue(rng.integers(0, 64, (5,)).astype(np.int32), 4)
+    while eng.poll(rid) is None:
+        eng.step()
+    eng.take(rid)
+    rules = [obs.SloRule("serving.request_s", percentile=0.99,
+                         threshold=60.0, window_s=10.0)]
+    with obs.session(serve_port=0, slo_rules=rules) as sess:
+        live = _COMPILES["n"]
+        url = sess.server.url
+        rids = [eng.enqueue(rng.integers(0, 64, (5,)).astype(np.int32),
+                            6) for _ in range(3)]
+        while any(eng.poll(r) is None for r in rids):
+            eng.step()
+            urllib.request.urlopen(url + "/metrics", timeout=5).read()
+            sess.slo.tick()
+        urllib.request.urlopen(url + "/metrics/cluster",
+                               timeout=5).read()
+        urllib.request.urlopen(url + "/healthz", timeout=5).read()
+        assert all(eng.take(r).ok for r in rids)
+        live_compiles = _COMPILES["n"] - live
+        assert live_compiles == 0, (
+            f"live telemetry phase compiled {live_compiles} "
+            "program(s); the scrape server and SLO ticker must only "
+            "READ the registry")
+
+
 SESSIONS = {
     "adag": lambda: session_adag(),
     "adag_zero1": lambda: session_adag(zero1=True),
@@ -355,6 +406,7 @@ SESSIONS = {
     "serving_chunked": session_serving_chunked,
     "serving_prefix_pool": session_serving_prefix_pool,
     "spec_prefix": session_spec_prefix,
+    "obs_live": session_obs_live,
 }
 
 

@@ -13,10 +13,16 @@ Usage::
     python scripts/obs_report.py new.jsonl --compare base.jsonl
     python scripts/obs_report.py run.jsonl --json   # the report dict
     python scripts/obs_report.py --merge host0.jsonl host1.jsonl ...
+    python scripts/obs_report.py serve.jsonl --request 3
 
 ``--compare BASE`` prints a regression diff of NEW (the positional
 trace) against BASE instead of the full report — per-phase total/mean
 deltas, latency percentile deltas, counter drift.
+
+``--request ID`` renders ONE serving request's waterfall instead:
+submit -> queue wait -> admission (chunked-prefill spans included) ->
+per-step token emissions with inter-token gaps -> finish, filtered
+from the round-11 per-request ``request_id`` trace propagation.
 
 ``--merge`` takes SEVERAL per-host traces (a multi-host run writes one
 file per host per attempt) and renders ONE cross-host event timeline,
@@ -67,6 +73,10 @@ def main(argv):
     ap.add_argument("--merge", action="store_true",
                     help="merge per-host traces into one cross-host "
                          "event timeline (wall-clock aligned)")
+    ap.add_argument("--request", type=int, metavar="ID", default=None,
+                    help="render one serving request's waterfall "
+                         "(submit/admit/chunks/emits/finish) instead "
+                         "of the full report")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of text "
                          "(with --merge: one timeline entry per line)")
@@ -89,6 +99,16 @@ def main(argv):
         return 0
     if len(args.trace) != 1:
         ap.error("several traces need --merge")
+    if args.request is not None:
+        from distkeras_tpu.obs.trace import read_trace
+
+        wf = report.request_waterfall(read_trace(args.trace[0]),
+                                      args.request)
+        if args.json:
+            print(json.dumps(wf, indent=1, default=str))
+        else:
+            print(report.render_waterfall(wf))
+        return 0 if wf.get("found") else 1
     rep = report.load_report(args.trace[0])
     if args.compare:
         base = report.load_report(args.compare)

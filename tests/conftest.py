@@ -235,6 +235,34 @@ SLOW = MULTIPROCESS | {
     # budget goes to the exchange-layer matrix instead of a second
     # spawned-subprocess collective run.
     "test_cluster::test_two_process_kill_one_host_coordinated_restart",
+    # Round-11 fast-gate rebalance: the round-10 serving fast path
+    # grew the gate past its wall clock (measured 1029 s against the
+    # 870 s tier-1 budget on the 8-CPU harness, before this round
+    # added anything), so the heaviest SECOND spellings of already-
+    # fast-covered contracts move to the merge gate.  What stays fast
+    # per subsystem: beam — width-1/scores/eos/prefill/length-penalty/
+    # ancestry + the kv_int8 rolling-beam parity; speculative — the
+    # whole solo-fn matrix, the rolling batcher parity + draft-fault
+    # chaos tests, and the pooled engine parity; chunked prefill —
+    # greedy parity + the 1k-prompt interleave bound; device_data —
+    # the ADAG family matrix (test_device_data.py); TP decode — the
+    # prompt-cache decode test; compile counts — the graph-lint CLI
+    # and in-process census/parity stay, the full recorded-session
+    # guard subprocess (61 s) runs at merge (and in this round's
+    # obs_live work the new session asserts its zero-compile claim
+    # in-session, so a regression still fails the guard itself).
+    "test_budget_guards::test_compile_count_guard_passes",
+    "test_lm_trainer::test_lm_device_data_matches_streaming",
+    "test_lm_trainer::test_ema_decay_matches_manual_shadow",
+    "test_generate::test_beam_windowed_ancestry_equals_physical",
+    "test_generate::test_rolling_beam_matches_large_cache",
+    "test_serving::test_speculative_batcher_matches_solo",
+    "test_serving::test_speculative_batcher_sampled_matches_solo",
+    "test_serving_fastpath::test_chunked_prefill_sampled_and_tail_overlap",
+    "test_serving_fastpath::test_elastic_chunked_pool_enqueue",
+    "test_sharded_decode::test_beam_prompt_cache_under_tp",
+    "test_speculative::test_windowed_small_ring_matches_big_cache_sampled",
+    "test_obs_live::test_request_waterfall_speculative_and_unknown_id",
 }
 
 

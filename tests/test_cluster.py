@@ -417,12 +417,17 @@ def test_two_process_kill_one_host_coordinated_restart(tmp_path):
     the same global batches — identical math, reduction-order
     tolerance)."""
     cs = _load_chaos_suite()
-    summaries, out, traces = cs.run_cluster_scenario(
+    summaries, out, traces, fed = cs.run_cluster_scenario(
         "kill", 0, str(tmp_path), window=2.0, attempt_timeout=100.0,
         num_epoch=1, kill_round=3)
     for s in summaries:
         assert s["epochs"] == 2 and s["restarts"] == 1, s
     assert os.path.exists(out)
+    # Live telemetry plane (round 11): host 0's /metrics/cluster
+    # federated BOTH hosts' live servers at some point during the run.
+    assert any(up >= {0, 1} for _, up in fed), (
+        f"/metrics/cluster never federated both hosts: "
+        f"{[sorted(u) for _, u in fed][:20]}")
 
     # Chaos really killed host 1 (its epoch-0 trace records the
     # injected fault) and BOTH hosts started an epoch-1 attempt (the

@@ -196,6 +196,46 @@ def test_loop_jit_positive_and_negative():
 # ----------------------------------------------------------- suppression
 
 
+def test_jax_free_positive_and_negative():
+    """Round-11 satellite: modules on the jit-free ledger (the live
+    telemetry plane, the offline obs modules) must never import jax —
+    not even lazily inside a function."""
+    src = """
+        def handler():
+            import jax
+
+            return jax.devices()
+    """
+    pos = lint(src, path="distkeras_tpu/obs/live.py")
+    assert "jax-free" in rules_of(pos, only_gating=True)
+    pos = lint("from jax import numpy as jnp",
+               path="distkeras_tpu/obs/slo.py")
+    assert "jax-free" in rules_of(pos, only_gating=True)
+    # Same import outside the ledger: no finding.
+    neg = lint(src, path="distkeras_tpu/serving/lanes.py")
+    assert "jax-free" not in rules_of(neg)
+    # Ledger module importing non-jax things: no finding.
+    neg = lint("import json\nimport threading\n",
+               path="distkeras_tpu/obs/live.py")
+    assert "jax-free" not in rules_of(neg)
+
+
+def test_jax_free_ledger_covers_live_plane_on_disk():
+    """The shipped live-plane modules really are jax-free (the rule
+    would gate a regression; this pins the ledger covers them)."""
+    import os
+
+    from distkeras_tpu.analysis.source_lint import (_JAX_FREE_FILES,
+                                                    lint_paths)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(root, f) for f in _JAX_FREE_FILES]
+    assert all(os.path.exists(p) for p in paths), paths
+    assert {os.path.basename(p) for p in paths} >= {"live.py", "slo.py"}
+    findings = lint_paths(paths)
+    assert not [f.format() for f in findings if f.rule == "jax-free"]
+
+
 def test_suppression_comment_parsing():
     assert suppressed_rules("x = 1") is None
     assert suppressed_rules("x = 1  # dkt: ignore") == frozenset()
