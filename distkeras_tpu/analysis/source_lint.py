@@ -73,13 +73,16 @@ _HOT_PATH_FILES = ("serving.py",)  # pre-split path; tests still use it
 _STEP_NAME_HINT = ("step", "train", "update")
 # The JIT-FREE ledger: modules that must never import jax, even
 # lazily — the live telemetry plane (scrape/SLO threads must not be
-# able to trigger device work or compilation) and the offline obs
+# able to trigger device work or compilation), the offline obs
 # modules (obs_report.py imports them through a no-framework stub
-# loader on hosts with no jax installed).
+# loader on hosts with no jax installed), and the lock sanitizer
+# (utils/locks.py feeds the obs metrics registry and is imported by
+# every module above).
 _JAX_FREE_FILES = tuple(
     os.path.join("distkeras_tpu", "obs", f)
     for f in ("live.py", "slo.py", "metrics.py", "trace.py",
-              "report.py"))
+              "report.py")) + (
+    os.path.join("distkeras_tpu", "utils", "locks.py"),)
 
 
 def _attr_chain(node) -> list[str]:
@@ -340,9 +343,10 @@ def lint_source(source: str, path: str = "<string>") -> list[Finding]:
     return _Linter(path, source).run(tree)
 
 
-def lint_paths(paths: Iterable[str]) -> list[Finding]:
-    """Lint files/directories (``.py`` files, recursively)."""
-    findings: list[Finding] = []
+def iter_py_files(paths: Iterable[str]) -> list[str]:
+    """Expand files/directories into the sorted ``.py`` file list both
+    lint layers walk (``__pycache__`` skipped) — ONE walker, so
+    file-selection fixes cannot drift between them."""
     files: list[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -353,10 +357,16 @@ def lint_paths(paths: Iterable[str]) -> list[Finding]:
                              if n.endswith(".py"))
         else:
             files.append(p)
-    for f in sorted(files):
+    return sorted(files)
+
+
+def lint_paths(paths: Iterable[str]) -> list[Finding]:
+    """Lint files/directories (``.py`` files, recursively)."""
+    findings: list[Finding] = []
+    for f in iter_py_files(paths):
         with open(f, encoding="utf-8") as fh:
             findings.extend(lint_source(fh.read(), path=f))
     return findings
 
 
-__all__ = ["lint_source", "lint_paths"]
+__all__ = ["lint_source", "lint_paths", "iter_py_files"]

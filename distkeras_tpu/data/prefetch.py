@@ -91,7 +91,8 @@ class Prefetcher:
         self._finished = False
         self._stop = threading.Event()
         self._thread = threading.Thread(
-            target=self._run, args=(iter(source),), daemon=True)
+            target=self._run, args=(iter(source),),
+            name="dkt-prefetch", daemon=True)
         self._thread.start()
 
     def _put(self, item) -> bool:

@@ -13,9 +13,10 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 
 import numpy as np
+
+from distkeras_tpu.utils.locks import TracedLock
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), "native")
@@ -23,7 +24,9 @@ _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_NATIVE_DIR, "dataloader.cc")
 _SO = os.path.join(_PKG_DIR, "_libdkt_data.so")
 
-_lock = threading.Lock()
+# Build-cache lock (leaf): held across the one-time g++ build — a
+# long first acquire by design, never on a serving/training hot path.
+_lock = TracedLock("native.build")
 _lib = None
 _tried = False
 

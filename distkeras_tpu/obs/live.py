@@ -45,6 +45,8 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from distkeras_tpu.utils.locks import assert_unlocked
+
 
 # ------------------------------------------------------------- health
 
@@ -322,6 +324,9 @@ class TelemetryServer:
     # ----------------------------------------------------------- health
 
     def check_health(self):
+        # The injected probe is user code: it must never run under a
+        # sanitized lock (it may block on I/O or call back into obs).
+        assert_unlocked("obs.live health probe")
         try:
             out = self._health()
         except Exception as e:  # noqa: BLE001 — a broken probe is down

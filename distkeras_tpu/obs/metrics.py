@@ -35,7 +35,8 @@ from __future__ import annotations
 
 import bisect
 import re
-import threading
+
+from distkeras_tpu.utils.locks import TracedLock
 
 # Log-ish spaced seconds: 100us .. 2min.  Wide enough for h2d dispatch
 # at the bottom and a whole chaos-suite drain at the top.
@@ -71,7 +72,7 @@ class _Instrument:
         self.help = help
         self._children: dict[tuple, object] = {}
         self._lock = registry._lock if registry is not None \
-            else threading.Lock()
+            else TracedLock("obs.metrics")
 
     def _child(self, labels: dict):
         key = _label_key(labels)
@@ -223,7 +224,9 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # Leaf lock: every subsystem records INTO the registry while
+        # holding its own lock; nothing is acquired under this one.
+        self._lock = TracedLock("obs.registry")
         self._metrics: dict[str, _Instrument] = {}
         # prom_name -> registry name: the exposition mangling is lossy,
         # so a wire-name collision is detected HERE, at registration,

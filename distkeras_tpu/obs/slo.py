@@ -41,6 +41,7 @@ import threading
 import time
 
 from distkeras_tpu.obs.metrics import windowed_percentiles
+from distkeras_tpu.utils.locks import TracedLock, assert_unlocked
 
 # Histograms the ticker windows even without a rule naming them — the
 # serving fast path's user-facing latencies plus the training step.
@@ -114,7 +115,11 @@ class SloEngine:
                            + [30.0]) * 2.0
         self._breached: dict[int, bool] = {}
         self._subscribers: list = []
-        self._lock = threading.Lock()
+        # Guards the ring/breach state and the subscriber list; the
+        # registry lock nests INSIDE it (_aggregate -> snapshot).
+        # Subscriber callbacks always fire with it RELEASED (the PR-8
+        # deadlock regression; locks.assert_unlocked pins it).
+        self._lock = TracedLock("obs.slo")
         self._stop = threading.Event()
         self._thread = None
         self.last_values: dict[tuple[str, str], float] = {}
@@ -126,8 +131,10 @@ class SloEngine:
         transition.  Called from the ticker thread with the engine
         lock RELEASED, so the callback may query the engine
         (``windowed()``) or block — it only delays later ticks, never
-        deadlocks them."""
-        self._subscribers.append(fn)
+        deadlocks them.  Registration itself takes the lock, so a
+        subscribe racing a tick is ordered, not torn."""
+        with self._lock:
+            self._subscribers.append(fn)
 
     # ------------------------------------------------------------ ticks
 
@@ -198,13 +205,19 @@ class SloEngine:
         ticker."""
         with self._lock:
             values, fired = self._tick_locked()
+            subscribers = list(self._subscribers)
+        if fired:
+            # The lock-sanitizer guard: breach events and subscriber
+            # callbacks MUST fire with the engine lock released (the
+            # PR-8 subscriber-calls-windowed() deadlock).
+            assert_unlocked("slo.breach subscribers")
         for rule, value in fired:
             if self._emit is not None:
                 self._emit("slo.breach", metric=rule.metric,
                            q=rule.q_label, value=value,
                            threshold=rule.threshold,
                            window_s=rule.window_s)
-            for fn in list(self._subscribers):
+            for fn in subscribers:
                 try:
                     fn(rule, value)
                 except Exception:  # noqa: BLE001 — a subscriber

@@ -37,6 +37,8 @@ import threading
 import time
 import uuid
 
+from distkeras_tpu.utils.locks import TracedLock
+
 
 def _host_index() -> int:
     """jax process index if jax is already initialized; 0 otherwise.
@@ -87,7 +89,9 @@ class EventTrace:
         # — their monotonic clocks have different epochs, so a merged
         # file would report meaningless relative times.
         self._f = open(self.path, "w", buffering=1, encoding="utf-8")
-        self._lock = threading.Lock()
+        # Leaf lock: guards the file handle only (one write per
+        # record); span stacks are thread-local, not locked.
+        self._lock = TracedLock("obs.trace")
         self._tls = threading.local()
         self._next_id = 0
         self.host = _host_index()

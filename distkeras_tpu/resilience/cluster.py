@@ -582,7 +582,8 @@ def run_cluster_local(command, num_hosts: int, coord_dir: str, *,
         except BaseException as e:  # noqa: BLE001 — reported below
             errors[h] = e
 
-    threads = [threading.Thread(target=drive, args=(h,), daemon=True)
+    threads = [threading.Thread(target=drive, args=(h,),
+                                name=f"dkt-driver-host{h}", daemon=True)
                for h in range(num_hosts)]
     for t in threads:
         t.start()

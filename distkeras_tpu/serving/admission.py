@@ -19,7 +19,6 @@ exact-parity contract are untouched.
 from __future__ import annotations
 
 import collections
-import threading
 import time
 
 import numpy as np
@@ -27,6 +26,7 @@ import numpy as np
 from distkeras_tpu import obs
 from distkeras_tpu.resilience.admission import (EngineClosed, QueueFull,
                                                  RequestResult, _Pending)
+from distkeras_tpu.utils.locks import TracedRLock
 
 
 class _AdmissionMixin:
@@ -55,8 +55,10 @@ class _AdmissionMixin:
         # overloaded, it is closing.  EngineClosed WINS: once
         # begin_shutdown returns, every later enqueue/submit raises it,
         # even when the queue is also full.  Reentrant because
-        # enqueue -> pump -> _admit_pending nests.
-        self._admission_lock = threading.RLock()
+        # enqueue -> pump -> _admit_pending nests.  Ordering contract
+        # (docs/concurrency.md): this lock is acquired FIRST — pool/
+        # obs locks nest inside it, never the reverse.
+        self._admission_lock = TracedRLock("serving.admission")
         # Internal admission (enqueue -> pump -> submit) threads the
         # request's ENQUEUE-TIME id through to submit, so every span/
         # event the admission path emits carries the id the caller

@@ -40,13 +40,13 @@ and ``spec_prefix`` sessions.
 from __future__ import annotations
 
 import dataclasses
-import threading
 
 import jax
 import jax.numpy as jnp
 
 from distkeras_tpu.models.generate import init_cache
 from distkeras_tpu.models.transformer import TransformerConfig
+from distkeras_tpu.utils.locks import TracedRLock
 
 
 @dataclasses.dataclass
@@ -118,7 +118,9 @@ class PrefixPool:
         self._entries: dict[int, _Entry] = {}
         self._next_id = 0
         self._tick = 0
-        self._lock = threading.RLock()
+        # Leaf lock: engines acquire it UNDER their admission lock
+        # (_pin_prefix/_vacate); nothing is acquired under this one.
+        self._lock = TracedRLock("serving.prefix_pool")
 
     # -------------------------------------------------------- mutation
 

@@ -50,7 +50,8 @@ def probe_devices(deadline_s: float = 120.0):
         except Exception as e:  # noqa: BLE001 — surface to the caller
             err.append(e)
 
-    t = threading.Thread(target=probe, daemon=True)
+    t = threading.Thread(target=probe, name="dkt-device-probe",
+                         daemon=True)
     t.start()
     t.join(timeout=deadline_s)
     if t.is_alive():

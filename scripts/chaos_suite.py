@@ -219,6 +219,11 @@ import os, sys
 os.environ["KERAS_BACKEND"] = "jax"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+# Every cluster child runs under the LOCK SANITIZER (round 12): all
+# engine/obs/resilience locks are instrumented, and the child emits a
+# per-host locks.report event into its trace — the ladder fails on
+# any recorded violation.  Must be set before distkeras imports.
+os.environ.setdefault("DKT_LOCK_SANITIZER", "1")
 import jax
 jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, {repo!r})
@@ -290,6 +295,12 @@ if spec and epoch == 0:
 params = sup.run(tokens[host::{nhosts}])
 obs.event("cluster.child", host=host, epoch=epoch, phase="trained",
           rounds=len(t.history))
+from distkeras_tpu.utils import locks as _locks
+_rep = _locks.lock_report()
+obs.event("locks.report", host=host, epoch=epoch, **_rep)
+assert not _rep["violations"], (
+    "lock sanitizer violations on host %d:\\n" % host
+    + "\\n".join(v.format() for v in _locks.violations()))
 if host == 0:
     flat = {{"/".join(map(str, p)): np.asarray(v)
             for p, v in jax.tree_util.tree_flatten_with_path(params)[0]}}
@@ -507,6 +518,28 @@ def run_cluster_ladder(scenarios, seed, workdir):
                   f"unexpected SLO breach(es) — latency regressed "
                   f"under chaos (classes: "
                   f"{sorted({e['fields'].get('metric') for e in unexpected})})")
+        # Per-host lock-sanitizer report (round 12): every completing
+        # child emits one; a recorded violation anywhere in the
+        # ladder — any host, any epoch — fails the scenario.  (A
+        # chaos-killed epoch-0 child dies before reporting; the
+        # coordinated restart's completing attempt must still report
+        # for BOTH hosts.)
+        reports = [e for e in merged["timeline"]
+                   if e["name"] == "locks.report"]
+        print(f"--- per-host lock sanitizer report ({scenario}) ---")
+        for e in reports:
+            print(f"  host {e['host']}: " + json.dumps(e["fields"]))
+        hosts_reported = {e["fields"].get("host") for e in reports}
+        if not hosts_reported >= {0, 1}:
+            failures += 1
+            print(f"  FAIL  cluster/{scenario}: lock report missing "
+                  f"for host(s) {sorted({0, 1} - hosts_reported)}")
+        bad = [e for e in reports if e["fields"].get("violations")]
+        if bad:
+            failures += 1
+            print(f"  FAIL  cluster/{scenario}: lock sanitizer "
+                  f"violation(s) recorded on host(s) "
+                  f"{sorted({e['fields'].get('host') for e in bad})}")
     return failures
 
 
@@ -568,7 +601,12 @@ def main():
 
     from distkeras_tpu import obs
     from distkeras_tpu.obs.trace import read_trace
+    from distkeras_tpu.utils import locks
 
+    # The single-host matrix runs under the lock sanitizer too: every
+    # engine/obs lock the checks construct from here on is
+    # instrumented, and a recorded violation fails the suite.
+    locks.enable_sanitizer()
     trace_path = args.trace or os.path.join(
         tempfile.mkdtemp(prefix="chaos_obs_"), "chaos.jsonl")
     failures = 0
@@ -585,7 +623,14 @@ def main():
                 obs.event("chaos_suite.check", check=name,
                           status="fail", error=repr(e)[:200])
             assert chaos.active_plan() is None, "a FaultPlan leaked"
+        obs.event("locks.report", **locks.lock_report())
     print(f"{len(matrix) - failures}/{len(matrix)} chaos checks passed")
+    print("--- lock sanitizer report ---")
+    print(f"  {json.dumps(locks.lock_report())}")
+    if locks.violation_count():
+        failures += 1
+        for v in locks.violations():
+            print("  VIOLATION " + v.format())
 
     # Machine-readable fault/recovery timeline, straight off the obs
     # event trace: injected faults (chaos.fault), Supervisor attempts/

@@ -345,7 +345,30 @@ def session_obs_live():
     the registry, so the live phase — decode steps interleaved with
     /metrics and /metrics/cluster scrapes, /healthz probes, and
     explicit SLO ticks — must add ZERO compiled programs (asserted
-    here; the recorded budget is the engine's own warm-up)."""
+    here; the recorded budget is the engine's own warm-up).
+
+    Round 12: the whole session runs under the LOCK SANITIZER
+    (utils/locks.py) — every engine/registry/SLO lock is instrumented
+    from construction on — asserting both that the sanitizer itself
+    is jax-free (zero extra programs: the budget is unchanged from
+    the un-sanitized recording) and that the live plane's lock
+    discipline is violation-free under real scrape traffic."""
+    from distkeras_tpu.utils import locks
+
+    was_enabled = locks.sanitizer_enabled()
+    locks.enable_sanitizer()
+    try:
+        _session_obs_live_sanitized()
+    finally:
+        # Restore, don't blindly disable: a later session must not
+        # silently run un-sanitized when the environment asked for
+        # DKT_LOCK_SANITIZER process-wide, and an assertion failure
+        # above must not leave state dependent on the failure path.
+        if not was_enabled:
+            locks.disable_sanitizer()
+
+
+def _session_obs_live_sanitized():
     import urllib.request
 
     import jax
@@ -354,6 +377,7 @@ def session_obs_live():
     from distkeras_tpu import obs
     from distkeras_tpu.models import transformer as tfm
     from distkeras_tpu.serving import ContinuousBatcher
+    from distkeras_tpu.utils import locks
 
     cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
                                 n_layers=2, d_ff=64, max_len=32,
@@ -386,7 +410,11 @@ def session_obs_live():
         assert live_compiles == 0, (
             f"live telemetry phase compiled {live_compiles} "
             "program(s); the scrape server and SLO ticker must only "
-            "READ the registry")
+            "READ the registry (sanitizer enabled: utils/locks.py "
+            "must stay jax-free)")
+    vs = locks.violations()
+    assert not vs, "lock sanitizer violations in the live session:\n" \
+        + "\n".join(v.format() for v in vs)
 
 
 SESSIONS = {

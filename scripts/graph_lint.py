@@ -2,7 +2,9 @@
 
 Runs, in order:
 
-1. the **source lint** (analysis/source_lint.py) over ``distkeras_tpu/``;
+1. the **source lint** (analysis/source_lint.py) over ``distkeras_tpu/``,
+   plus the **thread-safety lint** (analysis/thread_lint.py) over the
+   threaded core modules;
 2. the **IR lint** (analysis/ir_lint.py) over the standard trace
    targets (analysis/targets.py) — every trainer family's and serving
    engine's real jitted step on the deterministic 8-device CPU mesh:
@@ -16,6 +18,7 @@ Exit 0 iff there are zero unsuppressed error/warn findings.  Usage::
 
     python scripts/graph_lint.py                  # full run (CI)
     python scripts/graph_lint.py --source-only    # AST rules only, fast
+    python scripts/graph_lint.py --threads        # thread-safety rules only
     python scripts/graph_lint.py --ir-only        # IR rules + budgets
     python scripts/graph_lint.py --update-budgets # re-record the census
     python scripts/graph_lint.py --update-baseline # re-record warn ledger
@@ -54,6 +57,13 @@ def run_source(findings):
     from distkeras_tpu.analysis.source_lint import lint_paths
 
     findings += lint_paths([os.path.join(REPO, "distkeras_tpu")])
+    run_threads(findings)
+
+
+def run_threads(findings):
+    from distkeras_tpu.analysis.thread_lint import lint_paths_threads
+
+    findings += lint_paths_threads([os.path.join(REPO, "distkeras_tpu")])
 
 
 def run_ir(findings, update: bool, verbose: bool):
@@ -96,6 +106,10 @@ def main(argv):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--source-only", action="store_true")
     ap.add_argument("--ir-only", action="store_true")
+    ap.add_argument("--threads", action="store_true",
+                    help="thread-safety rules only (analysis/"
+                         "thread_lint.py over the threaded core), "
+                         "fastest of all")
     ap.add_argument("--update-budgets", action="store_true")
     ap.add_argument("--update-baseline", action="store_true",
                     help="re-record scripts/lint_baseline.json from "
@@ -103,24 +117,36 @@ def main(argv):
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
+    if args.update_baseline and (args.source_only or args.ir_only
+                                 or args.threads):
+        # The ledger covers BOTH lint layers; re-recording from a
+        # half-census would drop the other layer's keys and start
+        # failing its previously-baselined warns on the next full run.
+        ap.error("--update-baseline needs the full run (drop "
+                 "--source-only/--ir-only/--threads)")
+    if args.threads and (args.source_only or args.ir_only
+                         or args.update_budgets):
+        # --threads skips the IR layer entirely: silently accepting a
+        # budget re-record (or a conflicting mode) would exit 0
+        # having written nothing.
+        ap.error("--threads runs the thread-safety rules alone; it "
+                 "cannot combine with --source-only/--ir-only/"
+                 "--update-budgets")
+
     from distkeras_tpu.analysis.findings import (apply_baseline,
                                                  format_findings,
                                                  load_baseline,
                                                  save_baseline)
 
-    if args.update_baseline and (args.source_only or args.ir_only):
-        # The ledger covers BOTH lint layers; re-recording from a
-        # half-census would drop the other layer's keys and start
-        # failing its previously-baselined warns on the next full run.
-        ap.error("--update-baseline needs the full run (drop "
-                 "--source-only/--ir-only)")
-
     findings = []
-    if not args.ir_only:
-        run_source(findings)
-    if not args.source_only:
-        run_ir(findings, update=args.update_budgets,
-               verbose=args.verbose)
+    if args.threads:
+        run_threads(findings)
+    else:
+        if not args.ir_only:
+            run_source(findings)
+        if not args.source_only:
+            run_ir(findings, update=args.update_budgets,
+                   verbose=args.verbose)
     if args.update_baseline:
         counts = save_baseline(BASELINE_PATH, findings)
         print(f"wrote {BASELINE_PATH} ({sum(counts.values())} warn "

@@ -55,7 +55,13 @@ def _load_report_module():
     for name, path in (
             ("distkeras_tpu", os.path.join(REPO, "distkeras_tpu")),
             ("distkeras_tpu.obs",
-             os.path.join(REPO, "distkeras_tpu", "obs"))):
+             os.path.join(REPO, "distkeras_tpu", "obs")),
+            # obs/metrics.py (and friends) import the lock wrappers
+            # from utils.locks — stdlib-only, but the utils package
+            # root is NOT (it pulls the framework), so it gets a stub
+            # parent too.
+            ("distkeras_tpu.utils",
+             os.path.join(REPO, "distkeras_tpu", "utils"))):
         if name not in sys.modules:
             mod = types.ModuleType(name)
             mod.__path__ = [path]

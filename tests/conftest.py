@@ -16,6 +16,14 @@ import os
 os.environ["KERAS_BACKEND"] = "jax"
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
+# Run the WHOLE tier-1 suite under the lock-order sanitizer
+# (utils/locks.py): every TracedLock/TracedRLock the production code
+# constructs is instrumented, lock-order inversions / double-acquires
+# / callbacks-under-lock raise at the offending site, and the autouse
+# fixture below fails any test that recorded a violation.  Set before
+# anything imports distkeras_tpu (the env is read at locks import);
+# the driver can override with DKT_LOCK_SANITIZER=0.
+os.environ.setdefault("DKT_LOCK_SANITIZER", "1")
 
 import jax
 
@@ -294,3 +302,76 @@ def _bound_llvm_jit_maps():
     _test_tally["n"] += 1
     if _test_tally["n"] % _TESTS_PER_CACHE_DROP == 0:
         jax.clear_caches()
+
+
+# ------------------------------------------------ concurrency gate
+# (round 12)  Two autouse fixtures make thread discipline a tier-1
+# property of EVERY test, not just the ones that think about threads:
+#
+# - _lock_sanitizer_gate: any lock-order violation the runtime
+#   sanitizer recorded during the test fails it — even when the
+#   raising thread swallowed the exception (SLO ticker, HTTP handler
+#   threads catch broadly).  Tests that deliberately provoke
+#   violations (tests/test_locks.py positives) opt out with
+#   @pytest.mark.expected_lock_violations.
+# - _no_thread_leaks: a test must not leave its own background
+#   threads running (the PR-8 EADDRINUSE class: a leaked
+#   dkt-telemetry thread holds the port for the next test).  All
+#   subsystem threads are dkt-named; a gc pass first lets abandoned
+#   Prefetcher/engine objects run their __del__ cleanup, then
+#   stragglers get a short grace to finish stopping.  Opt out with
+#   @pytest.mark.bg_threads for tests that intentionally leave
+#   background work (e.g. a deliberately hung device probe).
+
+import sys as _sys
+
+
+def _locks_module():
+    return _sys.modules.get("distkeras_tpu.utils.locks")
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer_gate(request):
+    locks = _locks_module()
+    before = locks.violation_count() if locks is not None else 0
+    yield
+    if request.node.get_closest_marker("expected_lock_violations"):
+        return
+    locks = _locks_module()
+    if locks is None:
+        return
+    new = locks.violations()[before:]
+    assert not new, (
+        "the lock sanitizer recorded violation(s) during this test:\n"
+        + "\n".join(v.format() for v in new))
+
+
+@pytest.fixture(autouse=True)
+def _no_thread_leaks(request):
+    import threading as _threading
+
+    before = set(_threading.enumerate())
+    yield
+    if request.node.get_closest_marker("bg_threads"):
+        return
+
+    def leaked():
+        return [t for t in _threading.enumerate()
+                if t.is_alive() and t not in before
+                and t.name.startswith("dkt-")]
+
+    left = leaked()
+    if left:
+        import gc
+        import time as _time
+
+        gc.collect()   # abandoned Prefetcher/session: __del__ stops it
+        deadline = _time.monotonic() + 2.0
+        while leaked() and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        left = leaked()
+    assert not left, (
+        f"test leaked live background thread(s): "
+        f"{sorted(t.name for t in left)} — stop/close them, or mark "
+        "the test @pytest.mark.bg_threads if the background work is "
+        "intentional")

@@ -193,6 +193,249 @@ def test_loop_jit_positive_and_negative():
     assert "loop-jit" not in rules_of(neg)
 
 
+# ---------------------------------------------------- thread-safety rules
+
+
+def tlint(src, path="distkeras_tpu/serving/foo.py"):
+    from distkeras_tpu.analysis.thread_lint import lint_source_threads
+
+    return lint_source_threads(textwrap.dedent(src), path=path)
+
+
+def test_raw_lock_positive_and_negative():
+    src = """
+        import threading
+
+        L = threading.Lock()
+    """
+    assert "raw-lock" in rules_of(tlint(src))
+    assert "raw-lock" in rules_of(tlint(
+        "import threading\nR = threading.RLock()",
+        path="distkeras_tpu/obs/foo.py"))
+    # Outside the threaded scope: no finding.
+    assert "raw-lock" not in rules_of(
+        tlint(src, path="distkeras_tpu/models/foo.py"))
+    # The instrumented wrappers are the fix, not a finding.
+    assert "raw-lock" not in rules_of(tlint("""
+        from distkeras_tpu.utils.locks import TracedLock
+
+        L = TracedLock("x")
+    """))
+    # ... and their own module is the one allowlisted raw-lock home.
+    assert "raw-lock" not in rules_of(tlint(
+        "import threading\nL = threading.Lock()",
+        path="distkeras_tpu/utils/locks.py"))
+    # Every import spelling is caught, not just the literal one.
+    assert "raw-lock" in rules_of(tlint("""
+        from threading import Lock
+
+        L = Lock()
+    """))
+    assert "raw-lock" in rules_of(tlint("""
+        from threading import RLock as R
+
+        L = R()
+    """))
+    assert "raw-lock" in rules_of(tlint("""
+        import threading as t
+
+        L = t.Condition()
+    """))
+    # A non-threading Lock name does not fire.
+    assert "raw-lock" not in rules_of(tlint("""
+        from multiprocessing import Lock
+
+        L = Lock()
+    """))
+
+
+def test_lock_callback_positive_and_negative():
+    # The exact PR-8 deadlock shape: subscribers fired under the lock.
+    pos = tlint("""
+        class T:
+            def tick(self):
+                with self._lock:
+                    for fn in list(self._subscribers):
+                        fn(1)
+    """)
+    assert "lock-callback" in rules_of(pos)
+    # Direct call of a callback-named attribute under a lock.
+    assert "lock-callback" in rules_of(tlint("""
+        class T:
+            def fire(self):
+                with self._lock:
+                    self.on_breach_callback(1)
+    """))
+    # The fixed shape: collect under the lock, fire after release.
+    assert "lock-callback" not in rules_of(tlint("""
+        class T:
+            def tick(self):
+                with self._lock:
+                    fired = list(self._subscribers)
+                for fn in fired:
+                    fn(1)
+    """))
+    # A def nested under the with runs LATER, not under the lock.
+    assert "lock-callback" not in rules_of(tlint("""
+        class T:
+            def tick(self):
+                with self._lock:
+                    def later():
+                        for fn in list(self._subscribers):
+                            fn(1)
+                    self.pending = later
+    """))
+
+
+def test_lock_blocking_positive_and_negative():
+    assert "lock-blocking" in rules_of(tlint("""
+        import time
+
+        def f(lock):
+            with lock:
+                time.sleep(1.0)
+    """))
+    assert "lock-blocking" in rules_of(tlint("""
+        import subprocess
+
+        def f(lock):
+            with lock:
+                subprocess.run(["g++"])
+    """))
+    assert "lock-blocking" in rules_of(tlint("""
+        class T:
+            def stop(self):
+                with self._lock:
+                    self._thread.join(timeout=5.0)
+    """))
+    assert "lock-blocking" in rules_of(tlint("""
+        from urllib.request import urlopen
+
+        def f(lock):
+            with lock:
+                return urlopen("http://peer/metrics").read()
+    """))
+    # The same calls OFF the lock: no finding.
+    assert "lock-blocking" not in rules_of(tlint("""
+        import time
+
+        def f(lock):
+            with lock:
+                n = 1
+            time.sleep(1.0)
+    """))
+    # A string join under a lock is not a thread join.
+    assert "lock-blocking" not in rules_of(tlint("""
+        def f(lock, parts):
+            with lock:
+                return ",".join(parts)
+    """))
+
+
+def test_lock_double_acquire_positive_and_negative():
+    pos = tlint("""
+        from distkeras_tpu.utils.locks import TracedLock
+
+        class T:
+            def __init__(self):
+                self._lock = TracedLock("t")
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """)
+    assert "lock-double-acquire" in rules_of(pos)
+    # The same nesting on a REENTRANT lock is legal.
+    assert "lock-double-acquire" not in rules_of(tlint("""
+        from distkeras_tpu.utils.locks import TracedRLock
+
+        class T:
+            def __init__(self):
+                self._lock = TracedRLock("t")
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """))
+    # Two DIFFERENT locks nesting: legal.
+    assert "lock-double-acquire" not in rules_of(tlint("""
+        from distkeras_tpu.utils.locks import TracedLock
+
+        class T:
+            def __init__(self):
+                self._a = TracedLock("a")
+                self._b_lock = TracedLock("b")
+
+            def f(self):
+                with self._a:
+                    with self._b_lock:
+                        pass
+    """))
+    # An attr name bound reentrant in ONE class and non-reentrant in
+    # another is ambiguous, not proof: the reentrant class's legal
+    # nesting must not fire.
+    assert "lock-double-acquire" not in rules_of(tlint("""
+        from distkeras_tpu.utils.locks import TracedLock, TracedRLock
+
+        class A:
+            def __init__(self):
+                self._lock = TracedRLock("a")
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+
+        class B:
+            def __init__(self):
+                self._lock = TracedLock("b")
+    """))
+
+
+def test_thread_lint_suppression_and_severity():
+    findings = tlint("""
+        import time
+
+        def f(lock):
+            with lock:
+                time.sleep(0.1)  # dkt: ignore[lock-blocking]
+    """)
+    hits = [f for f in findings if f.rule == "lock-blocking"]
+    assert hits and all(f.suppressed for f in hits)
+    assert not [f for f in findings if f.gating]
+    # raw-lock / lock-callback / lock-double-acquire are errors
+    # (never baselineable); lock-blocking is a warn (ratchets).
+    sev = {f.rule: f.severity for f in tlint("""
+        import threading, time
+
+        L = threading.Lock()
+
+        def f(lock):
+            with lock:
+                time.sleep(0.1)
+    """)}
+    assert sev == {"raw-lock": "error", "lock-blocking": "warn"}
+
+
+def test_thread_lint_clean_on_repo():
+    """The shipped threaded core lints clean — the migration to
+    TracedLock is complete and nothing fires callbacks or blocks
+    under a lock (zero suppressions; satellite acceptance)."""
+    import os
+
+    from distkeras_tpu.analysis.thread_lint import lint_paths_threads
+
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "distkeras_tpu")
+    findings = lint_paths_threads([root])
+    gating = [f.format() for f in findings if f.gating]
+    assert not gating, gating
+    assert not [f for f in findings if f.suppressed], (
+        "the concurrency gate ships with zero suppressions")
+
+
 # ----------------------------------------------------------- suppression
 
 
