@@ -61,14 +61,15 @@ from distkeras_tpu.utils.serialization import (
 )
 from distkeras_tpu import obs
 from distkeras_tpu.models.adapter import ModelAdapter, TrainState
-from distkeras_tpu.parallel import collectives, exchange
+from distkeras_tpu.parallel import collectives, exchange, rules
 from distkeras_tpu.parallel.collectives import zero1_optimizer
 from distkeras_tpu.parallel.exchange import (ExchangeConfig,
                                               exchange_optimizer)
 from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+from distkeras_tpu.parallel.rules import match_partition_rules
 from distkeras_tpu.parallel.sharding import (ShardingPlan, dp_plan,
                                               fsdp_plan, tp_plan,
-                                              zero1_plan)
+                                              zero1_plan, zero3_plan)
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.data.packing import pack_documents, packing_efficiency
 from distkeras_tpu.data.tokenizer import BPETokenizer
@@ -120,9 +121,12 @@ __all__ = [
     "fsdp_plan",
     "tp_plan",
     "zero1_plan",
+    "zero3_plan",
     "zero1_optimizer",
+    "match_partition_rules",
     "collectives",
     "exchange",
+    "rules",
     "ExchangeConfig",
     "exchange_optimizer",
     "obs",

@@ -71,11 +71,15 @@ class TraceSpec:
     donate_argnums: tuple = ()
     suppress: tuple = ()
     # Total parameter bytes of the model this step trains (the hooks
-    # fill it in) — the zero1 parity check's reference volume P.
+    # fill it in) — the zero parity check's reference volume P.
     params_bytes: int | None = None
     # The DP partner target whose gradient all-reduce this target's
     # declared RS+AG exchange must replace at equal volume.
     zero1_parity_with: str | None = None
+    # Which ZeRO stage's declared scopes to measure (1: the post-scan
+    # RS + explicit AG; 2: the in-scan accumulator RS + update AG; 3:
+    # the gather-on-use AG + backward grad RS).
+    zero_stage: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -618,15 +622,36 @@ def check_budget(name: str, census: Sequence[CollectiveOp],
              "scripts/comm_budget.json diff")]
 
 
-def declared_zero1_exchange(spec: TraceSpec) -> dict:
-    """Measure the zero1 exchange the step DECLARES, from its traced
-    jaxpr: ``rs_bytes`` = the sharding-constraint reduce-scatters
-    under the ``zero1/reduce_scatter`` named scope, ``ag_bytes`` = the
-    explicit all-gathers under ``zero1/all_gather``.  These are the
-    real program's eqns (the hooks hand out the executed step), just
-    read before GSPMD picks a backend-specific implementation."""
+def declared_zero_exchange(spec: TraceSpec, stage: int | None = None
+                           ) -> dict:
+    """Measure the ZeRO exchange the step DECLARES, from its traced
+    jaxpr.  Per stage (``spec.zero_stage`` unless overridden):
+
+    * stage 1 — ``rs_bytes``: the sharding-constraint reduce-scatters
+      under the ``zero1/reduce_scatter`` scope; ``ag_bytes``: the
+      explicit all-gathers (shard_map) under ``zero1/all_gather``;
+    * stage 2 — ``rs_bytes``: the in-scan accumulator constraints
+      under ``zero2/accum_scatter`` (one program occurrence covers the
+      whole window — the scan body is one sub-jaxpr); ``ag_bytes``:
+      the update all-gathers under ``zero2/all_gather``;
+    * stage 3 — ``ag_bytes``: the gather-on-use constraints under
+      ``zero3/param_gather``; ``rs_bytes``: the backward cotangent
+      constraints under ``zero3/grad_scatter``.  NOTE the backward
+      eqn's name stack reads ``transpose(jvp(zero3/param_gather))/
+      zero3/grad_scatter`` — it contains BOTH scopes, so the scatter
+      scope takes precedence.
+
+    These are the real program's eqns (the hooks hand out the executed
+    step), just read before GSPMD picks a backend-specific
+    implementation."""
+    stage = spec.zero_stage if stage is None else stage
     closed = spec.fn.trace(*spec.args).jaxpr
     out = {"rs_bytes": 0, "ag_bytes": 0}
+    rs_scope = {1: "zero1/reduce_scatter", 2: "zero2/accum_scatter",
+                3: "zero3/grad_scatter"}[stage]
+    ag_scope = {1: "zero1/all_gather", 2: "zero2/all_gather",
+                3: "zero3/param_gather"}[stage]
+    ag_prim = "sharding_constraint" if stage == 3 else "shard_map"
 
     def nbytes(eqn):
         return sum(int(np.prod(v.aval.shape)) * v.aval.dtype.itemsize
@@ -636,10 +661,9 @@ def declared_zero1_exchange(spec: TraceSpec) -> dict:
         for eqn in jaxpr.eqns:
             stack = str(getattr(eqn.source_info, "name_stack", ""))
             prim = eqn.primitive.name
-            if ("zero1/reduce_scatter" in stack
-                    and prim == "sharding_constraint"):
+            if prim == "sharding_constraint" and rs_scope in stack:
                 out["rs_bytes"] += nbytes(eqn)
-            if "zero1/all_gather" in stack and prim == "shard_map":
+            elif prim == ag_prim and ag_scope in stack:
                 out["ag_bytes"] += nbytes(eqn)
             for sub, _ in _subjaxprs(eqn):
                 walk(sub)
@@ -648,30 +672,45 @@ def declared_zero1_exchange(spec: TraceSpec) -> dict:
     return out
 
 
+def declared_zero1_exchange(spec: TraceSpec) -> dict:
+    """Stage-1 spelling of :func:`declared_zero_exchange` (kept for
+    older call sites)."""
+    return declared_zero_exchange(spec, stage=1)
+
+
 def check_zero1_parity(z1_spec: TraceSpec, dp_census) -> list[Finding]:
-    """The ZeRO-1 acceptance check: RS+AG must move exactly the bytes
-    of the gradient all-reduce it replaces.
+    """The ZeRO acceptance check (stages 1/2/3; the stage comes from
+    ``spec.zero_stage``): the declared scatter/gather exchange must be
+    PAD-FREE — each leg moves exactly the model's parameter bytes.
 
     With P = the model's parameter bytes, the check asserts (all
     measured, nothing assumed):
 
-    1. the zero1 step declares reduce-scatter payload == P — i.e. the
-       bucket layout added ZERO padding — and all-gather payload == P;
+    1. the zero step declares scatter payload == P — i.e. the bucket
+       layout added ZERO padding — and gather payload == P.  Per
+       program occurrence: stage 1's post-scan RS and update AG, stage
+       2's in-scan accumulator RS (the scan body is one occurrence
+       covering the whole window — so the per-ROUND wire is
+       ``window x RS(P) + AG(P)`` vs replicated DP's ``window x
+       AR(P)``, stage 2's saving) and update AG, stage 3's
+       gather-on-use AG and backward grad RS (no update gather at all);
     2. by the ring identity RS(P) + AG(P) carries exactly AR(P)'s
-       wire bytes: ``2 (n-1)/n P`` per device — the replicated-DP
-       gradient all-reduce volume;
+       wire bytes: ``2 (n-1)/n P`` per device — so stage 1's per-round
+       exchange equals the replicated-DP gradient all-reduce volume,
+       and stages 2/3 never exceed it;
     3. the DP partner's COMPILED all-reduces move >= P gradient bytes;
-       moving more than P is reported as an info finding (e.g. tied
+       moving more than P is reported as a warn finding (e.g. tied
        weights whose gradient contributions XLA reduces separately).
 
     (1)+(2) prove the headline claim; (3) pins it to the compiled DP
-    program.  Compiled zero1 bytes are pinned separately by the census
+    program.  Compiled zero bytes are pinned separately by the census
     budget: XLA CPU implements the declared exchange hierarchically
     (subgroup all-reduces + permutes), a backend artifact the budget
     tracks but parity must not depend on.
     """
     findings = []
     P = z1_spec.params_bytes
+    stage = z1_spec.zero_stage
 
     def add(rule, severity, message, hint=""):
         findings.append(Finding(
@@ -681,18 +720,18 @@ def check_zero1_parity(z1_spec: TraceSpec, dp_census) -> list[Finding]:
 
     if not P:
         add("zero1-parity", "error",
-            "zero1 parity target carries no params_bytes reference",
+            "zero parity target carries no params_bytes reference",
             "the traced_for_analysis hook must fill params_bytes")
         return findings
-    decl = declared_zero1_exchange(z1_spec)
+    decl = declared_zero_exchange(z1_spec)
     if decl["rs_bytes"] != P or decl["ag_bytes"] != P:
         add("zero1-parity", "error",
-            f"declared exchange RS={decl['rs_bytes']} / "
-            f"AG={decl['ag_bytes']} bytes != parameter bytes {P} — "
-            "RS+AG no longer carries exactly the all-reduce it "
-            "replaces",
+            f"declared stage-{stage} exchange scatter="
+            f"{decl['rs_bytes']} / gather={decl['ag_bytes']} bytes != "
+            f"parameter bytes {P} — the exchange no longer carries "
+            "exactly the volume the proof pins",
             "nonzero bucket padding (a leaf size stopped dividing by "
-            "the data axis) or a missing zero1 scope; inspect "
+            "the data axis) or a missing zero named scope; inspect "
             "collectives.Zero1Layout for this parameter tree")
     # The DP partner's compiled gradient all-reduce: every AR big
     # enough to be a gradient leaf (scalars like the loss mean are
@@ -750,5 +789,5 @@ def save_budgets(path: str, budgets: dict, device_count: int | None = None
 
 __all__ = ["TraceSpec", "CollectiveOp", "comm_census", "lint_trace",
            "census_wire_total", "census_to_budget", "check_budget",
-           "declared_zero1_exchange", "check_zero1_parity",
-           "load_budgets", "save_budgets"]
+           "declared_zero_exchange", "declared_zero1_exchange",
+           "check_zero1_parity", "load_budgets", "save_budgets"]

@@ -18,12 +18,21 @@ import dataclasses
 
 from distkeras_tpu.analysis.ir_lint import TraceSpec
 
-# (zero1 target, its replicated-DP partner) — the pairs the parity
-# check runs on.
-ZERO1_PARITY_PAIRS = (
-    ("adag_zero1/accum_step", "adag_dp/accum_step"),
-    ("lmtrainer_zero1/train_step", "lmtrainer_dp/train_step"),
+# (zero target, its replicated-DP partner, stage) — the triples the
+# declared-exchange parity proof runs on (ir_lint.check_zero1_parity;
+# stages 2/3 measure their own scopes — see declared_zero_exchange).
+ZERO_PARITY_TARGETS = (
+    ("adag_zero1/accum_step", "adag_dp/accum_step", 1),
+    ("adag_zero2/accum_step", "adag_dp/accum_step", 2),
+    ("adag_zero3/accum_step", "adag_dp/accum_step", 3),
+    ("lmtrainer_zero1/train_step", "lmtrainer_dp/train_step", 1),
+    ("lmtrainer_zero2/train_step", "lmtrainer_dp/train_step", 2),
+    ("lmtrainer_zero3/train_step", "lmtrainer_dp/train_step", 3),
 )
+
+# Stage-1 pairs (kept: the historical name some callers import).
+ZERO1_PARITY_PAIRS = tuple(
+    (z, dp) for z, dp, stage in ZERO_PARITY_TARGETS if stage == 1)
 
 
 def _lm_cfg():
@@ -66,6 +75,10 @@ def adag_targets() -> list[TraceSpec]:
     ds = _mlp_dataset()
     specs = (_mlp_trainer(zero1=False).traced_for_analysis(ds)
              + _mlp_trainer(zero1=True).traced_for_analysis(ds)
+             # ZeRO stages 2/3: the in-scan scattered accumulator and
+             # the gather-on-use parameter census (docs/zero1.md).
+             + _mlp_trainer(zero=2).traced_for_analysis(ds)
+             + _mlp_trainer(zero=3).traced_for_analysis(ds)
              # Exchange-layer variants (docs/lowcomm.md): the adasum
              # merge and the local-SGD period whose census pins the
              # 1/H per-step collective-count claim.
@@ -81,9 +94,14 @@ def lm_targets() -> list[TraceSpec]:
     specs = []
     # compress="int8": the error-feedback exchange whose census pins
     # the <= 1/4 gradient-wire-bytes claim (s8 payloads) against the
-    # dp baseline; zero1 x int8 pins the compressed reduce-scatter leg.
-    for kw in ({}, {"zero1": True}, {"fsdp": True},
+    # dp baseline; zero1 x int8 pins the compressed reduce-scatter
+    # leg; zero=2/3 pin the scattered-accumulator and gather-on-use
+    # programs; the codec-rules variant pins the per-bucket wire
+    # dtypes (embeddings top-k, everything else int8).
+    for kw in ({}, {"zero1": True}, {"zero": 2}, {"zero": 3},
+               {"fsdp": True},
                {"compress": "int8"},
+               {"compress": (("emb", "topk"), (".*", "int8"))},
                {"zero1": True, "compress": "int8"}):
         t = dk.LMTrainer(cfg, learning_rate=1e-2, batch_size=8, **kw)
         specs += t.traced_for_analysis()
@@ -121,22 +139,26 @@ def serving_targets() -> list[TraceSpec]:
 
 
 def _pair(specs: list[TraceSpec]) -> list[TraceSpec]:
-    """Attach the declared parity partners to the zero1 specs."""
+    """Attach the declared parity partners (and stage) to the zero
+    specs."""
     names = {s.name for s in specs}
     out = []
     for s in specs:
-        for z1, dp in ZERO1_PARITY_PAIRS:
-            if s.name == z1 and dp in names:
-                s = dataclasses.replace(s, zero1_parity_with=dp)
+        for z, dp, stage in ZERO_PARITY_TARGETS:
+            if s.name == z and dp in names:
+                s = dataclasses.replace(s, zero1_parity_with=dp,
+                                        zero_stage=stage)
         out.append(s)
     return out
 
 
 def default_targets() -> list[TraceSpec]:
-    """Every standard target: both trainer families (DP / zero1 /
-    fsdp) plus both serving engines' decode steps."""
+    """Every standard target: both trainer families (DP / the ZeRO
+    stages / fsdp / the exchange variants) plus both serving engines'
+    decode steps."""
     return adag_targets() + lm_targets() + serving_targets()
 
 
-__all__ = ["ZERO1_PARITY_PAIRS", "adag_targets", "lm_targets",
-           "serving_targets", "default_targets"]
+__all__ = ["ZERO_PARITY_TARGETS", "ZERO1_PARITY_PAIRS",
+           "adag_targets", "lm_targets", "serving_targets",
+           "default_targets"]

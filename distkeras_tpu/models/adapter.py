@@ -259,6 +259,111 @@ class ModelAdapter:
 
         return train_step
 
+    def zero_layout(self, n: int, bucket_mb: float | None = None):
+        """The ZeRO fusion-bucket layout of this model's trainable
+        variables (shapes/dtypes only — nothing materializes).  The ONE
+        geometry the stage-2/3 step builders, the trainers' view
+        conversion, and the sharding plans share, so an accumulator
+        bucket and its optimizer-state mirror can never disagree."""
+        from distkeras_tpu.parallel.collectives import (
+            DEFAULT_BUCKET_MB, Zero1Layout)
+
+        structs = [jax.ShapeDtypeStruct(tuple(v.shape), np.dtype(v.dtype))
+                   for v in self.model.trainable_variables]
+        return Zero1Layout.for_tree(
+            structs, n,
+            DEFAULT_BUCKET_MB if bucket_mb is None else bucket_mb)
+
+    def make_zero_accum_step(self, window: int, mesh, inner,
+                             stage: int, bucket_mb: float | None = None,
+                             probe: bool = False) -> Callable:
+        """The gradient-accumulation step for ZeRO stages 2 and 3
+        (docs/zero1.md): same contract as :meth:`make_accum_train_step`
+        — ``step(state, xs, ys)`` scanning ``window`` microbatches —
+        but the gradient accumulator is the SCATTERED fusion-bucket
+        layout: each microbatch's gradient is packed per bucket and
+        reduce-scattered INTO the accumulation scan (the
+        ``collectives.scatter`` constraint on the carry), so a replica
+        only ever persists its ``1/n`` gradient shard — the PR-2
+        follow-up ("interleave bucket reduce-scatters into the scan")
+        closed.  The update then runs on the shard views directly via
+        ``inner`` (the UNWRAPPED optax transform, whose state the
+        trainers init over shard views).
+
+        Stage 2 keeps parameters replicated and all-gathers the update
+        (RS-per-microbatch + one AG — *less* wire than the per-
+        microbatch all-reduce it replaces).  Stage 3 additionally takes
+        ``state.tv`` as ``[n, cols]`` shard views and re-materializes
+        parameters per fusion bucket just-in-time inside the loss
+        (``collectives.gather_bucket``: all-gather forward, reduce-
+        scatter backward); the update output IS the new view state — no
+        parameter all-gather leg at all.
+        """
+        from distkeras_tpu.parallel.collectives import (all_gather,
+                                                        gather_bucket,
+                                                        scatter)
+
+        if stage not in (2, 3):
+            raise ValueError(f"stage must be 2 or 3, got {stage}")
+        n = int(mesh.shape["data"])
+        layout = self.zero_layout(n, bucket_mb)
+        compute_loss = self.make_loss_fn()
+
+        def loss_of_views(v, ntv, x, y):
+            with jax.named_scope("zero3/param_gather"):
+                buckets = [gather_bucket(b, mesh)
+                           for b in layout.pack_views(v)]
+            return compute_loss(layout.unpack(buckets), ntv, x, y)
+
+        def train_step(state: TrainState, xs, ys):
+            grad_fn = jax.value_and_grad(
+                loss_of_views if stage >= 3 else compute_loss,
+                has_aux=True)
+            scope = ("zero3/grad_accum" if stage >= 3
+                     else "zero2/accum_scatter")
+
+            def micro(carry, batch):
+                bks, ntv, loss_acc = carry
+                x, y = batch
+                (loss, ntv2), g = grad_fn(state.tv, ntv, x, y)
+                g_bks = (layout.pack_views(g) if stage >= 3
+                         else layout.pack(g))
+                with jax.named_scope(scope):
+                    bks = [scatter(a + b, mesh)
+                           for a, b in zip(bks, g_bks)]
+                return (bks, ntv2, loss_acc + loss), None
+
+            (bks, ntv2, loss_sum), _ = jax.lax.scan(
+                micro, (layout.zero_buckets(), state.ntv, jnp.zeros(())),
+                (xs, ys))
+            g_views = layout.views_from_buckets(
+                [b / window for b in bks])
+            p_views = (state.tv if stage >= 3
+                       else layout.shard_views(state.tv))
+            with jax.named_scope(f"zero{stage}/update"):
+                u_views, opt_state = inner.update(
+                    g_views, state.opt_state, p_views)
+            if stage >= 3:
+                tv = jax.tree.map(lambda p, u: p + u, state.tv, u_views)
+            else:
+                with jax.named_scope("zero2/all_gather"):
+                    u_buckets = [all_gather(b, mesh)
+                                 for b in layout.pack_views(u_views)]
+                tv = jax.tree.map(lambda p, u: p + u, state.tv,
+                                  layout.unpack(u_buckets))
+            out_state = TrainState(tv=tv, ntv=ntv2, opt_state=opt_state,
+                                   step=state.step + 1)
+            loss = loss_sum / window
+            if probe:
+                import optax
+
+                return out_state, (loss,
+                                   {"grad_norm": optax.global_norm(
+                                       g_views)})
+            return out_state, loss
+
+        return train_step
+
     def make_localsgd_accum_step(self, window: int, sync_every: int,
                                  mesh, config, axis: str = "data"
                                  ) -> Callable:
@@ -386,14 +491,19 @@ class ModelAdapter:
 
         return window
 
-    def make_indexed_accum_train_step(self, window: int) -> Callable:
+    def make_indexed_accum_train_step(self, window: int,
+                                      accum: Callable | None = None
+                                      ) -> Callable:
         """``make_accum_train_step`` over a device-resident dataset:
         ``step(state, X, Y, idx)`` with ``idx: [window, GB]`` gathers
         each microbatch from the staged ``X``/``Y`` on device, then
         accumulates exactly like the streaming accum step.  The
         distributed trainers' device_data path (per round, only the
-        index block crosses the link; the mesh gathers its own rows)."""
-        accum = self.make_accum_train_step(window)
+        index block crosses the link; the mesh gathers its own rows).
+        ``accum`` overrides the wrapped accumulation step (the ZeRO
+        stage-2/3 trainers pass :meth:`make_zero_accum_step`'s)."""
+        accum = accum if accum is not None \
+            else self.make_accum_train_step(window)
 
         def step(state: TrainState, X, Y, idx):
             if idx.shape[0] != window:

@@ -15,27 +15,173 @@ import optax
 # update rule: the step taken for element i depends only on element i's
 # gradient/moment history (plus replicated scalars like the step count
 # or a global-norm clip factor, which survive sharding as cheap scalar
-# psums).  That property is what makes the ZeRO-1 sharded weight update
-# (parallel/collectives.zero1_optimizer) *math-identical*: slicing the
+# psums).  That property is what makes the ZeRO sharded weight update
+# (parallel/collectives.py, every stage) *math-identical*: slicing the
 # flattened view across replicas commutes with the update.  Transforms
 # that mix elements within a leaf — LARS/LAMB per-layer trust ratios,
 # Shampoo-style preconditioners — are NOT in this set and would
-# silently diverge under zero1.
+# silently diverge under a sharded update.
 ZERO1_ELEMENTWISE = frozenset(
     {"sgd", "adam", "adamw", "nadam", "adagrad", "adadelta", "rmsprop"})
 
+# optax factory names whose transforms are per-leaf elementwise (plus
+# replicated scalars): prebuilt transforms built ONLY from these are
+# recognized safe at trainer construction, so e.g. a bare
+# ``optax.adam(1e-3)`` no longer draws the can't-verify warning.
+_ELEMENTWISE_FACTORIES = frozenset({
+    "chain", "named_chain", "masked", "flatten", "identity",
+    "with_extra_args_support",
+    "scale", "scale_by_learning_rate", "scale_by_schedule",
+    "inject_hyperparams",
+    "scale_by_adam", "scale_by_amsgrad", "scale_by_adamax",
+    "scale_by_lion", "scale_by_rms", "scale_by_stddev", "scale_by_rss",
+    "scale_by_belief", "scale_by_yogi", "scale_by_radam",
+    "scale_by_adadelta", "scale_by_optimistic_gradient",
+    "add_decayed_weights", "trace", "ema", "clip",
+    "clip_by_global_norm", "zero_nans", "keep_params_nonnegative",
+    "apply_every", "add_noise",
+})
+
+# optax factory names KNOWN to mix elements within a leaf (per-layer
+# trust ratios, full-matrix/ blocked preconditioners, sign-of-sum
+# tricks over the leaf).  A prebuilt transform containing one raises at
+# trainer construction, naming it (parallel/collectives.zero_validate).
+_NON_ELEMENTWISE_FACTORIES = frozenset({
+    "scale_by_trust_ratio",          # LARS / LAMB
+    "normalize_by_update_norm",
+    "scale_by_sm3", "scale_by_novograd",
+    "scale_by_distance_over_gradients",
+})
+
+
+def _factory_names(transform):
+    """Best-effort build recipe of a prebuilt transform, as
+    ``(factory names, opaque)``: optax factories return closures whose
+    ``__qualname__`` is ``"<factory>.<locals>.<fn>"``, and combinators
+    (``chain``, ``masked``, the aliases) close over the inner
+    transforms' init/update closures — so walking the closure graph
+    and collecting the qualname roots recovers the recipe.  ``opaque``
+    is True when ANY reachable piece is not a recognizable optax-style
+    closure (a module-level function, a non-optax factory, a truncated
+    walk) — the caller must then never conclude "safe", only "unsafe"
+    (a known-bad name was still found) or "uninspectable".  Returns
+    None when even the top-level init/update are unrecognizable."""
+    names: set[str] = set()
+    opaque = False
+    seen: set[int] = set()
+    stack = [getattr(transform, "init", None),
+             getattr(transform, "update", None)]
+    if not all(callable(f) for f in stack):
+        return None
+    for fn in stack:
+        if ".<locals>." not in getattr(fn, "__qualname__", ""):
+            return None  # top level unrecognizable: nothing to walk
+    def classify(fn):
+        """Push a recipe callable, or flip `opaque` if it did not come
+        out of optax — a user-written init/update (module-level or
+        closure) is exactly the thing the recipe cannot vouch for.
+        optax's own module-level helpers (``init_empty_state`` et al.)
+        are inert and stay silent."""
+        nonlocal opaque
+        mod = getattr(fn, "__module__", "") or ""
+        if not mod.startswith("optax"):
+            # Keep walking its closure anyway: it may still wrap a
+            # known-bad optax transform worth naming.
+            opaque = True
+        stack.append(fn)
+
+    while stack:
+        fn = stack.pop()
+        if id(fn) in seen:
+            continue
+        if len(seen) > 256:  # runaway graph: partial recipe only
+            opaque = True
+            break
+        seen.add(id(fn))
+        qual = getattr(fn, "__qualname__", "")
+        mod = getattr(fn, "__module__", "") or ""
+        if ".<locals>." in qual and mod.startswith("optax"):
+            names.add(qual.split(".", 1)[0])
+        for cell in getattr(fn, "__closure__", None) or ():
+            try:
+                val = cell.cell_contents
+            except ValueError:  # pragma: no cover - empty cell
+                continue
+            in_tuple = isinstance(val, (tuple, list))
+            vals = list(val) if in_tuple else [val]
+            for v in vals:
+                if hasattr(v, "init") and hasattr(v, "update") \
+                        and callable(getattr(v, "init", None)) \
+                        and callable(getattr(v, "update", None)):
+                    # A nested transform object (masked, wrappers):
+                    # BOTH halves must be recognizable factory
+                    # closures or the recipe is opaque (classify
+                    # flips the flag; the old code silently skipped
+                    # them and could conclude "safe" around an
+                    # uninspectable inner update).
+                    classify(v.init)
+                    classify(v.update)
+                elif callable(v) and in_tuple:
+                    # A tuple of callables in a combinator closure IS
+                    # the inner transforms' init/update halves (optax
+                    # chain closes over `init_fns`/`update_fns`) —
+                    # every member must be recognizable or the recipe
+                    # is opaque.
+                    classify(v)
+                elif callable(v) and ".<locals>." in getattr(
+                        v, "__qualname__", ""):
+                    # Singleton helper closures (schedules etc.): walk
+                    # them for names; module-level helpers are inert.
+                    stack.append(v)
+    return names, opaque
+
+
+def zero1_offender(spec) -> str | None:
+    """The name of the known non-elementwise optax transform inside
+    ``spec``, or None — what :func:`~distkeras_tpu.parallel.
+    collectives.zero_validate` puts in its construction-time error so
+    the failure is attributable instead of a silent divergence inside
+    the scattered update."""
+    if isinstance(spec, str):
+        return None
+    try:
+        recipe = _factory_names(spec)
+    except Exception:  # pragma: no cover - defensive
+        return None
+    if recipe is None:
+        return None
+    names, _opaque = recipe
+    bad = sorted(names & _NON_ELEMENTWISE_FACTORIES)
+    return bad[0] if bad else None
+
 
 def zero1_compatible(spec) -> bool | None:
-    """Whether ``spec`` is known-safe under the ZeRO-1 sharded update.
+    """Whether ``spec`` is known-safe under the ZeRO sharded update
+    (stages 1/2/3 share the elementwise requirement).
 
     Returns ``True`` for resolvable names in :data:`ZERO1_ELEMENTWISE`
-    (all of them today), ``False`` for known-unsafe names (none yet),
-    and ``None`` for anything this module cannot inspect — a prebuilt
-    optax transform — meaning "caller must vouch for elementwise
-    update math" (the trainers warn).
+    and for prebuilt optax transforms assembled only from recognized
+    elementwise factories; ``False`` for known-unsafe specs — an
+    unresolvable name, or a prebuilt transform containing a factory in
+    the non-elementwise set (``zero1_offender`` names it); ``None``
+    for anything this module cannot inspect, meaning "caller must
+    vouch for elementwise update math" (the trainers warn).
     """
     if isinstance(spec, str):
         return spec.lower() in ZERO1_ELEMENTWISE
+    try:
+        recipe = _factory_names(spec)
+    except Exception:  # pragma: no cover - defensive
+        return None
+    if recipe is None:
+        return None
+    names, opaque = recipe
+    if names & _NON_ELEMENTWISE_FACTORIES:
+        return False           # known-bad beats opaque: name it
+    if opaque or not names:
+        return None            # any unattributable piece: never "safe"
+    if names <= _ELEMENTWISE_FACTORIES:
+        return True
     return None
 
 

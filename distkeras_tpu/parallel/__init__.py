@@ -1,13 +1,17 @@
-from distkeras_tpu.parallel import collectives
+from distkeras_tpu.parallel import collectives, rules
 from distkeras_tpu.parallel.collectives import (Zero1Layout, all_gather,
+                                                 gather_bucket,
                                                  reduce_scatter,
                                                  zero1_optimizer)
 from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh, local_device_count
+from distkeras_tpu.parallel.rules import match_partition_rules, match_rules
 from distkeras_tpu.parallel.sharding import (ShardingPlan, Zero1Plan,
-                                              dp_plan, fsdp_plan, tp_plan,
-                                              zero1_plan)
+                                              Zero3Plan, dp_plan, fsdp_plan,
+                                              tp_plan, zero1_plan,
+                                              zero3_plan)
 
 __all__ = ["MeshSpec", "make_mesh", "local_device_count", "ShardingPlan",
-           "dp_plan", "fsdp_plan", "tp_plan", "zero1_plan", "Zero1Plan",
-           "collectives", "Zero1Layout", "reduce_scatter", "all_gather",
-           "zero1_optimizer"]
+           "dp_plan", "fsdp_plan", "tp_plan", "zero1_plan", "zero3_plan",
+           "Zero1Plan", "Zero3Plan", "collectives", "rules", "Zero1Layout",
+           "reduce_scatter", "all_gather", "gather_bucket",
+           "zero1_optimizer", "match_partition_rules", "match_rules"]

@@ -58,6 +58,7 @@ compressing exactly the reduce-scatter leg of this module's exchange
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Sequence
 
@@ -101,23 +102,42 @@ class Zero1Layout:
     slots: tuple[_Slot, ...]         # in leaf order
     bucket_cols: tuple[int, ...]     # column count per bucket
     bucket_dtypes: tuple[Any, ...]
+    # Per-bucket group key (all None without `groups=`): the exchange
+    # layer's per-bucket codec choice buckets by (dtype, group) so a
+    # bucket is always codec-homogeneous (parallel/exchange.py).
+    bucket_groups: tuple[Any, ...] = ()
 
     @classmethod
     def for_tree(cls, tree, n: int,
-                 bucket_mb: float = DEFAULT_BUCKET_MB) -> "Zero1Layout":
+                 bucket_mb: float = DEFAULT_BUCKET_MB,
+                 groups=None) -> "Zero1Layout":
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         if n < 1:
             raise ValueError(f"axis size must be >= 1, got {n}")
-        # Group by dtype (buckets concatenate, so they must be
-        # homogeneous), then fill ~bucket_mb buckets in leaf order.
+        if groups is None:
+            group_of = [None] * len(leaves)
+        else:
+            group_of = jax.tree_util.tree_leaves(
+                groups, is_leaf=lambda x: x is None)
+            if len(group_of) != len(leaves):
+                raise ValueError(
+                    f"groups carries {len(group_of)} entries for "
+                    f"{len(leaves)} leaves")
+        # Group by (dtype, group) — buckets concatenate, so they must
+        # be dtype-homogeneous, and a group key (e.g. a codec) must
+        # never straddle a bucket — then fill ~bucket_mb buckets in
+        # leaf order.  With no groups this is exactly the historical
+        # dtype-only bucketing, bit-for-bit.
         order = list(range(len(leaves)))
-        by_dtype: dict[Any, list[int]] = {}
+        by_key: dict[Any, list[int]] = {}
         for i in order:
-            by_dtype.setdefault(np.dtype(leaves[i].dtype), []).append(i)
+            by_key.setdefault((np.dtype(leaves[i].dtype), group_of[i]),
+                              []).append(i)
         slots: list[_Slot | None] = [None] * len(leaves)
         bucket_cols: list[int] = []
         bucket_dtypes: list[Any] = []
-        for dtype, idxs in by_dtype.items():
+        bucket_groups: list[Any] = []
+        for (dtype, group), idxs in by_key.items():
             budget = max(1, int(bucket_mb * 2 ** 20 / dtype.itemsize))
             cur_cols, cur_bucket = 0, -1
             for i in idxs:
@@ -126,6 +146,7 @@ class Zero1Layout:
                 if cur_bucket < 0 or cur_cols * n + cols * n > budget:
                     bucket_cols.append(0)
                     bucket_dtypes.append(dtype)
+                    bucket_groups.append(group)
                     cur_bucket = len(bucket_cols) - 1
                     cur_cols = 0
                 slots[i] = _Slot(shape=tuple(leaves[i].shape), dtype=dtype,
@@ -136,7 +157,8 @@ class Zero1Layout:
                 bucket_cols[cur_bucket] = cur_cols
         return cls(n=n, treedef=treedef, slots=tuple(slots),
                    bucket_cols=tuple(bucket_cols),
-                   bucket_dtypes=tuple(bucket_dtypes))
+                   bucket_dtypes=tuple(bucket_dtypes),
+                   bucket_groups=tuple(bucket_groups))
 
     # ------------------------------------------------------------ views
 
@@ -197,6 +219,13 @@ class Zero1Layout:
         views = [buckets[s.bucket][:, s.offset:s.offset + s.cols]
                  for s in self.slots]
         return self.treedef.unflatten(views)
+
+    def zero_buckets(self) -> list:
+        """Fresh all-zero buckets in this layout — the ZeRO-2/3 step
+        builders' gradient accumulator carry (kept scattered by a
+        :func:`scatter` constraint per microbatch add)."""
+        return [jnp.zeros((self.n, c), d)
+                for c, d in zip(self.bucket_cols, self.bucket_dtypes)]
 
     def unpack(self, buckets: Sequence):
         """Bucket list -> pytree of original leaf shapes (drop pad)."""
@@ -271,6 +300,50 @@ def all_gather(x, mesh: Mesh, axis: str = "data"):
                      out_specs=P(None, None), check_vma=False)(x)
 
 
+def _replicate(x, mesh: Mesh):
+    """Jit-native all-gather of a scattered ``[n, C]`` bucket: constrain
+    it to replicated so GSPMD materializes every row on every replica.
+    Outside a trace it is the identity (eager sharded arrays gather on
+    read)."""
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, None)))
+    return x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_bucket(x, mesh: Mesh, axis: str = "data"):
+    """The ZeRO-3 gather-on-use primitive: forward re-materializes a
+    scattered ``[n, C]`` parameter bucket on every replica (an
+    all-gather under GSPMD), and the BACKWARD scatters the cotangent
+    back to ``P(axis, None)`` — a reduce-scatter of the gradient, one
+    per fusion bucket.
+
+    The custom vjp is the point: ``with_sharding_constraint``'s own
+    transpose would pin the cotangent replicated (forcing a full
+    gradient all-reduce and a replicated gradient buffer); here the
+    gradient of a gathered parameter only ever materializes as the
+    ``1/n`` shard each replica owns.  Scopes ``zero3/param_gather`` /
+    ``zero3/grad_scatter`` tag both legs for the declared-exchange
+    parity proof (analysis/ir_lint.py) and profiler timelines.
+    """
+    with jax.named_scope("zero3/param_gather"):
+        return _replicate(x, mesh)
+
+
+def _gather_bucket_fwd(x, mesh, axis):
+    with jax.named_scope("zero3/param_gather"):
+        return _replicate(x, mesh), None
+
+
+def _gather_bucket_bwd(mesh, axis, _, ct):
+    with jax.named_scope("zero3/grad_scatter"):
+        return (scatter(ct, mesh, axis),)
+
+
+gather_bucket.defvjp(_gather_bucket_fwd, _gather_bucket_bwd)
+
+
 def adasum_reduce(x, mesh: Mesh, axis: str = "data"):
     """Adasum merge primitive (shard_map): ``[n, C]`` whose *rows are
     per-replica addends* (the :func:`reduce_scatter` contract) ->
@@ -302,39 +375,59 @@ def adasum_reduce(x, mesh: Mesh, axis: str = "data"):
 # ------------------------------------------------------------ the wrapper
 
 
-def zero1_validate(mesh: Mesh, spec, axis: str = "data") -> None:
-    """The zero1 enablement checks, shared by :func:`zero1_enable` and
-    the exchange layer's zero1+int8 composition
-    (``parallel/exchange.py``): pure-``axis`` mesh, and an optimizer
-    whose update rule is per-leaf elementwise
-    (``ops.optimizers.zero1_compatible`` — known-unsafe raises,
-    uninspectable warns)."""
+def zero_validate(mesh: Mesh, spec, axis: str = "data",
+                  stage: int = 1) -> None:
+    """The ZeRO enablement checks, run at TRAINER CONSTRUCTION for
+    every stage (1/2/3) by both trainer families, and by the exchange
+    layer's zero1+int8 composition (``parallel/exchange.py``):
+
+    * pure-``axis`` mesh — every stage here shards the update (and, at
+      stage 3, the parameters) of an otherwise *replicated* layout;
+    * an optimizer whose update rule is per-leaf elementwise
+      (``ops.optimizers.zero1_compatible``).  A known-unsafe transform
+      raises HERE, naming the offending optax transform (e.g.
+      ``scale_by_trust_ratio`` inside a LAMB chain), instead of
+      training to silently-diverged weights inside the scattered
+      update; an uninspectable transform warns.
+    """
+    knob = f"zero={stage}" if stage != 1 else "zero1=True"
     for ax, size in mesh.shape.items():
         if ax != axis and int(size) > 1:
             raise ValueError(
-                f"zero1=True composes with the {axis} axis only, but the "
-                f"mesh has {ax}={int(size)}; zero1 shards the update of "
-                "*replicated* parameters — use fsdp/TP plans when the "
-                "parameters themselves shard")
-    from distkeras_tpu.ops.optimizers import zero1_compatible
+                f"{knob} composes with the {axis} axis only, but the "
+                f"mesh has {ax}={int(size)}; the ZeRO stages shard the "
+                "update of *replicated* parameters — use fsdp/TP plans "
+                "when a rule-driven parameter layout is wanted instead")
+    from distkeras_tpu.ops.optimizers import (zero1_compatible,
+                                              zero1_offender)
 
     compat = zero1_compatible(spec)
     if compat is False:
+        offender = zero1_offender(spec)
         raise ValueError(
-            f"optimizer {spec!r} is known-incompatible with the zero1 "
-            "sharded update (its update rule mixes elements within a "
-            "leaf, so sharding changes the math); train it replicated "
-            "or under fsdp")
+            f"optimizer {spec!r} is known-incompatible with the ZeRO "
+            "sharded update"
+            + (f": transform {offender!r} mixes elements within a leaf"
+               if offender else
+               " (its update rule mixes elements within a leaf)")
+            + ", so sharding changes the math; train it replicated or "
+            "under fsdp")
     if compat is None:
         import warnings
 
         warnings.warn(
-            "zero1=True with a prebuilt/factory optax optimizer that "
+            f"{knob} with a prebuilt/factory optax optimizer that "
             "cannot be verified elementwise: the sharded update is "
             "math-identical only for per-leaf elementwise update rules; "
             "transforms mixing elements within a leaf (LARS/LAMB trust "
             "ratios, Shampoo preconditioners) will silently diverge",
             stacklevel=3)
+
+
+def zero1_validate(mesh: Mesh, spec, axis: str = "data") -> None:
+    """Stage-1 spelling of :func:`zero_validate` (kept: the exchange
+    layer and older call sites name it)."""
+    zero_validate(mesh, spec, axis=axis, stage=1)
 
 
 def zero1_optimizer(inner: optax.GradientTransformation, mesh: Mesh,
@@ -415,19 +508,26 @@ def zero1_optimizer(inner: optax.GradientTransformation, mesh: Mesh,
 
 def zero1_enable(inner: optax.GradientTransformation, mesh: Mesh,
                  spec=None, bucket_mb: float | None = None,
-                 axis: str = "data") -> optax.GradientTransformation:
-    """Validate a trainer's zero1 configuration and return the wrapped
+                 axis: str = "data",
+                 stage: int = 1) -> optax.GradientTransformation:
+    """Validate a trainer's ZeRO configuration and return the wrapped
     optimizer — the ONE enablement path both trainer families share
-    (``DistributedTrainer`` and ``LMTrainer``).
+    for every stage that wraps (``DistributedTrainer`` stages 1/2/3 —
+    stages 2/3 consume only the wrapper's shard-view ``init`` and
+    drive the raw inner from the step — and ``LMTrainer`` stage 1;
+    LMTrainer stages 2/3 init over views directly and call
+    :func:`zero_validate` alone).
 
-    * Rejects meshes with any non-``axis`` dimension > 1: zero1 shards
-      the update of *replicated* parameters; sharded-parameter layouts
-      belong to fsdp/TP plans.
+    * Rejects meshes with any non-``axis`` dimension > 1: the ZeRO
+      stages shard the update/state of *replicated* parameter layouts;
+      rule-driven sharded-parameter layouts belong to fsdp/TP plans.
     * Checks ``spec`` (the user's optimizer spec, a name string or a
       prebuilt transform) against ``ops.optimizers.zero1_compatible``:
-      known-unsafe raises, uninspectable warns.
+      known-unsafe raises naming the offending transform,
+      uninspectable warns.
     """
-    zero1_validate(mesh, spec if spec is not None else inner, axis=axis)
+    zero_validate(mesh, spec if spec is not None else inner, axis=axis,
+                  stage=stage)
     return zero1_optimizer(
         inner, mesh, axis=axis,
         bucket_mb=DEFAULT_BUCKET_MB if bucket_mb is None else bucket_mb)
@@ -442,30 +542,28 @@ def zero1_shard_shapes(params, n: int) -> frozenset:
 
 def zero1_state_shardings(params, opt_state, mesh: Mesh,
                           axis: str = "data"):
-    """Sharding tree for a ZeRO-1 optimizer state: leaves whose shape
-    is one of ``params``' shard-view shapes go ``P(axis, None)``;
-    everything else replicates.
+    """Sharding tree for a ZeRO optimizer state (every stage): leaves
+    whose shape is one of ``params``' shard-view shapes go
+    ``P(axis, None)``; everything else replicates.
 
     The rule is by *shape*, structure-agnostic on purpose: it covers
     moments nested inside chains, masks, and EMA shadows uniformly —
-    under zero1 the inner optimizer only ever sees shard views, so
-    every params-mirroring leaf it creates has a shard-view shape, and
-    the remaining leaves are scalar counts.  The ONE definition both
-    trainer families' sharding rules share (``sharding.Zero1Plan`` and
-    ``LMTrainer._state_shardings``).  ``opt_state`` may be real arrays
-    or an ``eval_shape`` tree.
+    under a sharded update the inner optimizer only ever sees shard
+    views, so every params-mirroring leaf it creates has a shard-view
+    shape, and the remaining leaves are scalar counts.  Since the
+    ZeRO-2/3 round it is expressed through the ONE regex rule engine
+    (``parallel/rules.py``: the shape-keyed :func:`~distkeras_tpu.
+    parallel.rules.shard_view_rule` ahead of a replicate-everything
+    catch-all), the same ordered-rules form every other plan takes.
+    ``opt_state`` may be real arrays or an ``eval_shape`` tree.
     """
-    shard_shapes = zero1_shard_shapes(params, int(mesh.shape[axis]))
-    rep = NamedSharding(mesh, P())
-    sh = NamedSharding(mesh, P(axis, None))
-    return jax.tree.map(
-        lambda x: sh if (hasattr(x, "shape")
-                         and tuple(x.shape) in shard_shapes) else rep,
-        opt_state)
+    from distkeras_tpu.parallel.rules import zero_state_shardings
+
+    return zero_state_shardings(params, opt_state, mesh, axis=axis)
 
 
 __all__ = ["Zero1Layout", "scatter", "reduce_scatter", "all_gather",
-           "adasum_reduce", "zero1_optimizer", "zero1_enable",
-           "zero1_validate",
+           "gather_bucket", "adasum_reduce", "zero1_optimizer",
+           "zero1_enable", "zero1_validate", "zero_validate",
            "zero1_shard_shapes", "zero1_state_shardings",
            "DEFAULT_BUCKET_MB"]

@@ -48,7 +48,7 @@ when local-SGD is safe.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+from typing import Any, Sequence
 
 import flax.struct
 import jax
@@ -75,20 +75,29 @@ class ExchangeConfig:
 
     ``merge_rule``: "mean" (the baseline semantics) or "adasum".
     ``sync_every``: local-SGD period H (1 = sync every step).
-    ``compress``: None, "int8" (error-feedback symmetric int8) or
-    "topk" (error-feedback magnitude top-k, ``topk_frac`` of each
-    bucket).  ``bucket_mb`` sizes the fusion buckets (same knob as
-    ZeRO-1).
+    ``compress``: None, "int8" (error-feedback symmetric int8), "topk"
+    (error-feedback magnitude top-k, ``topk_frac`` of each bucket) —
+    or an ordered sequence of ``(regex, codec)`` RULES resolved per
+    parameter leaf by the shared rule engine (``parallel/rules.py``,
+    first-match-wins over flattened key paths / Keras variable paths;
+    an unmatched leaf raises naming it).  Under rules the fusion
+    buckets group by (dtype, codec) so every bucket is
+    codec-homogeneous — e.g. ``[("emb", "topk"), (".*", "int8")]``
+    sends embedding gradients top-k and dense gradients int8, and the
+    compiled census pins each bucket's wire dtype separately.
+    ``bucket_mb`` sizes the fusion buckets (same knob as ZeRO-1).
 
     Composition limits (raise here, not deep in a trace):
     ``compress`` requires ``merge_rule="mean"`` (the codecs implement a
     compressed *sum*; Adasum needs the uncompressed stacks) and
     ``sync_every=1`` (local-SGD exchanges parameters, not gradients).
+    Codec RULES do not compose with the ZeRO stages (only the uniform
+    ``"int8"`` codec has a chunked compressed-reduce-scatter form).
     """
 
     merge_rule: str = "mean"
     sync_every: int = 1
-    compress: str | None = None
+    compress: str | tuple | None = None
     topk_frac: float = 0.01
     bucket_mb: float = DEFAULT_BUCKET_MB
 
@@ -97,10 +106,32 @@ class ExchangeConfig:
             raise ValueError(
                 f"merge_rule must be one of {_MERGE_RULES}, got "
                 f"{self.merge_rule!r}")
-        if self.compress not in _CODECS:
+        if isinstance(self.compress, (list, tuple)):
+            import re
+
+            rules = []
+            for entry in self.compress:
+                try:
+                    pat, codec = entry
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        "compress rules must be (pattern, codec) "
+                        f"pairs, got {entry!r}")
+                if codec not in ("int8", "topk"):
+                    raise ValueError(
+                        f"compress rule {pat!r} names codec {codec!r}; "
+                        "known codecs: 'int8', 'topk'")
+                re.compile(pat)  # typos raise here, not mid-trace
+                rules.append((str(pat), str(codec)))
+            if not rules:
+                raise ValueError(
+                    "compress=[] is ambiguous: pass None for no codec "
+                    "or at least one (pattern, codec) rule")
+            object.__setattr__(self, "compress", tuple(rules))
+        elif self.compress not in _CODECS:
             raise ValueError(
-                f"compress must be one of {_CODECS}, got "
-                f"{self.compress!r}")
+                f"compress must be one of {_CODECS} or a sequence of "
+                f"(regex, codec) rules, got {self.compress!r}")
         if self.sync_every < 1:
             raise ValueError(
                 f"sync_every must be >= 1, got {self.sync_every}")
@@ -133,13 +164,21 @@ class ExchangeConfig:
         """Per-step gradient merging (vs local-SGD's parameter sync)."""
         return not self.is_default and self.sync_every == 1
 
+    @property
+    def codec_rules(self) -> tuple | None:
+        """The (pattern, codec) rules when ``compress`` is rule-based,
+        else None."""
+        return self.compress if isinstance(self.compress, tuple) else None
+
     def label(self) -> str:
         parts = []
         if self.merge_rule != "mean":
             parts.append(self.merge_rule)
         if self.sync_every > 1:
             parts.append(f"localsgd{self.sync_every}")
-        if self.compress:
+        if self.codec_rules is not None:
+            parts.append("rulesef")
+        elif self.compress:
             parts.append(f"{self.compress}ef")
         return "_".join(parts) or "mean"
 
@@ -303,18 +342,78 @@ def _unstacked_struct(stacked):
         stacked)
 
 
+def resolve_codecs(rules: Sequence, tree, names=None):
+    """Per-leaf codec tree from ordered ``(pattern, codec)`` rules via
+    the shared rule engine (``parallel/rules.py``): first-match-wins
+    over the flattened key paths — or over ``names``, a same-structure
+    tree of explicit leaf names (the Keras trainers pass their variable
+    paths so rules read ``"dense_1/kernel"``-style, not list indices).
+    An unmatched leaf raises, naming it."""
+    from distkeras_tpu.parallel.rules import (UnmatchedLeafError,
+                                              compile_rules, first_match,
+                                              match_rules)
+
+    if names is None:
+        return match_rules(list(rules), tree, what="codec")
+    compiled = compile_rules(list(rules))
+
+    def of(name):
+        matched, codec = first_match(compiled, str(name))
+        if not matched:
+            raise UnmatchedLeafError(str(name), "codec")
+        return codec
+
+    return jax.tree.map(of, names)
+
+
+def exchange_layout(tree, n: int, config: ExchangeConfig, names=None
+                    ) -> Zero1Layout:
+    """The fusion-bucket layout one exchange policy uses for ``tree``:
+    the plain ZeRO-1 layout, except under codec RULES the buckets
+    additionally group by resolved codec (``Zero1Layout`` groups=), so
+    each bucket is codec-homogeneous and ``bucket_groups[i]`` IS bucket
+    i's codec."""
+    if config.codec_rules is None:
+        return Zero1Layout.for_tree(tree, n, config.bucket_mb)
+    codecs = resolve_codecs(config.codec_rules, tree, names=names)
+    return Zero1Layout.for_tree(tree, n, config.bucket_mb,
+                                groups=codecs)
+
+
+def _bucket_codecs(layout: Zero1Layout, config: ExchangeConfig) -> list:
+    """Bucket index -> codec (or None): the rule-resolved group key
+    under codec rules, the uniform ``compress`` otherwise."""
+    if config.codec_rules is not None:
+        return list(layout.bucket_groups)
+    return [config.compress] * len(layout.bucket_cols)
+
+
+def _e2_slots(layout: Zero1Layout, config: ExchangeConfig,
+              zero1: bool) -> dict:
+    """bucket index -> slot in the ``e2`` residual list.  Only int8
+    buckets outside zero1 carry a phase-2 re-quantization residual —
+    a top-k bucket in a mixed-rules layout gets NO slot (an aligned
+    zero buffer would persist bucket-sized dead f32 in the optimizer
+    state, donated and resharded every step)."""
+    if zero1:
+        return {}
+    codecs = _bucket_codecs(layout, config)
+    return {i: k for k, i in enumerate(
+        j for j, c in enumerate(codecs) if c == "int8")}
+
+
 def _residual_shapes(layout: Zero1Layout, config: ExchangeConfig,
                      zero1: bool):
-    """(e1 shapes, e2 shapes) — global, per bucket — for one layout."""
+    """(e1 shapes, e2 shapes) — global — for one layout.  ``e1``
+    exists per bucket for every codec'd bucket; ``e2`` per int8 bucket
+    only (see :func:`_e2_slots`)."""
     n = layout.n
-    if config.compress == "int8":
-        e1 = [(n, n, c) for c in layout.bucket_cols]
-        e2 = [] if zero1 else [(n, c) for c in layout.bucket_cols]
-    elif config.compress == "topk":
-        e1 = [(n, n, c) for c in layout.bucket_cols]
-        e2 = []
-    else:
-        e1, e2 = [], []
+    codecs = _bucket_codecs(layout, config)
+    if not any(codecs):
+        return [], []
+    e1 = [(n, n, c) for c in layout.bucket_cols]
+    e2 = [(n, layout.bucket_cols[i])
+          for i in sorted(_e2_slots(layout, config, zero1))]
     return e1, e2
 
 
@@ -346,18 +445,20 @@ def wire_bytes(layout: Zero1Layout, config: ExchangeConfig,
                                 layout.bucket_dtypes)]
     ar_legs = 1 if zero1 else 2
     f32_bytes = int(sum(ar_legs * ring * p for p in payloads))
-    if config.compress == "int8":
-        legs = 1 if zero1 else 2
-        wire = int(sum(legs * ring * (c * n + 4 * n)
-                       for c in layout.bucket_cols))
-    elif config.compress == "topk":
-        wire = int(sum(ring * 8 * topk_k(config, c, n) * n
-                       for c in layout.bucket_cols))
-    elif config.merge_rule == "adasum":
-        wire = int(sum(ring * n * p for p in payloads))
-    else:
-        wire = f32_bytes
-    return f32_bytes, wire
+    codecs = _bucket_codecs(layout, config)
+    wire = 0.0
+    for cols, payload, codec in zip(layout.bucket_cols, payloads,
+                                    codecs):
+        if codec == "int8":
+            legs = 1 if zero1 else 2
+            wire += legs * ring * (cols * n + 4 * n)
+        elif codec == "topk":
+            wire += ring * 8 * topk_k(config, cols, n) * n
+        elif config.merge_rule == "adasum":
+            wire += ring * n * payload
+        else:
+            wire += ar_legs * ring * payload
+    return f32_bytes, int(wire)
 
 
 def _record_geometry(layout: Zero1Layout, config: ExchangeConfig,
@@ -375,14 +476,17 @@ def _record_geometry(layout: Zero1Layout, config: ExchangeConfig,
     obs.gauge("exchange.compression_ratio",
               f32_bytes / max(wire, 1))
     obs.gauge("exchange.sync_every", config.sync_every)
+    codecs = _bucket_codecs(layout, config)
     obs.event("exchange.geometry", merge_rule=config.merge_rule,
-              codec=config.compress or "none", zero1=zero1,
-              buckets=len(layout.bucket_cols))
+              codec=("rules" if config.codec_rules is not None
+                     else config.compress or "none"), zero1=zero1,
+              buckets=len(layout.bucket_cols),
+              bucket_codecs=",".join(str(c) for c in codecs))
 
 
 def exchange_optimizer(inner: optax.GradientTransformation, mesh: Mesh,
                        config: ExchangeConfig, axis: str = "data",
-                       zero1: bool = False
+                       zero1: bool = False, names=None
                        ) -> optax.GradientTransformation:
     """Wrap ``inner`` so its ``update`` takes STACKED LOCAL gradients
     (leading replica axis, sharded ``P(axis)``) and performs the
@@ -396,6 +500,13 @@ def exchange_optimizer(inner: optax.GradientTransformation, mesh: Mesh,
     ZeRO-1 layout), and the f32 *update* is all-gathered — the
     "compress the reduce-scatter leg" composition.
 
+    Under codec RULES (``config.compress`` a ``(pattern, codec)``
+    sequence) each fusion bucket runs the codec its leaves resolved to;
+    ``names`` optionally names the leaves for the rules (a tree of
+    strings matching the parameter structure — the Keras trainers pass
+    their variable paths; by default the flattened key paths name
+    them).
+
     The returned transform's ``init`` takes the plain (un-stacked)
     parameter tree, like any optax transform.
     """
@@ -403,11 +514,14 @@ def exchange_optimizer(inner: optax.GradientTransformation, mesh: Mesh,
     if zero1 and config.compress != "int8":
         raise ValueError(
             "zero1 composes with compress='int8' only (the chunked "
-            "two-phase codec IS a compressed reduce-scatter; adasum "
-            "and top-k merge whole buckets)")
+            "two-phase codec IS a compressed reduce-scatter; adasum, "
+            "top-k and per-bucket codec rules merge whole buckets)")
+
+    def layout_for(tree) -> Zero1Layout:
+        return exchange_layout(tree, n, config, names=names)
 
     def init(params):
-        layout = Zero1Layout.for_tree(params, n, config.bucket_mb)
+        layout = layout_for(params)
         inner_state = inner.init(layout.shard_views(params) if zero1
                                  else params)
         e1_s, e2_s = _residual_shapes(layout, config, zero1)
@@ -420,6 +534,8 @@ def exchange_optimizer(inner: optax.GradientTransformation, mesh: Mesh,
     def _merge(stacked, ex: ExchangeState, layout: Zero1Layout):
         """shard_map over ``axis``: local grads -> merged grads (full
         tree, or scattered buckets under zero1) + new residuals."""
+        codecs = _bucket_codecs(layout, config)
+        e2_slot = _e2_slots(layout, config, zero1)
 
         def body(stacked_local, e1, e2):
             g = jax.tree.map(lambda v: jnp.squeeze(v, axis=0),
@@ -429,13 +545,15 @@ def exchange_optimizer(inner: optax.GradientTransformation, mesh: Mesh,
             e2 = [jnp.squeeze(e, axis=0) for e in e2]
             merged, e1_new, e2_new = [], [], []
             for i, b in enumerate(buckets):
-                if config.compress == "int8":
+                if codecs[i] == "int8":
                     m, r1, r2 = _merge_bucket_int8(
-                        b, e1[i], e2[i] if e2 else 0.0, axis, n, zero1)
+                        b, e1[i],
+                        e2[e2_slot[i]] if i in e2_slot else 0.0,
+                        axis, n, zero1)
                     e1_new.append(r1)
-                    if not zero1:
+                    if i in e2_slot:  # appended in slot order
                         e2_new.append(r2)
-                elif config.compress == "topk":
+                elif codecs[i] == "topk":
                     k = topk_k(config, layout.bucket_cols[i], n)
                     m, r1 = _merge_bucket_topk(b, e1[i], axis, n, k)
                     e1_new.append(r1)
@@ -470,8 +588,7 @@ def exchange_optimizer(inner: optax.GradientTransformation, mesh: Mesh,
 
     def update(stacked_grads, state, params=None, **kw):
         inner_state, ex = state
-        layout = Zero1Layout.for_tree(_unstacked_struct(stacked_grads),
-                                      n, config.bucket_mb)
+        layout = layout_for(_unstacked_struct(stacked_grads))
         _record_geometry(layout, config, zero1)
         with jax.named_scope("exchange/merge"):
             merged, e1, e2, norm = _merge(stacked_grads, ex, layout)
@@ -504,30 +621,36 @@ def exchange_state_shardings(params, opt_state, mesh: Mesh,
                              axis: str = "data", zero1: bool = False):
     """Sharding tree for an :func:`exchange_optimizer` state: residual
     leaves shard over their leading replica axis, zero1 shard views
-    (when composed) take the ZeRO-1 rule, everything else replicates.
+    (when composed) take the ZeRO shard-view rule, everything else
+    replicates.  Since the ZeRO-2/3 round the policy is ordered rules
+    resolved by the shared engine (``parallel/rules.py``) — the path-
+    keyed ``e1``/``e2`` residual rules inside the located
+    :class:`ExchangeState`, the shape-keyed shard-view rule outside.
     ``opt_state`` may be real arrays or an ``eval_shape`` tree."""
+    from distkeras_tpu.parallel.rules import (match_rules,
+                                              shard_view_rule)
+
     rep = NamedSharding(mesh, P())
-    shard_shapes = (zero1_shard_shapes(list(jax.tree.leaves(params)),
-                                       int(mesh.shape[axis]))
-                    if zero1 else frozenset())
-
-    def ex_shardings(ex: ExchangeState):
-        return ExchangeState(
-            e1=jax.tree.map(
-                lambda _: NamedSharding(mesh, P(axis, None, None)),
-                ex.e1),
-            e2=jax.tree.map(
-                lambda _: NamedSharding(mesh, P(axis, None)), ex.e2),
-            residual_norm=rep)
-
-    sh = NamedSharding(mesh, P(axis, None))
+    ex_rules = [
+        (r"(^|/)e1(/|$)", NamedSharding(mesh, P(axis, None, None))),
+        (r"(^|/)e2(/|$)", NamedSharding(mesh, P(axis, None))),
+        (r".*", rep),
+    ]
+    inner_rules = []
+    if zero1:
+        shapes = zero1_shard_shapes(list(jax.tree.leaves(params)),
+                                    int(mesh.shape[axis]))
+        inner_rules.append(shard_view_rule(shapes, mesh, axis=axis))
+    inner_rules.append((r".*", rep))
 
     def rule(x):
         if isinstance(x, ExchangeState):
-            return ex_shardings(x)
-        if hasattr(x, "shape") and tuple(x.shape) in shard_shapes:
-            return sh
-        return rep
+            # The residual rules match within the ExchangeState subtree
+            # only — a user parameter named "e1" elsewhere can never
+            # collide with them.
+            return match_rules(ex_rules, x, what="exchange sharding")
+        return match_rules(inner_rules, {"leaf": x},
+                           what="exchange sharding")["leaf"]
 
     return jax.tree.map(rule, opt_state,
                         is_leaf=lambda x: isinstance(x, ExchangeState))
@@ -604,7 +727,8 @@ def sync_local_tree(tree, config: ExchangeConfig, axis: str, n: int):
 
 
 __all__ = ["ExchangeConfig", "ExchangeState", "exchange_optimizer",
-           "exchange_state_shardings", "residual_norm_of",
+           "exchange_state_shardings", "exchange_layout",
+           "resolve_codecs", "residual_norm_of",
            "adasum_combine", "int8_encode", "int8_decode",
            "merge_local_params", "sync_local_tree",
            "topk_k", "wire_bytes"]

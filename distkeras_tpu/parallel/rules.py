@@ -1,0 +1,196 @@
+"""Regex partition-rule plans: ordered rules over flattened key paths.
+
+The repo grew three hand-built planners — ``ShardingPlan.spec_for``'s
+regex loop over Keras variable paths, ``Zero1Plan``'s shape-keyed
+optimizer-state walk, and ``ExchangePlan``'s residual-aware variant —
+and the ZeRO-2/3 work multiplies the plans again.  This module is the
+ONE rule engine they all derive from, the ``match_partition_rules``
+pattern of SNIPPETS [1] grown into a library:
+
+* a **rule** is ``(pattern, value)``: ``pattern`` a regex matched
+  (``re.search``) against the leaf's flattened key path (rendered
+  ``"layers/0/attn/wq"``-style, the same language ShardingPlan always
+  used), ``value`` either a concrete value (a ``PartitionSpec``, a
+  codec name, ...) or a callable ``(name, leaf) -> value | None`` —
+  ``None`` means "this rule declines, fall through to the next".
+  Callable rules are what lets shape-keyed policies (the ZeRO shard-view
+  rule) and path-keyed policies live in one ordered list.
+* matching is **first-match-wins** in rule order;
+* an **unmatched leaf raises**, naming the leaf path — the silent
+  "unmatched means replicated" default of the old planners hid typos in
+  TP rule sets.  Pass ``default=`` to restore a fallback explicitly
+  (the plans append an explicit catch-all ``(".*", default)`` instead,
+  so reading the rule list shows the whole policy).
+
+Consumers: ``parallel/sharding.py`` (every ShardingPlan;
+``Zero3Plan``), ``parallel/exchange.py`` (per-bucket codec rules and
+the exchange-state shardings), and user code via
+``distkeras_tpu.match_partition_rules``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.parallel.compat import keystr
+
+
+class UnmatchedLeafError(ValueError):
+    """No rule matched a leaf (carries the rendered leaf path)."""
+
+    def __init__(self, name: str, what: str):
+        self.leaf = name
+        super().__init__(
+            f"no {what} rule matched leaf {name!r}; rules are ordered "
+            "(pattern, value) pairs matched first-match-wins against "
+            "the flattened key path — add a rule for this leaf or a "
+            "catch-all ('.*', <default>) at the end")
+
+
+# Sentinel: "no default — unmatched leaves are an error".
+_RAISE = object()
+
+
+def leaf_name(path) -> str:
+    """Render one jax key path the way every rule in this repo is
+    written against: ``"layers/0/attn/wq"``."""
+    return keystr(path, simple=True, separator="/")
+
+
+def compile_rules(rules: Sequence[tuple[str, Any]]):
+    """[(pattern, value)] -> [(compiled, value)], validating patterns
+    eagerly so a typo raises at plan construction, not mid-trace."""
+    return [(re.compile(pat), val) for pat, val in rules]
+
+
+def first_match(compiled, name: str, leaf=None):
+    """First rule whose pattern matches ``name`` and whose value
+    accepts the leaf; ``(matched, value)`` — ``(False, None)`` when no
+    rule claims it."""
+    for pat, val in compiled:
+        if pat.search(name) is None:
+            continue
+        if callable(val) and not isinstance(val, type):
+            out = val(name, leaf)
+            if out is None:
+                continue  # rule declined: fall through
+            return True, out
+        return True, val
+    return False, None
+
+
+def match_rules(rules: Sequence[tuple[str, Any]], tree, *,
+                default: Any = _RAISE, what: str = "partition"):
+    """Pytree -> same-structure pytree of rule values.
+
+    The generic engine: ``rules`` may map to anything (PartitionSpecs,
+    codec names, shardings).  Unmatched leaves raise
+    :class:`UnmatchedLeafError` naming the leaf, unless ``default`` is
+    given.
+    """
+    compiled = compile_rules(rules)
+
+    def visit(path, leaf):
+        name = leaf_name(path)
+        matched, val = first_match(compiled, name, leaf)
+        if matched:
+            return val
+        if default is _RAISE:
+            raise UnmatchedLeafError(name, what)
+        return default
+
+    return jax.tree_util.tree_map_with_path(visit, tree)
+
+
+def match_partition_rules(rules: Sequence[tuple[str, P]], tree, *,
+                          default: Any = _RAISE):
+    """The SNIPPETS [1] ``match_partition_rules`` contract: ordered
+    ``(regex, PartitionSpec)`` rules over flattened key paths, first
+    match wins, **scalar leaves always replicate** (partitioning a
+    scalar is never meaningful), unmatched non-scalar leaves raise
+    naming the leaf."""
+    def scalar_guard(name, leaf):
+        shape = getattr(leaf, "shape", None)
+        if shape is not None and len(shape) == 0:
+            return P()
+        return None
+
+    return match_rules([(r".*", scalar_guard)] + list(rules), tree,
+                       default=default)
+
+
+def tree_shardings(mesh: Mesh, rules: Sequence[tuple[str, Any]], tree, *,
+                   default: Any = _RAISE, what: str = "sharding"):
+    """Like :func:`match_rules` but wraps plain ``PartitionSpec``
+    values into ``NamedSharding(mesh, spec)`` (values that already are
+    shardings pass through) — the form ``jax.device_put`` and
+    ``jit(out_shardings=...)`` consume."""
+    def wrap(v):
+        return NamedSharding(mesh, v) if isinstance(v, P) else v
+
+    if default is not _RAISE:
+        default = wrap(default)
+    specs = match_rules(rules, tree, default=default, what=what)
+    return jax.tree_util.tree_map(wrap, specs)
+
+
+# --------------------------------------------------- the ZeRO rule set
+
+
+def shard_view_rule(shard_shapes: frozenset, mesh: Mesh,
+                    axis: str = "data"):
+    """The ZeRO shard-view rule as ONE engine rule: any leaf whose
+    shape is a ``[n, cols]`` shard-view shape of the parameter tree
+    scatters ``P(axis, None)``; every other leaf falls through to the
+    next rule.  Shape-keyed on purpose (see
+    ``collectives.zero1_state_shardings``): it covers moments nested in
+    chains, masks and EMA shadows uniformly, because under a sharded
+    update the inner optimizer only ever sees shard views."""
+    sh = NamedSharding(mesh, P(axis, None))
+
+    def rule(name, leaf):
+        if hasattr(leaf, "shape") and tuple(leaf.shape) in shard_shapes:
+            return sh
+        return None
+
+    return (r".*", rule)
+
+
+def zero_state_rules(params, mesh: Mesh, axis: str = "data"):
+    """The ordered rule list for a ZeRO-sharded optimizer state (every
+    stage): shard views scatter, everything else (scalar counts,
+    EmptyState internals) replicates.  ``params`` is the parameter tree
+    the state mirrors (arrays or shape structs) — full layout or shard
+    views, the derived shard shapes agree."""
+    from distkeras_tpu.parallel.collectives import zero1_shard_shapes
+
+    shapes = zero1_shard_shapes(jax.tree.leaves(params),
+                                int(mesh.shape[axis]))
+    return [shard_view_rule(shapes, mesh, axis=axis),
+            (r".*", NamedSharding(mesh, P()))]
+
+
+def zero_state_shardings(params, opt_state, mesh: Mesh,
+                         axis: str = "data"):
+    """Sharding tree for a ZeRO optimizer state, via the rule engine —
+    the ONE definition every stage and both trainer families share."""
+    return match_rules(zero_state_rules(params, mesh, axis=axis),
+                       opt_state, what="ZeRO state sharding")
+
+
+def zero3_param_shardings(view_tree, mesh: Mesh, axis: str = "data"):
+    """Shardings for a ZeRO-3 parameter tree held as ``[n, cols]``
+    shard views: every leaf scatters ``P(axis, None)`` (gather-on-use
+    re-materializes them per fusion bucket inside the step)."""
+    sh = NamedSharding(mesh, P(axis, None))
+    return jax.tree.map(lambda _: sh, view_tree)
+
+
+__all__ = ["UnmatchedLeafError", "leaf_name", "compile_rules",
+           "first_match", "match_rules", "match_partition_rules",
+           "tree_shardings", "shard_view_rule", "zero_state_rules",
+           "zero_state_shardings", "zero3_param_shardings"]

@@ -12,7 +12,7 @@ operands over ``model`` and XLA inserts the all-gathers/reduce-scatters.
 
 from __future__ import annotations
 
-import re
+
 from typing import Sequence
 
 import jax
@@ -67,16 +67,22 @@ class ShardingPlan:
 
     def __init__(self, rules: Sequence[tuple[str, P]] = (),
                  batch_spec: P = P("data"), fsdp_axis: str | None = None):
-        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        from distkeras_tpu.parallel.rules import compile_rules
+
+        self.rules = compile_rules(rules)
         self.batch_spec = batch_spec
         self.fsdp_axis = fsdp_axis
 
     def spec_for(self, path: str, shape=None, mesh: Mesh | None = None) -> P:
-        spec = P()
-        for pat, rule_spec in self.rules:
-            if pat.search(path):
-                spec = rule_spec
-                break
+        # First-match-wins through the shared rule engine
+        # (parallel/rules.py); a plan's unmatched leaves replicate —
+        # the historical ShardingPlan default (rule authors who want
+        # unmatched-leaf errors use rules.match_partition_rules).
+        from distkeras_tpu.parallel.rules import first_match
+
+        matched, spec = first_match(self.rules, path)
+        if not matched:
+            spec = P()
         if self.fsdp_axis is not None and mesh is not None:
             spec = _augment_fsdp(spec, shape,
                                  int(mesh.shape[self.fsdp_axis]),
@@ -225,6 +231,51 @@ class ExchangePlan(ShardingPlan):
         )
 
 
+class Zero3Plan(ShardingPlan):
+    """Data parallelism with parameters AND optimizer state scattered
+    as ``[n, cols]`` chunk-major shard views over ``data`` (ZeRO-3,
+    gather-on-use): persistent state holds 1/n of every parameter,
+    gradient-moment and EMA leaf per device; the train step
+    re-materializes parameters per fusion bucket just-in-time
+    (``collectives.gather_bucket``) and runs the update entirely on the
+    shard views — no per-step parameter all-gather of the update.
+
+    Unlike :func:`fsdp_plan` (the GSPMD dimension-sharding spelling of
+    ZeRO-3), the chunk-major layout shards EVERY leaf regardless of
+    divisibility (biases, norm scales — anything `_augment_fsdp` would
+    leave replicated), and the gather is bucket-granular: a handful of
+    fused all-gathers per step instead of one per parameter.  Derived
+    from the shared rule engine (``parallel/rules.py``): the shape-
+    keyed shard-view rule ahead of a replicate catch-all.
+    """
+
+    def __init__(self, bucket_mb: float | None = None):
+        super().__init__(rules=(), batch_spec=P("data"))
+        from distkeras_tpu.parallel.collectives import DEFAULT_BUCKET_MB
+
+        self.zero = 3
+        self.bucket_mb = (DEFAULT_BUCKET_MB if bucket_mb is None
+                          else bucket_mb)
+
+    def state_shardings(self, mesh: Mesh, state, tv_paths: Sequence[str]):
+        """TrainState shardings for a state whose ``tv`` leaves are
+        shard views: ``tv`` and the view-mirroring optimizer leaves
+        scatter ``P("data", None)``; ``ntv``/``step``/scalar counts
+        replicate — one ordered rule list (parallel/rules.py)."""
+        from distkeras_tpu.models.adapter import TrainState
+        from distkeras_tpu.parallel.rules import (zero3_param_shardings,
+                                                  zero_state_shardings)
+
+        rep = NamedSharding(mesh, P())
+        return TrainState(
+            tv=zero3_param_shardings(list(state.tv), mesh),
+            ntv=jax.tree.map(lambda _: rep, state.ntv),
+            opt_state=zero_state_shardings(list(state.tv),
+                                           state.opt_state, mesh),
+            step=rep,
+        )
+
+
 def dp_plan() -> ShardingPlan:
     """Pure data parallelism: replicate weights, split batch on ``data``."""
     return ShardingPlan(rules=(), batch_spec=P("data"))
@@ -243,6 +294,19 @@ def zero1_plan(bucket_mb: float | None = None) -> Zero1Plan:
     all-gather per use; see docs/zero1.md for when to prefer which.
     """
     return Zero1Plan(bucket_mb=bucket_mb)
+
+
+def zero3_plan(bucket_mb: float | None = None) -> Zero3Plan:
+    """Data parallelism with chunk-major gather-on-use parameter
+    sharding (ZeRO-3): persistent params, gradients and optimizer
+    state all live as ``[n, cols]`` shard views over ``data`` —
+    per-device bytes for all three drop ~n× — and the step all-gathers
+    parameters per fusion bucket just-in-time.  The explicit-plan
+    spelling of ``zero=3`` on ADAG/DynSGD; compare :func:`fsdp_plan`
+    (GSPMD dimension sharding, composes with TP) and
+    :func:`zero1_plan` (update-only sharding, no gather-on-use).
+    """
+    return Zero3Plan(bucket_mb=bucket_mb)
 
 
 def fsdp_plan(extra_rules: Sequence[tuple[str, P]] = (),

@@ -23,6 +23,32 @@ from distkeras_tpu.resilience.chaos import Preempted
 from distkeras_tpu.utils.profiling import StepTimer
 
 
+def normalize_zero_args(zero, zero1: bool, zero_bucket_mb,
+                        zero1_bucket_mb):
+    """Reconcile the ``zero=`` stage knob with its deprecated PR-2
+    aliases — ONE definition for both trainer families
+    (``DistributedTrainer`` and ``LMTrainer``), so the alias semantics
+    can never drift between them.  Returns
+    ``(zero, zero1, zero_bucket_mb)`` with ``zero1 == (zero == 1)``.
+    """
+    if zero is None:
+        zero = 1 if zero1 else 0
+    elif zero1 and zero != 1:
+        raise ValueError(
+            f"zero1=True is the deprecated alias of zero=1 and "
+            f"cannot combine with zero={zero}; pass zero= alone")
+    if zero not in (0, 1, 2, 3):
+        raise ValueError(
+            f"zero must be 0 (off), 1, 2 or 3, got {zero!r}")
+    if zero_bucket_mb is not None and zero1_bucket_mb is not None:
+        raise ValueError(
+            "pass only one of zero_bucket_mb / zero1_bucket_mb "
+            "(the latter is the deprecated alias)")
+    if zero_bucket_mb is None:
+        zero_bucket_mb = zero1_bucket_mb
+    return zero, zero == 1, zero_bucket_mb
+
+
 class CheckpointingBase:
     """Checkpoint/resume plumbing shared across the whole trainer family.
 

@@ -33,8 +33,9 @@ from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.parallel.mesh import (MeshSpec, equal_across_hosts,
                                           make_mesh, per_host_rows,
                                           global_batch as mesh_global_batch)
-from distkeras_tpu.parallel.sharding import (ShardingPlan, dp_plan,
-                                              fsdp_plan, zero1_plan)
+from distkeras_tpu.parallel.sharding import (ShardingPlan, Zero1Plan,
+                                              dp_plan, fsdp_plan,
+                                              zero1_plan)
 from distkeras_tpu.trainers.base import Trainer
 
 
@@ -54,14 +55,30 @@ class DistributedTrainer(Trainer):
     replicating — identical training math, ~num_workers x less
     parameter memory per device.
 
-    ``zero1=True`` shards only the *weight update*: parameters stay
-    replicated (forward/backward untouched), the optimizer state
-    scatters over the data axis, and each round's exchange becomes
-    reduce-scatter(grads) -> per-replica shard update ->
-    all-gather(update), in ~``zero1_bucket_mb`` fusion buckets
-    (parallel/collectives.py).  Identical math at unchanged
-    communication volume, ~num_workers x less optimizer memory and
-    update compute per device; see docs/zero1.md for zero1 vs fsdp.
+    ``zero=`` selects a ZeRO sharding stage (docs/zero1.md; identical
+    training math at every stage, pure-data meshes only):
+
+    * ``zero=1`` shards only the *weight update*: parameters stay
+      replicated (forward/backward untouched), the optimizer state
+      scatters over the data axis, and each round's exchange becomes
+      reduce-scatter(grads) -> per-replica shard update ->
+      all-gather(update), in ~``zero_bucket_mb`` fusion buckets
+      (parallel/collectives.py).  Unchanged communication volume,
+      ~num_workers x less optimizer memory and update compute per
+      device.  ``zero1=True`` is the deprecated alias.
+    * ``zero=2`` additionally shards the GRADIENT ACCUMULATOR: each
+      microbatch's bucketed reduce-scatter interleaves into the
+      accumulation scan, so a replica only ever materializes its 1/n
+      gradient shard and the per-round wire drops from ``window``
+      all-reduces to ``window`` reduce-scatters + one all-gather.
+    * ``zero=3`` additionally shards the PARAMETERS as chunk-major
+      ``[n, cols]`` shard views with gather-on-use: the forward
+      re-materializes them per fusion bucket just-in-time
+      (collectives.gather_bucket) and the update runs entirely on the
+      shard views — per-device param+grad+optimizer bytes all drop
+      ~num_workers x.  Compare ``fsdp=True`` (the GSPMD
+      dimension-sharded spelling, which composes with TP but leaves
+      small/indivisible leaves replicated).
 
     **Gradient-exchange policy** (docs/lowcomm.md, ADAG/DynSGD only):
     ``merge_rule="adasum"`` replaces the mean-reduce with pairwise
@@ -85,15 +102,21 @@ class DistributedTrainer(Trainer):
                  batch_size: int = 32, num_epoch: int = 1,
                  num_workers: int | None = None, mesh=None,
                  plan: ShardingPlan | None = None, fsdp: bool = False,
+                 zero: int | None = None,
                  zero1: bool = False, zero1_bucket_mb: float | None = None,
+                 zero_bucket_mb: float | None = None,
                  device_data: bool = False, merge_rule: str = "mean",
-                 sync_every: int = 1, compress: str | None = None,
+                 sync_every: int = 1, compress=None,
                  topk_frac: float = 0.01, probe_metrics: bool = False,
                  **kw):
         super().__init__(keras_model, loss=loss,
                          worker_optimizer=worker_optimizer,
                          learning_rate=learning_rate, batch_size=batch_size,
                          num_epoch=num_epoch, **kw)
+        from distkeras_tpu.trainers.base import normalize_zero_args
+
+        zero, zero1, zero_bucket_mb = normalize_zero_args(
+            zero, zero1, zero_bucket_mb, zero1_bucket_mb)
         if device_data and not self._supports_device_data:
             raise ValueError(
                 f"device_data=True is not supported by "
@@ -109,8 +132,8 @@ class DistributedTrainer(Trainer):
             compress=compress, topk_frac=topk_frac,
             # Under zero1 x int8 the exchange's bucket layout IS the
             # zero1 layout, so the one bucket knob governs both.
-            **({} if zero1_bucket_mb is None
-               else {"bucket_mb": zero1_bucket_mb}))
+            **({} if zero_bucket_mb is None
+               else {"bucket_mb": zero_bucket_mb}))
         self.exchange = exchange
         self.probe_metrics = probe_metrics
         self.probe_history: list[dict] = []
@@ -141,13 +164,14 @@ class DistributedTrainer(Trainer):
                     "stats, seeded Dropout): per-replica local updates "
                     "would diverge it — train such models with the "
                     "default synchronous exchange")
-            if zero1 and not (exchange.compress == "int8"
-                              and exchange.sync_every == 1):
+            if zero and not (zero == 1 and exchange.compress == "int8"
+                             and exchange.sync_every == 1):
                 raise ValueError(
-                    "zero1=True composes with compress='int8' only "
-                    "(the chunked codec compresses the reduce-scatter "
-                    "leg); adasum and local-SGD replace the exchange "
-                    "zero1 shards")
+                    "the ZeRO stages compose with zero=1 + "
+                    "compress='int8' only (the chunked codec compresses "
+                    "the reduce-scatter leg); adasum, local-SGD, codec "
+                    "rules and stages 2/3 replace the exchange the "
+                    "sharded update rides")
         if probe_metrics and exchange.sync_every > 1:
             raise ValueError(
                 "probe_metrics with sync_every > 1 is not supported: "
@@ -158,26 +182,39 @@ class DistributedTrainer(Trainer):
                 "probe_metrics does not compose with device_data=True "
                 "(the indexed data plane's scanned step has no probe "
                 "output slot)")
-        if sum((fsdp, zero1, plan is not None)) > 1:
+        if sum((fsdp, bool(zero), plan is not None)) > 1:
             raise ValueError(
-                "pass only one of plan=, fsdp=True, zero1=True — they are "
-                "alternative placement policies for the same state")
-        if zero1_bucket_mb is not None and not zero1:
+                "pass only one of plan=, fsdp=True, zero=/zero1=True — "
+                "they are alternative placement policies for the same "
+                "state")
+        if zero_bucket_mb is not None and not zero:
             raise ValueError(
-                "zero1_bucket_mb only applies with zero1=True (the "
-                "plan=zero1_plan(...) spelling carries its own bucket_mb)")
+                "zero_bucket_mb/zero1_bucket_mb only apply with a ZeRO "
+                "stage (the plan=zero1_plan(...)/zero3_plan(...) "
+                "spellings carry their own bucket_mb)")
         if not exchange.is_default:
             from distkeras_tpu.parallel.sharding import ExchangePlan
 
             self.plan = ExchangePlan(exchange, zero1=zero1)
         else:
+            from distkeras_tpu.parallel.sharding import zero3_plan
+
             self.plan = plan or (fsdp_plan() if fsdp
-                                 else zero1_plan(zero1_bucket_mb) if zero1
+                                 else zero1_plan(zero_bucket_mb)
+                                 if zero == 1
+                                 else Zero1Plan(zero_bucket_mb)
+                                 if zero == 2
+                                 else zero3_plan(zero_bucket_mb)
+                                 if zero == 3
                                  else dp_plan())
-            # plan=zero1_plan() is the explicit spelling of zero1=True:
-            # the plan's sharded opt-state layout only exists if the
-            # optimizer is wrapped to produce it.
-            zero1 = zero1 or bool(getattr(self.plan, "zero1", False))
+            # plan=zero1_plan()/zero3_plan() are the explicit spellings
+            # of zero=1/zero=3: the plans' sharded layouts only exist
+            # if the optimizer/step are wired to produce them.
+            if not zero:
+                if getattr(self.plan, "zero1", False):
+                    zero, zero1 = 1, True
+                elif getattr(self.plan, "zero", 0):
+                    zero = int(self.plan.zero)
         if mesh is not None:
             self.mesh = mesh
         else:
@@ -197,34 +234,77 @@ class DistributedTrainer(Trainer):
                         "merge_rule/sync_every/compress compose with the "
                         f"data axis only, but the mesh has {ax}="
                         f"{int(size)}")
+        self.zero = zero
         self.zero1 = zero1
-        if zero1 and exchange.compress == "int8":
-            from distkeras_tpu.parallel.collectives import zero1_validate
+        self._zero_inner = None
+        self._zero_bucket_mb = getattr(self.plan, "bucket_mb", None)
+        if zero == 1 and exchange.compress == "int8":
+            from distkeras_tpu.parallel.collectives import zero_validate
             from distkeras_tpu.parallel.exchange import exchange_optimizer
 
-            zero1_validate(self.mesh, worker_optimizer)
+            zero_validate(self.mesh, worker_optimizer, stage=zero)
             self.adapter.optimizer = exchange_optimizer(
-                self.adapter.optimizer, self.mesh, exchange, zero1=True)
-        elif zero1:
+                self.adapter.optimizer, self.mesh, exchange, zero1=True,
+                names=self.adapter.tv_paths)
+        elif zero:
             from distkeras_tpu.parallel.collectives import zero1_enable
 
-            # Wrap AFTER the adapter resolved the optimizer: the wrapper
-            # is a drop-in GradientTransformation, so init_state and
-            # every accum/train step builder pick it up unchanged.
+            # The shared enablement path: zero1_enable runs the
+            # construction-time checks for this stage — a known
+            # non-elementwise transform (LARS/LAMB trust ratios)
+            # raises naming itself instead of silently diverging
+            # inside the scattered update — then wraps AFTER the
+            # adapter resolved the optimizer: the wrapper is a drop-in
+            # GradientTransformation, so init_state and every
+            # accum/train step builder pick it up unchanged.  For
+            # stages 2/3 only its INIT half is consumed (shard-view
+            # state); the zero accum step drives the raw inner update
+            # on the scattered views directly (_zero_inner).
+            self._zero_inner = self.adapter.optimizer
             self.adapter.optimizer = zero1_enable(
-                self.adapter.optimizer, self.mesh, spec=worker_optimizer,
-                bucket_mb=self.plan.bucket_mb)
+                self._zero_inner, self.mesh, spec=worker_optimizer,
+                bucket_mb=self._zero_bucket_mb, stage=zero)
         elif exchange.needs_grad_exchange:
             from distkeras_tpu.parallel.exchange import exchange_optimizer
 
             self.adapter.optimizer = exchange_optimizer(
-                self.adapter.optimizer, self.mesh, exchange)
+                self.adapter.optimizer, self.mesh, exchange,
+                names=self.adapter.tv_paths)
 
     # ------------------------------------------------------------ helpers
 
+    def _zero_view_state(self, state):
+        """Stage 3: the persistent ``tv`` is the chunk-major shard-view
+        layout (``[n, cols]`` per leaf) — converted ONCE here, before
+        placement; the step trains on views end to end."""
+        layout = self.adapter.zero_layout(self.num_workers,
+                                          self._zero_bucket_mb)
+        return state.replace(tv=layout.shard_views(list(state.tv)))
+
+    def _zero_unview_state(self, state):
+        """Inverse of :meth:`_zero_view_state` (gathers the scattered
+        views): parameter-layout ``tv`` for eval/export."""
+        layout = self.adapter.zero_layout(self.num_workers,
+                                          self._zero_bucket_mb)
+        return state.replace(tv=layout.unview(list(state.tv)))
+
     def _shard_state(self, state):
+        if self.zero >= 3:
+            state = self._zero_view_state(state)
         sh = self.plan.state_shardings(self.mesh, state, self.adapter.tv_paths)
         return jax.device_put(state, sh), sh
+
+    def _eval_state_view(self, pytree):
+        """Mid-train eval under stage 3 reads the params back out of
+        the shard views (a gather per eval round, never per step)."""
+        if self.zero >= 3:
+            pytree = self._zero_unview_state(pytree)
+        return pytree.tv, pytree.ntv
+
+    def _export(self, state):
+        if self.zero >= 3:
+            state = self._zero_unview_state(state)
+        return super()._export(state)
 
     def _batch_sharding(self, leading_window: bool,
                         leading_sync: bool = False):
@@ -289,7 +369,8 @@ class ADAG(DistributedTrainer):
         """The (un-jitted) round step for this exchange configuration:
         local-SGD when ``sync_every > 1``, the stacked-local-gradient
         accumulation step when a merge rule/codec needs per-replica
-        gradients, the plain accumulation step otherwise."""
+        gradients, the ZeRO stage-2/3 scattered-accumulator step when
+        ``zero >= 2``, the plain accumulation step otherwise."""
         ex = self.exchange
         w = self.communication_window
         if ex.sync_every > 1:
@@ -299,6 +380,11 @@ class ADAG(DistributedTrainer):
             return self.adapter.make_accum_train_step(
                 w, value_and_grad=self._stacked_local_vag(),
                 grad_axis_size=self.num_workers,
+                probe=self.probe_metrics)
+        if self.zero >= 2:
+            return self.adapter.make_zero_accum_step(
+                w, self.mesh, self._zero_inner, stage=self.zero,
+                bucket_mb=self._zero_bucket_mb,
                 probe=self.probe_metrics)
         return self.adapter.make_accum_train_step(
             w, probe=self.probe_metrics)
@@ -318,10 +404,13 @@ class ADAG(DistributedTrainer):
         """THE jitted step of the single-process device-resident data
         plane — shared by ``_fit_device_data`` and
         :meth:`traced_for_analysis` (same never-drift contract as
-        :meth:`_jit_accum_step`)."""
+        :meth:`_jit_accum_step`).  Under ``zero >= 2`` the indexed
+        gather wraps the scattered-accumulator step, so device_data
+        and the ZeRO stages compose."""
+        accum = (self._accum_step_fn() if self.zero >= 2 else None)
         return jax.jit(
             self.adapter.make_indexed_accum_train_step(
-                self.communication_window),
+                self.communication_window, accum=accum),
             in_shardings=(state_sh, repl, repl, idx_sh),
             out_shardings=(state_sh, repl),
             donate_argnums=0,
@@ -342,17 +431,19 @@ class ADAG(DistributedTrainer):
         w = self.communication_window
         H = self.exchange.sync_every
         state = jax.eval_shape(self.adapter.init_state)
+        pbytes = int(sum(np.prod(v.shape) * v.dtype.itemsize
+                         for v in jax.tree.leaves(state.tv)))
+        if self.zero >= 3:
+            state = jax.eval_shape(self._zero_view_state, state)
         state_sh = self.plan.state_shardings(self.mesh, state,
                                              self.adapter.tv_paths)
         X = dataset[self.features_col]
         Y = dataset[self.label_col]
         name = type(self).__name__.lower()
-        variant = "zero1" if self.zero1 else "dp"
+        variant = f"zero{self.zero}" if self.zero else "dp"
         if not self.exchange.is_default:
             label = self.exchange.label()
             variant = f"zero1_{label}" if self.zero1 else label
-        pbytes = int(sum(np.prod(v.shape) * v.dtype.itemsize
-                         for v in jax.tree.leaves(state.tv)))
         global_bs = self.batch_size * self.num_workers
         if self.device_data:
             repl = NamedSharding(self.mesh, P())
@@ -572,7 +663,8 @@ class ADAG(DistributedTrainer):
 
         state = self.adapter.init_state()
         state, state_sh = self._shard_state(state)
-        accum = self.adapter.make_accum_train_step(w)
+        accum = (self._accum_step_fn() if self.zero >= 2
+                 else self.adapter.make_accum_train_step(w))
         mesh = self.mesh
 
         def local_gather(Xb, Yb, idx):

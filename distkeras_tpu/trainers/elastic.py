@@ -101,14 +101,15 @@ class ReplicaTrainer(DistributedTrainer):
                 "algorithm), so there is no single parameter set to "
                 "scatter. Use ADAG/DynSGD with fsdp=True for "
                 "memory-sharded data parallelism.")
-        if kw.pop("zero1", False) or (
-                plan is not None and getattr(plan, "zero1", False)):
+        if kw.pop("zero1", False) or kw.pop("zero", 0) or (
+                plan is not None and (getattr(plan, "zero1", False)
+                                      or getattr(plan, "zero", 0))):
             raise ValueError(
-                f"{type(self).__name__} cannot use zero1: each replica "
-                "runs its own full optimizer on intentionally divergent "
-                "weights (that is the algorithm), so there is no single "
-                "update to shard. Use ADAG/DynSGD with zero1=True for "
-                "the sharded weight update.")
+                f"{type(self).__name__} cannot use zero1/zero=: each "
+                "replica runs its own full optimizer on intentionally "
+                "divergent weights (that is the algorithm), so there is "
+                "no single update to shard. Use ADAG/DynSGD with zero= "
+                "for the sharded stages.")
         super().__init__(keras_model, loss=loss, **kw)
 
     # ------------------------------------------------------------ state

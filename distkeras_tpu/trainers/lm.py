@@ -89,6 +89,85 @@ def _with_ema(opt, decay: float):
     return optax.GradientTransformation(init, update)
 
 
+def _make_zero_step(cfg: tfm.TransformerConfig, inner, mesh, layout,
+                    stage: int, grad_accum: int, probe: bool):
+    """The ZeRO stage-2/3 train step for the pure-DP LM
+    (docs/zero1.md): the gradient accumulator is the SCATTERED fusion-
+    bucket layout — each microbatch's bucketed reduce-scatter
+    interleaves into the accumulation loop (``collectives.scatter`` on
+    the carry), so a replica only ever materializes its 1/n gradient
+    shard — and the update runs on the shard views via ``inner`` (the
+    raw optax chain, whose state the trainer inits over views).
+
+    Stage 2 keeps ``params`` replicated and all-gathers the update;
+    stage 3 takes ``params`` AS the ``[n, cols]`` shard-view tree,
+    re-materializes full parameters per fusion bucket just-in-time
+    inside the loss (``collectives.gather_bucket``: all-gather forward,
+    reduce-scatter backward) and returns the updated views — no
+    parameter all-gather leg at all.
+    """
+    from distkeras_tpu.parallel.collectives import (all_gather,
+                                                    gather_bucket,
+                                                    scatter)
+
+    dropping = cfg.dropout > 0
+    scope = "zero3/grad_accum" if stage >= 3 else "zero2/accum_scatter"
+
+    def loss_of_views(v, tok, rng, seg):
+        buckets = [gather_bucket(b, mesh) for b in layout.pack_views(v)]
+        full = layout.unpack(buckets)
+        return tfm.lm_loss(full, tok, cfg, None, None, rng, None, seg)
+
+    def loss_full(p, tok, rng, seg):
+        return tfm.lm_loss(p, tok, cfg, None, None, rng, None, seg)
+
+    def step(carry, tokens, dropout_rng=None, segment_ids=None):
+        params, opt_state = carry
+        if dropping and dropout_rng is None:
+            raise ValueError(
+                f"cfg.dropout={cfg.dropout} but the train step got no "
+                "dropout_rng (LMTrainer threads the rng automatically)")
+        rng = dropout_rng if dropping else None
+        grad_fn = jax.value_and_grad(
+            loss_of_views if stage >= 3 else loss_full)
+        acc = layout.zero_buckets()
+        loss = jnp.zeros((), jnp.float32)
+        for i in range(grad_accum):
+            tok = tokens[i] if grad_accum > 1 else tokens
+            seg = (None if segment_ids is None
+                   else segment_ids[i] if grad_accum > 1
+                   else segment_ids)
+            ri = (jax.random.fold_in(rng, i)
+                  if rng is not None and grad_accum > 1 else rng)
+            li, gi = grad_fn(params, tok, ri, seg)
+            g_bks = (layout.pack_views(gi) if stage >= 3
+                     else layout.pack(gi))
+            with jax.named_scope(scope):
+                acc = [scatter(a + b, mesh) for a, b in zip(acc, g_bks)]
+            loss = loss + li
+        g_views = layout.views_from_buckets(
+            [b / grad_accum for b in acc])
+        p_views = params if stage >= 3 else layout.shard_views(params)
+        with jax.named_scope(f"zero{stage}/update"):
+            u_views, opt_state = inner.update(g_views, opt_state,
+                                              p_views)
+        if stage >= 3:
+            params = jax.tree.map(lambda p, u: p + u, params, u_views)
+        else:
+            with jax.named_scope("zero2/all_gather"):
+                u_buckets = [all_gather(b, mesh)
+                             for b in layout.pack_views(u_views)]
+            params = jax.tree.map(lambda p, u: p + u, params,
+                                  layout.unpack(u_buckets))
+        loss = loss / grad_accum
+        if probe:
+            return (params, opt_state), (
+                loss, {"grad_norm": optax.global_norm(g_views)})
+        return (params, opt_state), loss
+
+    return step
+
+
 def _make_localsgd_step(cfg: tfm.TransformerConfig, optimizer, mesh,
                         config):
     """Local-SGD train step for the pure-DP LM (docs/lowcomm.md):
@@ -159,16 +238,21 @@ class LMTrainer(CheckpointingBase):
     unchanged train step inside the same jitted program.  Data order
     is bit-for-bit the streaming path's (parity-tested).
 
-    ``zero1=True``: cross-replica sharded weight update (ZeRO-1,
-    docs/zero1.md).  Parameters stay replicated — forward/backward are
-    untouched — but the optimizer state scatters over the ``data`` axis
-    and the step becomes reduce-scatter(grads) -> each replica updates
-    its shard -> all-gather(update), in ~``zero1_bucket_mb`` fusion
-    buckets (parallel/collectives.py).  Math-identical at unchanged
-    communication volume; per-device optimizer memory (adam moments,
-    the EMA shadow) and update FLOPs drop ~data-axis x.  Pure-DP meshes
-    only; ``fsdp=True`` (ZeRO-3) is the alternative when parameter
-    memory itself must shard.
+    ``zero=1|2|3``: ZeRO sharding stages (docs/zero1.md; identical
+    training math, pure-DP meshes only, ~``zero_bucket_mb`` fusion
+    buckets).  Stage 1 (alias ``zero1=True``) shards the weight
+    update: reduce-scatter(grads) -> each replica updates its shard ->
+    all-gather(update); optimizer memory (adam moments, the EMA
+    shadow) and update FLOPs drop ~data-axis x at unchanged comm
+    volume.  Stage 2 additionally shards the gradient accumulator —
+    each microbatch's bucketed reduce-scatter interleaves into the
+    ``grad_accum`` loop, so a replica only materializes its 1/n
+    gradient shard.  Stage 3 additionally holds the PARAMETERS as
+    chunk-major ``[n, cols]`` shard views with bucket-granular
+    gather-on-use (collectives.gather_bucket) and updates the views in
+    place — per-device param+grad+opt bytes all drop ~data-axis x.
+    ``fsdp=True`` is the GSPMD dimension-sharded ZeRO-3 alternative
+    when TP composition matters.
 
     **Gradient-exchange policy** (docs/lowcomm.md; pure-DP meshes, no
     dropout/MoE/segments): ``merge_rule="adasum"`` merges replica
@@ -206,11 +290,13 @@ class LMTrainer(CheckpointingBase):
                  batch_size: int = 8,
                  num_epoch: int = 1, mesh=None, rules=None,
                  microbatches: int | None = None, fsdp: bool = False,
+                 zero: int | None = None,
                  zero1: bool = False, zero1_bucket_mb: float | None = None,
+                 zero_bucket_mb: float | None = None,
                  device_data: bool = False,
                  grad_accum: int = 1, grad_clip_norm: float | None = None,
                  merge_rule: str = "mean", sync_every: int = 1,
-                 compress: str | None = None, topk_frac: float = 0.01,
+                 compress=None, topk_frac: float = 0.01,
                  probe_metrics: bool = False,
                  tokens_col: str = "tokens", seed: int = 0,
                  shuffle: bool = False, eval_every: int = 0,
@@ -220,6 +306,10 @@ class LMTrainer(CheckpointingBase):
                  checkpoint_backend: str = "auto",
                  ema_decay: float | None = None):
         self.cfg = cfg
+        from distkeras_tpu.trainers.base import normalize_zero_args
+
+        zero, zero1, zero_bucket_mb = normalize_zero_args(
+            zero, zero1, zero_bucket_mb, zero1_bucket_mb)
         if not callable(learning_rate) and learning_rate <= 0:
             raise ValueError(
                 f"learning_rate must be positive, got {learning_rate}")
@@ -345,10 +435,14 @@ class LMTrainer(CheckpointingBase):
                 f"(mesh has pipeline={n_pipe})")
         self.microbatches = microbatches or (2 * n_pipe if n_pipe > 1 else 1)
 
+        self.zero = zero
         self.zero1 = zero1
-        if zero1_bucket_mb is not None and not zero1:
+        self._zero_inner = None
+        self._zero_layout_cache = None
+        if zero_bucket_mb is not None and not zero:
             raise ValueError(
-                "zero1_bucket_mb only applies with zero1=True")
+                "zero_bucket_mb/zero1_bucket_mb only apply with a "
+                "ZeRO stage (zero=/zero1=True)")
         from distkeras_tpu.parallel.exchange import ExchangeConfig
 
         exchange = ExchangeConfig(
@@ -356,8 +450,8 @@ class LMTrainer(CheckpointingBase):
             compress=compress, topk_frac=topk_frac,
             # Under zero1 x int8 the exchange's bucket layout IS the
             # zero1 layout, so the one bucket knob governs both.
-            **({} if zero1_bucket_mb is None
-               else {"bucket_mb": zero1_bucket_mb}))
+            **({} if zero_bucket_mb is None
+               else {"bucket_mb": zero_bucket_mb}))
         self.exchange = exchange
         self.probe_metrics = probe_metrics
         self.probe_history: list[dict] = []
@@ -384,13 +478,14 @@ class LMTrainer(CheckpointingBase):
                     "with device_data=True: the staged data plane "
                     "does not route through the local-gradient "
                     "shard_map")
-            if zero1 and not (exchange.compress == "int8"
-                              and exchange.sync_every == 1):
+            if zero and not (zero == 1 and exchange.compress == "int8"
+                             and exchange.sync_every == 1):
                 raise ValueError(
-                    "zero1=True composes with compress='int8' only "
-                    "(the chunked codec compresses the reduce-scatter "
-                    "leg); adasum and local-SGD replace the exchange "
-                    "zero1 shards")
+                    "the ZeRO stages compose with zero=1 + "
+                    "compress='int8' only (the chunked codec compresses "
+                    "the reduce-scatter leg); adasum, local-SGD, codec "
+                    "rules and stages 2/3 replace the exchange the "
+                    "sharded update rides")
             if exchange.sync_every > 1 and grad_accum > 1:
                 raise ValueError(
                     "sync_every > 1 with grad_accum > 1 is not "
@@ -405,35 +500,51 @@ class LMTrainer(CheckpointingBase):
             raise ValueError(
                 "probe_metrics does not compose with device_data=True "
                 "(the staged-stream step has no probe output slot)")
-        if zero1:
+        if zero:
             if fsdp:
                 raise ValueError(
-                    "zero1=True (sharded weight update) and fsdp=True "
-                    "(ZeRO-3) are exclusive: fsdp already scatters the "
-                    "optimizer state along with the parameters")
+                    f"zero={zero} (chunk-major ZeRO) and fsdp=True "
+                    "(the GSPMD dimension-sharded ZeRO-3 spelling) are "
+                    "exclusive: they are alternative placements for "
+                    "the same state")
             from distkeras_tpu.parallel.collectives import (
-                DEFAULT_BUCKET_MB, zero1_enable, zero1_validate)
+                DEFAULT_BUCKET_MB, zero1_enable, zero_validate)
 
-            self._zero1_bucket_mb = (DEFAULT_BUCKET_MB
-                                     if zero1_bucket_mb is None
-                                     else zero1_bucket_mb)
-            if exchange.compress == "int8":
+            self._zero_bucket_mb = (DEFAULT_BUCKET_MB
+                                    if zero_bucket_mb is None
+                                    else zero_bucket_mb)
+            # Satellite contract: the elementwise-compatibility check
+            # runs at construction for EVERY stage — a known
+            # non-elementwise transform (LARS/LAMB trust ratios) raises
+            # naming itself instead of silently diverging inside the
+            # scattered update.  Also rejects non-pure-DP meshes.
+            # (Stage 1 runs it through zero1_enable, the shared
+            # enablement path; stages 2/3 validate here and init over
+            # views without a wrapper.)
+            if zero != 1:
+                zero_validate(self.mesh, optimizer, stage=zero)
+            if zero == 1 and exchange.compress == "int8":
                 from distkeras_tpu.parallel.exchange import (
                     exchange_optimizer)
 
                 # zero1 x int8-EF: the exchange optimizer both shards
                 # the update AND compresses the reduce-scatter leg.
-                zero1_validate(self.mesh, optimizer)
+                zero_validate(self.mesh, optimizer, stage=zero)
                 self.optimizer = exchange_optimizer(
                     self.optimizer, self.mesh, exchange, zero1=True)
-            else:
+            elif zero == 1:
                 # Wrap LAST, outside clip/EMA/weight-decay chains: the
                 # whole chain then runs on shard views (the EMA shadow
                 # and adam moments scatter too — the memory win covers
                 # them all).
                 self.optimizer = zero1_enable(
                     self.optimizer, self.mesh, spec=optimizer,
-                    bucket_mb=self._zero1_bucket_mb)
+                    bucket_mb=self._zero_bucket_mb)
+            else:
+                # Stages 2/3 drive the raw chain on shard views from
+                # inside the step (_make_zero_step); the trainer inits
+                # its state over views directly, so no wrapper at all.
+                self._zero_inner = self.optimizer
         elif exchange.needs_grad_exchange:
             from distkeras_tpu.parallel.exchange import exchange_optimizer
 
@@ -480,7 +591,7 @@ class LMTrainer(CheckpointingBase):
         # collectives.
         dp_local_grads = (n_model == 1 and n_seq == 1 and n_pipe == 1
                           and int(self.mesh.shape["expert"]) == 1
-                          and not fsdp and not zero1
+                          and not fsdp and not zero
                           and not cfg.num_experts)
         if exchange.needs_grad_exchange:
             # Exchange configurations (adasum / EF codecs, zero1 x int8
@@ -496,6 +607,10 @@ class LMTrainer(CheckpointingBase):
         if exchange.sync_every > 1:
             self._step_builder = lambda opt: _make_localsgd_step(
                 cfg, opt, self.mesh, exchange)
+        elif zero >= 2:
+            self._step_builder = lambda opt: _make_zero_step(
+                cfg, opt, self.mesh, self._layout(), stage=zero,
+                grad_accum=grad_accum, probe=self.probe_metrics)
         else:
             self._step_builder = lambda opt: tfm.make_train_step(
                 cfg, opt, grad_accum=grad_accum,
@@ -505,6 +620,23 @@ class LMTrainer(CheckpointingBase):
             p, t, cfg,
             segment_ids=seg,
             **self._fwd_kw)
+        if zero >= 3:
+            # Eval/serve read the params back out of the shard views:
+            # gather per fusion bucket (jit-native all-gather), then
+            # the unchanged nll — one gather per eval chunk, never per
+            # train step.
+            from distkeras_tpu.parallel.collectives import gather_bucket
+
+            base_nll = self._nll_fn
+
+            def nll_views(v, t, seg=None):
+                layout = self._layout()
+                full = layout.unpack(
+                    [gather_bucket(b, self.mesh)
+                     for b in layout.pack_views(v)])
+                return base_nll(full, t, seg)
+
+            self._nll_fn = nll_views
 
     @property
     def _feed_block(self) -> int:
@@ -513,6 +645,22 @@ class LMTrainer(CheckpointingBase):
         by construction); 1 = a flat [B, S+1] batch."""
         return (self.grad_accum if self.grad_accum > 1
                 else self.exchange.sync_every)
+
+    def _layout(self):
+        """The ZeRO fusion-bucket layout of this config's parameter
+        tree (shapes only — eval_shape, nothing materializes); one
+        geometry shared by the step builder, the view conversion, the
+        eval gather and the sharding rules."""
+        if self._zero_layout_cache is None:
+            from distkeras_tpu.parallel.collectives import Zero1Layout
+
+            shapes = jax.eval_shape(
+                lambda: tfm.init_params(jax.random.key(self.seed),
+                                        self.cfg))
+            self._zero_layout_cache = Zero1Layout.for_tree(
+                shapes, int(self.mesh.shape["data"]),
+                self._zero_bucket_mb)
+        return self._zero_layout_cache
 
     def _dp_local_value_and_grad(self):
         """``jax.value_and_grad`` replacement for the replicated-DP
@@ -700,11 +848,18 @@ class LMTrainer(CheckpointingBase):
         momentum buffers) take the params' shardings; everything else
         (step counters) is replicated.
 
-        Under ``zero1`` the optimizer state instead holds ``[n, cols]``
-        shard views and takes the shared shard-view sharding rule
-        (``collectives.zero1_state_shardings``).
+        Under the ZeRO stages the optimizer state instead holds
+        ``[n, cols]`` shard views and takes the shared shard-view rule
+        (``parallel/rules.py``); at stage 3 ``params`` is itself the
+        view tree and scatters ``P("data", None)`` per leaf.
         """
-        psh = self.plan.tree_shardings(self.mesh, params)
+        if self.zero >= 3:
+            from distkeras_tpu.parallel.rules import (
+                zero3_param_shardings)
+
+            psh = zero3_param_shardings(params, self.mesh)
+        else:
+            psh = self.plan.tree_shardings(self.mesh, params)
         rep = NamedSharding(self.mesh, P())
         if self.exchange.needs_grad_exchange:
             # Exchange state: error-feedback residuals shard over
@@ -715,7 +870,7 @@ class LMTrainer(CheckpointingBase):
 
             return psh, exchange_state_shardings(
                 params, opt_state, self.mesh, zero1=self.zero1)
-        if self.zero1:
+        if self.zero:
             from distkeras_tpu.parallel.collectives import (
                 zero1_state_shardings)
 
@@ -729,6 +884,48 @@ class LMTrainer(CheckpointingBase):
         osh = jax.tree.map(lambda x: psh if params_like(x) else rep,
                            opt_state, is_leaf=params_like)
         return psh, osh
+
+    def _build_carry_and_step(self, params):
+        """Committed carry + THE jitted step for this configuration:
+        ``(params, opt_state, psh, osh, step, step_sh, tok_sh)`` —
+        ``train()``'s construction, also reached by ``bench_suite.py
+        zero_stages`` so the bench times the exact program users train.
+
+        Optimizer state must be *committed* to the mesh: fresh eager
+        arrays are uncommitted (jit may reshard them freely) but the
+        checkpoint-restore template takes each leaf's sharding
+        literally, so adam's scalar count would come back pinned to
+        one device while params span the mesh — an invalid mix.  Built
+        under jit with explicit out_shardings (structure from
+        eval_shape): eager optax init on params spanning
+        non-addressable devices would fail multi-process.
+        """
+        if self.zero >= 2:
+            # Stages 2/3 run the raw chain on shard views: the state
+            # inits over the view tree (scattered moments), and at
+            # stage 3 the persistent params themselves convert to the
+            # ``[n, cols]`` view layout here — the carry trains as
+            # views end to end.
+            layout = self._layout()
+
+            def init_views(p):
+                return self.optimizer.init(layout.shard_views(p))
+
+            opt_shapes = jax.eval_shape(init_views, params)
+            carry_struct = (jax.eval_shape(layout.shard_views, params)
+                            if self.zero >= 3 else params)
+            psh, osh = self._state_shardings(carry_struct, opt_shapes)
+            opt_state = jax.jit(init_views, out_shardings=osh)(params)
+            if self.zero >= 3:
+                params = jax.jit(layout.shard_views,
+                                 out_shardings=psh)(params)
+        else:
+            opt_shapes = jax.eval_shape(self.optimizer.init, params)
+            psh, osh = self._state_shardings(params, opt_shapes)
+            opt_state = jax.jit(self.optimizer.init,
+                                out_shardings=osh)(params)
+        step, step_sh, tok_sh = self._jit_train_step(psh, osh)
+        return params, opt_state, psh, osh, step, step_sh, tok_sh
 
     def _jit_train_step(self, psh, osh):
         """Build THE jitted optimizer step for this configuration —
@@ -811,19 +1008,27 @@ class LMTrainer(CheckpointingBase):
         params = jax.eval_shape(
             lambda: tfm.init_params(jax.random.key(self.seed),
                                     self.cfg))
-        opt_state = jax.eval_shape(self.optimizer.init, params)
+        pbytes = int(sum(np.prod(v.shape) * v.dtype.itemsize
+                         for v in jax.tree.leaves(params)))
+        if self.zero >= 2:
+            layout = self._layout()
+            opt_state = jax.eval_shape(
+                lambda p: self.optimizer.init(layout.shard_views(p)),
+                params)
+            if self.zero >= 3:
+                params = jax.eval_shape(layout.shard_views, params)
+        else:
+            opt_state = jax.eval_shape(self.optimizer.init, params)
         psh, osh = self._state_shardings(params, opt_state)
         step, _, _ = self._jit_train_step(psh, osh)
         rng = (jax.random.key(self.seed + 0x5eed)
                if self.cfg.dropout > 0 else None)
         name = type(self).__name__.lower()
-        variant = ("zero1" if self.zero1
+        variant = (f"zero{self.zero}" if self.zero
                    else "fsdp" if self.fsdp else "dp")
         if not self.exchange.is_default:
             label = self.exchange.label()
             variant = f"zero1_{label}" if self.zero1 else label
-        pbytes = int(sum(np.prod(v.shape) * v.dtype.itemsize
-                         for v in jax.tree.leaves(params)))
         # Shapes are the GLOBAL avals the jitted step consumes — the
         # same for every process count (multi-process hosts each feed
         # a block that _global_batch assembles into these).
@@ -960,19 +1165,8 @@ class LMTrainer(CheckpointingBase):
         try:
             if params is None:
                 params = self.init_params()
-            # Optimizer state must be *committed* to the mesh: fresh
-            # eager arrays are uncommitted (jit may reshard them freely)
-            # but the checkpoint-restore template takes each leaf's
-            # sharding literally, so adam's scalar count would come back
-            # pinned to one device while params span the mesh — an
-            # invalid mix.  Built under jit with explicit out_shardings
-            # (structure from eval_shape): eager optax init on params
-            # spanning non-addressable devices would fail multi-process.
-            opt_shapes = jax.eval_shape(self.optimizer.init, params)
-            psh, osh = self._state_shardings(params, opt_shapes)
-            opt_state = jax.jit(self.optimizer.init,
-                                out_shardings=osh)(params)
-            step, step_sh, tok_sh = self._jit_train_step(psh, osh)
+            (params, opt_state, psh, osh, step, step_sh,
+             tok_sh) = self._build_carry_and_step(params)
             dropping = self.cfg.dropout > 0
             # Dropout stream keyed on the optimizer round: resume from a
             # checkpoint replays the identical mask sequence.
@@ -1157,21 +1351,21 @@ class LMTrainer(CheckpointingBase):
                     pass
             self._close_checkpoints()
         params, opt_state = carry
+        if self.zero >= 3:
+            # The carry trained as shard views; hand the user back a
+            # params-layout tree (one gather per bucket, end of run).
+            params = self._layout().unview(params)
         if self._ema:
             # Under a grad-exchange wrapper the state nests one level
             # deeper: (ema_state, ExchangeState).
             ema_src = (opt_state[0] if self.exchange.needs_grad_exchange
                        else opt_state)
             self._ema_params = ema_src[1]
-            if self.zero1:
+            if self.zero:
                 # The shadow rode the optimizer state as scattered
                 # shard views; hand the user back a params-layout tree.
-                from distkeras_tpu.parallel.collectives import Zero1Layout
-
-                layout = Zero1Layout.for_tree(
-                    params, int(self.mesh.shape["data"]),
-                    self._zero1_bucket_mb)
-                self._ema_params = layout.unview(self._ema_params)
+                self._ema_params = self._layout().unview(
+                    self._ema_params)
         jax.block_until_ready(jax.tree.leaves(params)[0])
         self.history = [float(l) for l in losses]
         # Probe scalars and the exchange residual diagnostic retire in
@@ -1234,13 +1428,13 @@ class LoRATrainer(LMTrainer):
                 "adapter-masked optimizer state cannot shadow the "
                 "frozen base; serve the merged tree train() returns "
                 "(or EMA-average adapters outside the trainer)")
-        if kw.get("zero1"):
+        if kw.get("zero1") or kw.get("zero"):
             raise ValueError(
-                "zero1 is not supported on LoRATrainer: the masked "
-                "packed (adapters, base) state keeps moments only for "
-                "the ~1000x-smaller adapter leaves, so there is nothing "
-                "worth sharding — and the frozen base must stay whole "
-                "for the in-step merge")
+                "zero1/zero= is not supported on LoRATrainer: the "
+                "masked packed (adapters, base) state keeps moments "
+                "only for the ~1000x-smaller adapter leaves, so there "
+                "is nothing worth sharding — and the frozen base must "
+                "stay whole for the in-step merge")
         if (kw.get("merge_rule", "mean") != "mean"
                 or kw.get("sync_every", 1) != 1
                 or kw.get("compress") is not None

@@ -417,6 +417,9 @@ def _session_obs_live_sanitized():
         + "\n".join(v.format() for v in vs)
 
 
+# NOTE: new sessions append at the END — inserting one mid-dict would
+# shift every later session's warm-cache delta budget (module
+# docstring).
 SESSIONS = {
     "adag": lambda: session_adag(),
     "adag_zero1": lambda: session_adag(zero1=True),
@@ -435,6 +438,16 @@ SESSIONS = {
     "serving_prefix_pool": session_serving_prefix_pool,
     "spec_prefix": session_spec_prefix,
     "obs_live": session_obs_live,
+    # ZeRO-2/3 (docs/zero1.md): the in-scan scattered accumulator and
+    # the gather-on-use view carry must each stay ONE step program —
+    # an extra program here means a stage started recompiling per
+    # round (e.g. the view layout stopped being trace-stable).  The
+    # codec-rules session pins the per-bucket (topk + int8) exchange
+    # to one program likewise.
+    "adag_zero2": lambda: session_adag(zero=2),
+    "lm_zero3": lambda: session_lm(zero=3),
+    "lm_codec_rules": lambda: session_lm(
+        compress=(("emb", "topk"), (".*", "int8"))),
 }
 
 

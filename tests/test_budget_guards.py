@@ -12,6 +12,7 @@ import pytest
 
 from distkeras_tpu.analysis import ir_lint
 from distkeras_tpu.analysis.targets import (ZERO1_PARITY_PAIRS,
+                                             ZERO_PARITY_TARGETS,
                                              default_targets)
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -31,12 +32,16 @@ def linted():
 def test_standard_targets_cover_every_family(linted):
     names = set(linted)
     for required in ("adag_dp/accum_step", "adag_zero1/accum_step",
+                     "adag_zero2/accum_step", "adag_zero3/accum_step",
                      "adag_adasum/accum_step",
                      "adag_localsgd4/accum_step",
                      "lmtrainer_dp/train_step",
                      "lmtrainer_zero1/train_step",
+                     "lmtrainer_zero2/train_step",
+                     "lmtrainer_zero3/train_step",
                      "lmtrainer_fsdp/train_step",
                      "lmtrainer_int8ef/train_step",
+                     "lmtrainer_rulesef/train_step",
                      "lmtrainer_zero1_int8ef/train_step",
                      "continuousbatcher_per_request/decode_step",
                      "speculativebatcher_sampled/step"):
@@ -69,23 +74,32 @@ def test_adag_zero1_compiled_wire_equals_dp(linted):
     assert dp == z1 > 0
 
 
-def test_zero1_parity_proof_for_both_families(linted):
-    """The acceptance check: for ADAG and LMTrainer, the zero1 step's
-    DECLARED exchange is pad-free (RS == AG == parameter bytes), hence
-    by the ring identity RS+AG moves exactly the gradient all-reduce's
-    wire bytes — asserted against each DP partner's compiled census."""
-    for z1_name, dp_name in ZERO1_PARITY_PAIRS:
-        spec = linted[z1_name][0]
+def test_zero_parity_proof_every_stage_both_families(linted):
+    """The acceptance check, extended to stages 2/3: for ADAG and
+    LMTrainer at every ZeRO stage, the step's DECLARED exchange is
+    pad-free (scatter == gather == parameter bytes per program
+    occurrence: stage 1's RS+AG, stage 2's in-scan accumulator RS +
+    update AG, stage 3's gather-on-use AG + backward grad RS), hence
+    by the ring identity the per-round wire never exceeds the gradient
+    all-reduce it replaces — asserted against each DP partner's
+    compiled census."""
+    for z_name, dp_name, _stage in ZERO_PARITY_TARGETS:
+        spec = linted[z_name][0]
         findings = ir_lint.check_zero1_parity(spec, linted[dp_name][2])
         gating = [f.format() for f in findings if f.gating]
-        assert not gating, (z1_name, gating)
+        assert not gating, (z_name, gating)
 
 
 def test_declared_exchange_measures_param_bytes(linted):
-    for z1_name, _dp in ZERO1_PARITY_PAIRS:
-        spec = linted[z1_name][0]
-        decl = ir_lint.declared_zero1_exchange(spec)
+    for z_name, _dp, stage in ZERO_PARITY_TARGETS:
+        spec = linted[z_name][0]
+        assert spec.zero_stage == stage
+        decl = ir_lint.declared_zero_exchange(spec)
         assert decl["rs_bytes"] == decl["ag_bytes"] == spec.params_bytes
+    # Stage-1 pairs keep their historical spelling too.
+    assert ZERO1_PARITY_PAIRS == (
+        ("adag_zero1/accum_step", "adag_dp/accum_step"),
+        ("lmtrainer_zero1/train_step", "lmtrainer_dp/train_step"))
 
 
 def test_lm_dp_tied_embedding_grads_summed_before_exchange(linted):
@@ -130,6 +144,42 @@ def test_int8ef_cuts_gradient_wire_to_quarter(linted):
     # must appear in the compiled program too.
     z1ef = linted["lmtrainer_zero1_int8ef/train_step"][2]
     assert any("s8" in c.dtype for c in z1ef)
+
+
+def test_codec_rules_census_pins_per_bucket_wire_dtypes(linted):
+    """The per-bucket codec rules claim, from the COMPILED census: the
+    (emb -> topk, .* -> int8) LM exchange moves an s8 payload for the
+    int8 buckets AND the top-k (values, indices) legs for the
+    embedding bucket — both wire dtypes visible in one program, which
+    a uniform codec can never produce."""
+    census = linted["lmtrainer_rulesef/train_step"][2]
+    dtypes = {c.dtype for c in census}
+    assert any("s8" in d for d in dtypes), dtypes      # int8 buckets
+    assert any("s32" in d for d in dtypes), dtypes     # top-k indices
+    # The s8 payload must be the dominant gradient wire (dense
+    # leaves), the top-k legs the small remainder.
+    s8 = sum(c.wire_bytes for c in census if "s8" in c.dtype)
+    assert s8 > 0
+
+
+def test_zero3_census_has_no_update_gather(linted):
+    """Stage 3's structural claim from the compiled census: the
+    gather-on-use program all-gathers the PARAMETERS (per fusion
+    bucket, gradient-sized payloads) but has no update all-gather leg
+    beyond them — params stay scattered across steps — while stage 1's
+    program gathers the packed update as one fused ``[n, P/n]``
+    payload.  Pinned: zero3's largest all-gather payload is a bucket,
+    not the whole packed update."""
+    z1 = linted["adag_zero1/accum_step"][2]
+    z3 = linted["adag_zero3/accum_step"][2]
+    z1_ag = max(c.payload_bytes for c in z1 if c.op == "all-gather")
+    z3_ag = max(c.payload_bytes for c in z3 if c.op == "all-gather")
+    P = linted["adag_zero3/accum_step"][0].params_bytes
+    assert z1_ag == P          # stage 1: one packed update gather
+    assert z3_ag < P, (z3_ag, P)  # stage 3: bucket-granular param AGs
+    ag_total = sum(c.payload_bytes * c.count for c in z3
+                   if c.op == "all-gather")
+    assert ag_total == P       # ...that together cover the params once
 
 
 def test_localsgd_quarters_per_step_collective_count(linted):

@@ -356,13 +356,25 @@ def test_zero1_plan_spelling_matches_flag(devices, blobs):
 
 
 def test_custom_transform_warns(blobs):
+    """A prebuilt transform the inspector cannot attribute warns; a
+    bare optax.adam is now RECOGNIZED elementwise by closure
+    inspection (ops/optimizers.zero1_compatible) and constructs
+    silently — the round-12 construction-time check upgrade."""
+    import warnings
+
     from helpers import make_mlp
 
+    opaque = optax.GradientTransformation(
+        lambda p: (), lambda g, s, p=None: (g, s))
     with pytest.warns(UserWarning, match="elementwise"):
+        dk.ADAG(make_mlp(), worker_optimizer=opaque, zero1=True)
+    with pytest.warns(UserWarning, match="elementwise"):
+        dk.LMTrainer(CFG, optimizer=opaque, zero1=True)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
         dk.ADAG(make_mlp(), worker_optimizer=optax.adam(1e-3),
                 zero1=True)
-    with pytest.warns(UserWarning, match="elementwise"):
-        dk.LMTrainer(CFG, optimizer=optax.adam(1e-3), zero1=True)
+        assert not [x for x in w if "elementwise" in str(x.message)]
 
 
 def test_exports():
@@ -373,5 +385,9 @@ def test_exports():
                                               zero1_compatible)
 
     assert zero1_compatible("adamw") is True
-    assert zero1_compatible(optax.adam(1e-3)) is None
+    # Round 12: a bare prebuilt adam is recognized elementwise by
+    # closure inspection; an unattributable transform stays None.
+    assert zero1_compatible(optax.adam(1e-3)) is True
+    assert zero1_compatible(optax.GradientTransformation(
+        lambda p: (), lambda g, s, p=None: (g, s))) is None
     assert "sgd" in ZERO1_ELEMENTWISE
