@@ -87,8 +87,8 @@ from distkeras_tpu.resilience import (ClusterMember, ClusterSupervisor,
                                        EngineClosed, FaultPlan, Preempted,
                                        QueueFull, RequestResult,
                                        Supervisor)
-from distkeras_tpu.serving import (ContinuousBatcher, PrefixPool,
-                                   SpeculativeBatcher)
+from distkeras_tpu.serving import (ContinuousBatcher, PagedBatcher,
+                                   PrefixPool, SpeculativeBatcher)
 from distkeras_tpu.evaluators import (Evaluator, AccuracyEvaluator,
                                        PerplexityEvaluator)
 from distkeras_tpu.predictors import Predictor, ModelPredictor
@@ -166,6 +166,7 @@ __all__ = [
     "EnsembleTrainer",
     "LMTrainer",
     "ContinuousBatcher",
+    "PagedBatcher",
     "SpeculativeBatcher",
     "PrefixPool",
     "LoRATrainer",

@@ -129,13 +129,17 @@ def serving_targets() -> list[TraceSpec]:
     cbp = dk.ContinuousBatcher(params, cfg, lanes=2,
                                prompt_buckets=(8,), prefill_chunk=8,
                                prefix_pool=pool)
+    # Paged engine (round 12): the page-table-gather decode step and
+    # the block-scatter admission program.
+    pgd = dk.PagedBatcher(params, cfg, lanes=2, block=4, n_blocks=9,
+                          prompt_buckets=(8,))
     draft = tfm.TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
                                   n_layers=1, d_ff=32, max_len=16)
     dparams = tfm.init_params(jax.random.key(1), draft)
     sb = dk.SpeculativeBatcher(params, dparams, cfg, draft, lanes=2,
                                n_draft=2, temperature=0.7)
     return (cb.traced_for_analysis() + cbp.traced_for_analysis()
-            + sb.traced_for_analysis())
+            + pgd.traced_for_analysis() + sb.traced_for_analysis())
 
 
 def _pair(specs: list[TraceSpec]) -> list[TraceSpec]:

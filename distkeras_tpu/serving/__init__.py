@@ -19,7 +19,13 @@ did against the old ``serving.py``.  Layout:
 - :mod:`~distkeras_tpu.serving.elastic` — elastic lane tiers
   (pre-compiled load-driven resizing).
 - :mod:`~distkeras_tpu.serving.prefix` — :class:`PrefixPool`, the
-  refcounted multi-prefix KV pool (round 10).
+  refcounted multi-prefix KV pool (round 10), and
+  :class:`PinnedStems`, the paged engine's pinned-prefix bookkeeping
+  (round 12).
+- :mod:`~distkeras_tpu.serving.paged` — :class:`PagedBatcher` +
+  :class:`BlockAllocator`: block-granular paged KV with per-lane page
+  tables, content-hash stem sharing, and copy-on-write lane forks
+  (round 12).
 
 The reference has no serving story at all (its ModelPredictor runs the
 training forward over a static batch — reference:
@@ -38,13 +44,17 @@ from distkeras_tpu.serving.admission import (EngineClosed, QueueFull,
                                              RequestResult)
 from distkeras_tpu.serving.lanes import (KV_INT8_LANE_ADVISORY,
                                          ContinuousBatcher)
-from distkeras_tpu.serving.prefix import PrefixPool
+from distkeras_tpu.serving.paged import BlockAllocator, PagedBatcher
+from distkeras_tpu.serving.prefix import PinnedStems, PrefixPool
 from distkeras_tpu.serving.speculative import SpeculativeBatcher
 
 __all__ = [
     "ContinuousBatcher",
     "SpeculativeBatcher",
+    "PagedBatcher",
+    "BlockAllocator",
     "PrefixPool",
+    "PinnedStems",
     "RequestResult",
     "QueueFull",
     "EngineClosed",

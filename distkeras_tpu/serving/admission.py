@@ -83,6 +83,12 @@ class _AdmissionMixin:
         # that pass a ttl reach their structured timeout via poll/take
         # instead of the pop-everything results().
         self.last_request_id: int | None = None
+        # Why the most recent submit() declined (None after a success):
+        # "no_free_lane", or "kv_blocks" — the paged engine's
+        # allocator-exhausted signal, which enqueue/pump treat as
+        # QUEUE backpressure (blocks free as lanes drain) instead of
+        # inventing a timeout.
+        self._decline_reason: str | None = None
 
     def _deadline_of(self, ttl, deadline):
         """Resolve submit/enqueue's ``ttl`` (seconds from now) /
@@ -164,12 +170,19 @@ class _AdmissionMixin:
         self._next_id += 1
         return rid
 
-    def _decline_full(self) -> None:
-        """Engine-full decline: no request was registered, so a stale
-        ``last_request_id`` must not masquerade as this request's."""
+    def _decline(self, reason: str) -> None:
+        """Record a submit() decline: no request was registered, so a
+        stale ``last_request_id`` must not masquerade as this
+        request's; enqueue/pump read ``_decline_reason`` to tell a
+        storage decline (retryable backpressure) from a deadline
+        expiry."""
+        self._decline_reason = reason
         if not self._admitting_internal:
-            obs.count("serving.rejected", reason="no_free_lane")
+            obs.count("serving.rejected", reason=reason)
             self.last_request_id = None
+
+    def _decline_full(self) -> None:
+        self._decline("no_free_lane")
 
     def enqueue(self, prompt, max_new_tokens: int, ttl=None, deadline=None,
                 **submit_kw) -> int:
@@ -203,12 +216,7 @@ class _AdmissionMixin:
         """
         with self._admission_lock:
             self._check_open()
-            prompt = np.asarray(prompt, np.int32).reshape(-1)
-            if prompt.size < 1:
-                raise ValueError("prompt must contain at least one token")
-            if max_new_tokens < 1:
-                raise ValueError(
-                    f"max_new_tokens must be >= 1, got {max_new_tokens}")
+            prompt = self._validate_request_args(prompt, max_new_tokens)
             self._validate_budget(prompt.size, max_new_tokens,
                                   **self._budget_kw(submit_kw))
             dl = self._deadline_of(ttl, deadline)
@@ -236,15 +244,29 @@ class _AdmissionMixin:
                 if self._admit_pending(pend):
                     self._bp_strikes = 0
                     return rid
-                # A lane was free, so the only way submit declined is
-                # the deadline expiring between our check and its
-                # re-check.
-                self._finish(rid, prompt, "timeout", prompt.size,
-                             born=pend.born)
-                return rid
+                # A lane was free, so submit declined either because
+                # the deadline expired between our check and its
+                # re-check, or (paged engines) because the KV-block
+                # allocator is exhausted — the latter queues like any
+                # other backpressure (blocks free as lanes drain).
+                if self._decline_reason != "kv_blocks":
+                    self._finish(rid, prompt, "timeout", prompt.size,
+                                 born=pend.born)
+                    return rid
             while len(self._pending) >= self.max_queue:
                 if not self._try_scale_up():
                     obs.count("serving.rejected", reason="queue_full")
+                    if self._decline_reason == "kv_blocks":
+                        # Name the REAL bottleneck: lanes may well be
+                        # free — the paged allocator is what's dry,
+                        # and "raise max_queue" would tune the wrong
+                        # knob.
+                        raise QueueFull(
+                            f"KV block allocator exhausted and the "
+                            f"admission queue holds "
+                            f"{len(self._pending)}/{self.max_queue} "
+                            "requests; shed load, raise n_blocks, or "
+                            "bound request budgets")
                     raise QueueFull(
                         f"all {self.lanes} lanes busy and the "
                         f"admission queue holds {len(self._pending)}/"
@@ -256,9 +278,10 @@ class _AdmissionMixin:
                 if self.free_lanes() and not self._pending:
                     if self._admit_pending(pend):
                         return rid
-                    self._finish(rid, prompt, "timeout", prompt.size,
-                                 born=pend.born)
-                    return rid
+                    if self._decline_reason != "kv_blocks":
+                        self._finish(rid, prompt, "timeout",
+                                     prompt.size, born=pend.born)
+                        return rid
             self._bp_strikes = 0
             self._pending.append(pend)
             obs.gauge("serving.queue_depth", len(self._pending))
@@ -304,6 +327,7 @@ class _AdmissionMixin:
 
     def _admit_pending(self, pend) -> bool:
         self._admit_rid = pend.request_id
+        self._decline_reason = None
         try:
             lane = self.submit(pend.prompt, pend.max_new,
                                deadline=pend.deadline, **pend.submit_kw)
@@ -360,6 +384,12 @@ class _AdmissionMixin:
                 continue
             if ok:
                 admitted.append(pend.request_id)
+            elif self._decline_reason == "kv_blocks":
+                # Allocator exhausted (paged engine): the request
+                # stays at the queue HEAD — blocks free as running
+                # lanes drain, and FIFO order must hold.
+                self._pending.appendleft(pend)
+                break
             else:
                 # Free lane + declined admission == the deadline
                 # expired between pump's check and submit's re-check.
@@ -453,8 +483,21 @@ class _AdmissionMixin:
                 # Queue blocked behind finished-but-undrained manual
                 # lanes: stepping cannot make progress.
                 break
+            free_before = bool(self.free_lanes())
+            backlog = len(self._pending)
             self.step()
             steps += 1
+            if (free_before and not self.running() and self._pending
+                    and len(self._pending) == backlog):
+                # Free lanes went into the step, yet the queue head
+                # still could not admit and nothing is decoding —
+                # storage starvation (e.g. a paged engine whose blocks
+                # are all pinned): stepping again cannot make progress
+                # either, so fall through to cancellation instead of
+                # spinning.  (``free_before`` matters: lanes freed by
+                # THIS step's reap get their pump on the next
+                # iteration, which must run.)
+                break
         for pend in self._pending:
             self._finish(pend.request_id, pend.prompt, "cancelled",
                          pend.prompt.size, born=pend.born)

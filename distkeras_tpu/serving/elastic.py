@@ -24,7 +24,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu import obs
-from distkeras_tpu.models.generate import init_cache
 
 
 class _ElasticMixin:
@@ -122,8 +121,10 @@ class _ElasticLanesMixin:
         """A dummy device state at ``tier`` lanes with EXACTLY the live
         state's avals — the warmup vehicle that populates the jit
         caches every tier will hit.  Returned in step-argument order
-        ``(cache, cur, pos, keys, temps, tps, mps)``."""
-        cache = init_cache(self.cfg, tier, kv_int8=self.kv_int8)
+        ``(cache, cur, pos, keys, temps, tps, mps)`` — the cache comes
+        from the engine's ``_fresh_cache`` layout hook, so the paged
+        engine's warmup dummies are block slabs like its live state."""
+        cache = self._fresh_cache(tier)
         cur = jnp.zeros((tier,), jnp.int32)
         pos = jnp.zeros((tier,), jnp.int32)
         keys = (jnp.stack([jax.random.key(0)] * tier) if self._keyed
@@ -144,13 +145,23 @@ class _ElasticLanesMixin:
         every declared step window, every admission bucket (seeded —
         prefix-pool gather included — and, under chunked prefill, the
         continuation program per bucket), the prefix reseed, and the
-        tiny host-scatter programs ``submit`` touches."""
+        tiny host-scatter programs ``submit`` touches.  Split into the
+        three stages below (round 12) so the paged engine can swap
+        the step/admission halves — its programs take page tables —
+        while the shell and the host-scatter warmers stay shared."""
+        self._warm_steps(tier)
+        self._warm_admission(tier)
+        self._warm_host_writes(tier)
+
+    def _warm_steps(self, tier: int) -> None:
         for n in self._step_windows:
             if n not in self._steps:
                 self._steps[n] = self._make_step(n)
         for n in self._step_windows:
             # The step donates its cache: a fresh dummy per window.
             self._steps[n](*self._tier_state(tier))
+
+    def _warm_admission(self, tier: int) -> None:
         pool = self._prefix_pool
         for width in self._buckets:
             rows = jnp.zeros((1, width), jnp.int32)
@@ -169,6 +180,8 @@ class _ElasticLanesMixin:
         if pool is not None:
             self._reseed_pool(self._tier_state(tier)[0], jnp.int32(0),
                               pool.slab, jnp.int32(0))
+
+    def _warm_host_writes(self, tier: int) -> None:
         # submit()'s host bookkeeping (lane-slot writes) specializes
         # per tier too — tiny scatters, but a compile is a compile.
         ints = jnp.zeros((tier,), jnp.int32)

@@ -163,7 +163,8 @@ class _LaneEngine(_AdmissionMixin, _ElasticMixin):
     def _vacate(self, lane) -> None:
         """THE one lane-release path (drain, reap, eviction, shutdown
         cancellation): frees the lane slot, drops it from the chunked-
-        admission queue, and releases its prefix-pool pin."""
+        admission queue, releases its prefix-pool pin, and hands the
+        lane's storage back through :meth:`_release_lane_storage`."""
         st = self._lane_state[lane]
         self._lane_state[lane] = None
         if st is None:
@@ -175,6 +176,25 @@ class _LaneEngine(_AdmissionMixin, _ElasticMixin):
                 pass
         if st.prefix_id is not None and self._prefix_pool is not None:
             self._prefix_pool.release(st.prefix_id)
+        self._release_lane_storage(lane, st)
+
+    def _release_lane_storage(self, lane, st) -> None:
+        """Storage-layout hook of :meth:`_vacate`: monolithic engines
+        own a fixed cache row per lane (nothing to release); the paged
+        engine drops the lane's block references here — the ONE place,
+        so no eviction path can leak a block."""
+
+    def _validate_request_args(self, prompt, max_new_tokens: int):
+        """The prompt/budget checks every engine's submit() runs —
+        one definition (ContinuousBatcher and SpeculativeBatcher must
+        not drift); returns the canonicalized 1-D int32 prompt."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        return prompt
 
     def _emit(self, lane_tokens):
         """Feed each live lane's new tokens (``lane_tokens(lane)``)

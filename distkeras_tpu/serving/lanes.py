@@ -216,6 +216,13 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
             lanes = _tiers[0]
         if lanes < 1:
             raise ValueError(f"lanes must be >= 1, got {lanes}")
+        if not isinstance(kv_int8, bool):
+            # PagedBatcher validates its own tri-state and passes a
+            # bool down; a string reaching a monolithic engine would
+            # otherwise silently truthy-coerce into plain int8.
+            raise ValueError(
+                f"kv_int8 must be a bool here (got {kv_int8!r}); "
+                'kv_int8="prefill" is a PagedBatcher admission mode')
         if prompt_cache is not None and prefix_pool is not None:
             raise ValueError(
                 "pass prompt_cache (ONE engine-level prefix, baked "
@@ -343,7 +350,7 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
         # prefill() which attends the prompt in full precision.
         # (Stored for introspection only, like ``lanes``; the runtime
         # switch is the ``k_scale`` leaf in ``self.cache``.)
-        self.kv_int8 = kv_int8
+        self.kv_int8 = bool(kv_int8)
         if kv_int8 and max(_tiers or (lanes,)) < KV_INT8_LANE_ADVISORY:
             # Construction-time advisory (round-10 satellite): at small
             # lane counts decode is weight-bound and the int8 cache is
@@ -360,25 +367,91 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
             obs.event("serving.advisory", kind="kv_int8_small_lanes",
                       lanes=max(_tiers or (lanes,)), detail=msg)
         self.per_request_sampling = per_request_sampling
-        self.cache = init_cache(cfg, lanes, kv_int8=kv_int8)
+        # Engine-level sampling statics the compiled step closes over
+        # (stored so the paged subclass's step factory reuses the ONE
+        # per-token body — see _build_one_step).
+        self.top_k = top_k
+        self.exact_top_k = exact_top_k
+        self._init_device_state(lanes)
+        self._one_step = self._build_one_step()
+        self._steps = {}
+        self._build_admission_programs()
+
+        if self.lane_tiers is not None:
+            def resize(cache, cur, pos, keys, temps, tps, mps, idx):
+                # Gather lanes idx[j] -> j across the WHOLE device
+                # state; jit specializes one program per (from, to)
+                # tier pair, all warmed below.
+                cache = jax.tree.map(
+                    lambda a: jnp.take(a, idx, axis=1), cache)
+                g = lambda a: jnp.take(a, idx, axis=0)
+                return (cache, g(cur), g(pos), g(keys), g(temps),
+                        g(tps), g(mps))
+
+            # No donation: the gathered output has a different lane
+            # count, so nothing could be reused in place anyway (and
+            # XLA would warn on every tier pair).
+            self._resize = jax.jit(resize)
+            self._compile_tiers()
+        elif (prefill_chunk is not None or self._prefix_pool is not None
+                or self._always_warm):
+            # Chunked/pooled engines make the elastic construction-time
+            # promise too: every admission bucket (seeded + chunk
+            # continuation + pool gather) and every DECLARED step
+            # window compiles here, so the serve phase is recompile-
+            # free (the serving_chunked / serving_prefix_pool compile
+            # sessions assert it).  Undeclared step(n) windows still
+            # compile lazily, as on a plain engine.  Engines that set
+            # ``_always_warm`` (the paged engine) take this path
+            # unconditionally — every one of their programs is built
+            # here or nowhere.
+            with obs.span("serving.compile_warm", lanes=lanes):
+                self._warm_tier(lanes)
+
+    # ----------------------------------------- device-state factories
+    #
+    # Split out of __init__ (round 12) so the paged engine
+    # (serving/paged.py) can swap the STORAGE — a block slab + page
+    # tables instead of the monolithic [lanes, max_len] cache — while
+    # the host machinery, the per-token sampling body, and therefore
+    # the exact-parity contract stay literally shared.
+
+    # Engines that must compile every program at construction even
+    # without chunked prefill / a pool / tiers (the paged engine).
+    _always_warm = False
+
+    def _fresh_cache(self, lanes: int):
+        """A zeroed KV store for ``lanes`` decode rows — the ONE
+        cache-layout decision point (monolithic here; the paged
+        engine overrides with its block slab)."""
+        return init_cache(self.cfg, lanes, kv_int8=self.kv_int8)
+
+    def _init_device_state(self, lanes: int) -> None:
+        self.cache = self._fresh_cache(lanes)
+        self._init_lane_rows(lanes)
+
+    def _init_lane_rows(self, lanes: int) -> None:
+        """Per-lane row state shared by every storage layout: next
+        position, current token, PRNG key, per-request sampling
+        params."""
         self.pos = jnp.zeros((lanes,), jnp.int32)
         self.cur = jnp.zeros((lanes,), jnp.int32)
-        sampling = temperature > 0 or per_request_sampling
+        sampling = self.temperature > 0 or self.per_request_sampling
         self.keys = (jnp.stack([jax.random.key(0)] * lanes)
                      if sampling else None)
         # Per-lane sampling params (per_request_sampling only):
         # constructor values are the defaults; submit() overrides the
         # admitted lane's slots.  top_p 1.0 / min_p 0.0 are exact
         # no-ops in the row-wise masks.
-        if per_request_sampling:
+        if self.per_request_sampling:
             # Explicit dtype: weak-typed f32 and plain f32 are distinct
             # jit avals, and the elastic warmup's dummy states must hit
             # the exact programs the live state will use.
-            self.temps = jnp.full((lanes,), float(temperature),
+            self.temps = jnp.full((lanes,), float(self.temperature),
                                   jnp.float32)
-            self.tps = jnp.full((lanes,), float(top_p or 1.0),
+            self.tps = jnp.full((lanes,), float(self.top_p or 1.0),
                                 jnp.float32)
-            self.mps = jnp.full((lanes,), float(min_p or 0.0),
+            self.mps = jnp.full((lanes,), float(self.min_p or 0.0),
                                 jnp.float32)
         else:
             # Placeholder args keep one step signature across modes
@@ -390,6 +463,19 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
             self._keyed = False
         else:
             self._keyed = True
+
+    def _build_one_step(self):
+        """The per-token decode body over a CONTIGUOUS [lanes, S]
+        cache tree: attention + sampling + position advance.  ONE
+        definition for every storage layout — the monolithic step
+        scans it over the live cache, the paged step scans it over
+        the page-table-gathered view — so emitted tokens cannot drift
+        between the two engines."""
+        cfg = self.cfg
+        per_request_sampling = self.per_request_sampling
+        temperature, top_p, min_p = (self.temperature, self.top_p,
+                                     self.min_p)
+        top_k, exact_top_k = self.top_k, self.exact_top_k
 
         def pick(k, row, q):
             return jax.random.categorical(
@@ -457,66 +543,42 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                        else jnp.minimum(pos + 1, cfg.max_len - 1))
             return cache, nxt.astype(jnp.int32), nxt_pos
 
-        def make_step(n):
-            def step_n(cache, cur, pos, keys, temps, tps, mps):
-                def body(carry, _):
-                    cache, cur, pos = carry
-                    cache, cur, pos = one_step(cache, cur, pos, keys,
-                                               temps, tps, mps)
-                    return (cache, cur, pos), cur
-                (cache, cur, pos), toks = jax.lax.scan(
-                    body, (cache, cur, pos), None, length=n)
-                return cache, cur, pos, toks.T        # [lanes, n]
-            return jax.jit(step_n, donate_argnums=0)
+        return one_step
 
-        self._make_step, self._steps = make_step, {}
+    def _make_step(self, n: int):
+        one_step = self._one_step
 
+        def step_n(cache, cur, pos, keys, temps, tps, mps):
+            def body(carry, _):
+                cache, cur, pos = carry
+                cache, cur, pos = one_step(cache, cur, pos, keys,
+                                           temps, tps, mps)
+                return (cache, cur, pos), cur
+            (cache, cur, pos), toks = jax.lax.scan(
+                body, (cache, cur, pos), None, length=n)
+            return cache, cur, pos, toks.T        # [lanes, n]
+        return jax.jit(step_n, donate_argnums=0)
+
+    def _build_admission_programs(self) -> None:
         # Admission: prefill `width` positions of ONE lane (lane-sliced
         # cache write; padded tail slots stay masked until the decode
         # loop overwrites them).  ONE jitted program per bucket shape —
         # the start offset and pool slot are traced, so every prefix
         # length and chunk offset shares it.
-        pooled = prefix_pool is not None
-        self._admit = _make_lane_admit(self.params, cfg,
+        pooled = self._prefix_pool is not None
+        self._admit = _make_lane_admit(self.params, self.cfg,
                                        prefix_lane=self._prefix_lane,
                                        pooled=pooled)
         # Chunked prefill: the continuation program lands chunk k > 0
         # on the lane's existing cache (no reseed — that would erase
         # the earlier chunks).
-        self._admit_cont = (_make_lane_admit(self.params, cfg,
+        self._admit_cont = (_make_lane_admit(self.params, self.cfg,
                                              seed=False)
-                            if prefill_chunk is not None else None)
+                            if self.prefill_chunk is not None else None)
         self._reseed = (_make_lane_reseed(prefix_lane=self._prefix_lane)
                         if self._prefix_lane is not None else None)
         self._reseed_pool = (_make_lane_reseed(pooled=True)
                              if pooled else None)
-
-        if self.lane_tiers is not None:
-            def resize(cache, cur, pos, keys, temps, tps, mps, idx):
-                # Gather lanes idx[j] -> j across the WHOLE device
-                # state; jit specializes one program per (from, to)
-                # tier pair, all warmed below.
-                cache = jax.tree.map(
-                    lambda a: jnp.take(a, idx, axis=1), cache)
-                g = lambda a: jnp.take(a, idx, axis=0)
-                return (cache, g(cur), g(pos), g(keys), g(temps),
-                        g(tps), g(mps))
-
-            # No donation: the gathered output has a different lane
-            # count, so nothing could be reused in place anyway (and
-            # XLA would warn on every tier pair).
-            self._resize = jax.jit(resize)
-            self._compile_tiers()
-        elif prefill_chunk is not None or pooled:
-            # Chunked/pooled engines make the elastic construction-time
-            # promise too: every admission bucket (seeded + chunk
-            # continuation + pool gather) and every DECLARED step
-            # window compiles here, so the serve phase is recompile-
-            # free (the serving_chunked / serving_prefix_pool compile
-            # sessions assert it).  Undeclared step(n) windows still
-            # compile lazily, as on a plain engine.
-            with obs.span("serving.compile_warm", lanes=lanes):
-                self._warm_tier(lanes)
 
     # ------------------------------------------------------------ API
 
@@ -554,36 +616,93 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                 "or add a finer width")
         return b
 
-    def _chunk_plan(self, off: int, warm: int) -> list:
+    def _chunk_plan(self, off: int, warm: int, skip: int = 0) -> list:
         """The admission plan for ``warm`` prompt tokens decoding past
         ``off`` cached positions: a list of ``(start, width)`` — rows
         are materialized at execution.  Monolithic (one bucket-padded
         chunk at ``off``) unless chunked prefill is on and the warm
         length exceeds the chunk width; then full ``W``-wide chunks on
-        the ``off + k*W`` grid plus a bucket-padded tail whose start
-        backs up so its padded end lands exactly at the warm frontier
-        (re-prefilling the overlap is idempotent — same tokens, same
-        cache prefix, same K/V).  Raises if any padded write would
-        overflow the cache."""
-        if warm == 0:
+        the ``off + skip + k*W`` grid plus a bucket-padded tail whose
+        start backs up so its padded end lands exactly at the warm
+        frontier (re-prefilling the overlap is idempotent — same
+        tokens, same cache prefix, same K/V).  ``skip`` drops the
+        first ``skip`` warm tokens from the plan — the paged engine's
+        stem-sharing admission, whose shared blocks already hold those
+        positions' K/V (the backed-up tail can never reach into the
+        skipped region: its width is at most one chunk, and the
+        chunked branch only runs when more than a chunk remains).
+        Raises if any padded write would overflow the cache."""
+        if warm <= skip:
             return []
         w_chunk = self.prefill_chunk
-        if self._rolling or w_chunk is None or warm <= w_chunk:
-            return [(off, self._bucket_for(warm, off))]
-        m, rem = divmod(warm, w_chunk)
-        plan = [(off + k * w_chunk, w_chunk) for k in range(m)]
+        lo, span = off + skip, warm - skip
+        if self._rolling or w_chunk is None or span <= w_chunk:
+            return [(lo, self._bucket_for(span, lo))]
+        m, rem = divmod(span, w_chunk)
+        plan = [(lo + k * w_chunk, w_chunk) for k in range(m)]
         if plan[-1][0] + w_chunk > self.cfg.max_len:
             raise ValueError(
                 f"chunked admission grid overflows the cache (chunk at "
                 f"{plan[-1][0]} + {w_chunk} > {self.cfg.max_len})")
         if rem:
             # The chunk width is always a bucket (the constructor adds
-            # it), so the smallest bucket >= rem is <= w_chunk < warm:
+            # it), so the smallest bucket >= rem is <= w_chunk < span:
             # the backed-up start always lands inside the grid, never
-            # before off, and its end off + warm fits by budget.
+            # before lo, and its end off + warm fits by budget.
             b = next(w for w in self._buckets if w >= rem)
             plan.append((off + warm - b, b))
         return plan
+
+    def _admission_plan(self, lane, prompt, off: int, warm: int):
+        """Stage lane storage for an admission and return its chunk
+        plan, or None to DECLINE for lack of KV storage (the paged
+        engine's allocator-exhausted signal — surfaced as ``kv_blocks``
+        backpressure by enqueue/pump).  The monolithic engine's storage
+        is the lane row itself, so it never declines here."""
+        del lane, prompt
+        return self._chunk_plan(off, warm)
+
+    def _abort_admission(self, lane) -> None:
+        """Failure between storage staging and lane commit: release
+        whatever _admission_plan staged (no-op for monolithic lanes;
+        the paged engine frees the staged blocks)."""
+
+    def _exec_admit(self, lane, start, rows, slot) -> None:
+        """Execute the FIRST admission chunk (the one that seeds the
+        lane) — ``slot`` is the pinned prefix-pool slot or None."""
+        if slot is not None:
+            self.cache = self._admit(
+                self.cache, jnp.asarray(rows), jnp.int32(lane),
+                jnp.int32(start), self._prefix_pool.slab,
+                jnp.int32(slot))
+        elif self._prefix_pool is not None:
+            # Pooled engine, plain request: the gather program takes
+            # slot -1 = "seed zeros".
+            self.cache = self._admit(
+                self.cache, jnp.asarray(rows), jnp.int32(lane),
+                jnp.int32(start), self._prefix_pool.slab,
+                jnp.int32(-1))
+        else:
+            self.cache = self._admit(self.cache, jnp.asarray(rows),
+                                     jnp.int32(lane), jnp.int32(start))
+
+    def _exec_reseed(self, lane, slot) -> None:
+        """No admission chunk ran (1-token prompt) but the lane still
+        needs its prefix K/V seeded."""
+        if slot is not None:
+            # 1-token prompt on a pooled prefix: no admission chunk
+            # runs, but the lane still needs the prefix K/V.
+            self.cache = self._reseed_pool(
+                self.cache, jnp.int32(lane), self._prefix_pool.slab,
+                jnp.int32(slot))
+        elif self._prefix_lane is not None:
+            # 1-token prompt: no admission chunk runs, but the lane
+            # still needs the shared prefix's K/V (code-review
+            # regression: skipping this read zeros where the prefix
+            # belongs).
+            self.cache = self._reseed(self.cache, jnp.int32(lane))
+        # else: 1-token prompt, no prefix — stale slots stay masked
+        # until the decode loop overwrites them.
 
     def _chunk_rows(self, prompt, off: int, start: int,
                     width: int) -> np.ndarray:
@@ -674,13 +793,8 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                 "enqueue(): a tier resize compacts lanes, so the lane "
                 "id submit() would return can dangle")
         self._check_open()
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        prompt = self._validate_request_args(prompt, max_new_tokens)
         p = prompt.size
-        if p < 1:
-            raise ValueError("prompt must contain at least one token")
-        if max_new_tokens < 1:
-            raise ValueError(
-                f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if ((temperature is not None or top_p is not None
              or min_p is not None) and not self.per_request_sampling):
             raise ValueError(
@@ -709,7 +823,7 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
             raise ValueError(
                 "per-request top_p/min_p need a sampling temperature "
                 f"(effective temperature is {eff_t})")
-        off, slot = self._off, None
+        off, slot, lane = self._off, None, None
         if prefix_id is not None:
             # Pin FIRST (see _pin_prefix): from here on, a concurrent
             # pool.put can never evict this entry, so the slot stays
@@ -747,7 +861,15 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                           prompt_len=p, max_new=int(max_new_tokens))
 
             warm = p - 1
-            plan = self._chunk_plan(off, warm)
+            plan = self._admission_plan(lane, prompt, off, warm)
+            if plan is None:
+                # KV-storage decline (the paged allocator is out of
+                # blocks): no lane is occupied; enqueue/pump treat it
+                # as backpressure, not a timeout.
+                self._decline("kv_blocks")
+                if prefix_id is not None:
+                    self._prefix_pool.release(prefix_id)
+                return None
             chunks = None
             if plan:
                 start0, width0 = plan[0]
@@ -755,39 +877,12 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                 with obs.span("serving.admit", bucket=width0,
                               chunks=len(plan), lane=lane,
                               request_id=rid):
-                    if slot is not None:
-                        self.cache = self._admit(
-                            self.cache, jnp.asarray(rows),
-                            jnp.int32(lane), jnp.int32(start0),
-                            self._prefix_pool.slab, jnp.int32(slot))
-                    elif self._prefix_pool is not None:
-                        # Pooled engine, plain request: the gather
-                        # program takes slot -1 = "seed zeros".
-                        self.cache = self._admit(
-                            self.cache, jnp.asarray(rows),
-                            jnp.int32(lane), jnp.int32(start0),
-                            self._prefix_pool.slab, jnp.int32(-1))
-                    else:
-                        self.cache = self._admit(
-                            self.cache, jnp.asarray(rows),
-                            jnp.int32(lane), jnp.int32(start0))
+                    self._exec_admit(lane, start0, rows, slot)
                 if len(plan) > 1:
                     chunks = [(s, self._chunk_rows(prompt, off, s, w))
                               for s, w in plan[1:]]
-            elif slot is not None:
-                # 1-token prompt on a pooled prefix: no admission
-                # chunk runs, but the lane still needs the prefix K/V.
-                self.cache = self._reseed_pool(
-                    self.cache, jnp.int32(lane),
-                    self._prefix_pool.slab, jnp.int32(slot))
-            elif self._prefix_lane is not None:
-                # 1-token prompt: no admission chunk runs, but the
-                # lane still needs the shared prefix's K/V
-                # (code-review regression: skipping this read zeros
-                # where the prefix belongs).
-                self.cache = self._reseed(self.cache, jnp.int32(lane))
-            # else: 1-token prompt, no prefix — stale slots stay
-            # masked until the decode loop overwrites them.
+            else:
+                self._exec_reseed(lane, slot)
             if chunks is None:
                 self.pos = self.pos.at[lane].set(off + warm)
                 self.cur = self.cur.at[lane].set(int(prompt[-1]))
@@ -817,9 +912,12 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
         except Exception:
             # Any failure between pin and lane commit (validation, a
             # chaos-injected admit fault, a dispatch error) must not
-            # leak the prefix reference.
+            # leak the prefix reference — nor, on the paged engine,
+            # the KV blocks the admission plan staged.
             if prefix_id is not None:
                 self._prefix_pool.release(prefix_id)
+            if lane is not None:
+                self._abort_admission(lane)
             raise
         if chunks is not None:
             self._admitting.append(lane)
@@ -907,19 +1005,26 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
             chaos.probe("serving.step")
             if obs.active() is not None:  # running() is O(lanes)
                 obs.gauge("serving.lanes_busy", len(self.running()))
-            if n not in self._steps:
-                self._steps[n] = self._make_step(n)
             with obs.span("serving.step", n=n):
-                self.cache, self.cur, self.pos, toks = self._steps[n](
-                    self.cache, self.cur, self.pos, self.keys,
-                    self.temps, self.tps, self.mps)
-                toks = np.asarray(toks)
+                toks = self._dispatch_step(n)
             out = self._emit(lambda lane: toks[lane].tolist())
             # Deadline granularity is one step window: tokens emitted
             # in the window that straddles the deadline are kept in
             # the partial result.
             self._reap()
             return out
+
+    def _dispatch_step(self, n: int):
+        """ONE device round-trip of the ``n``-token decode window over
+        the engine's storage; returns the emitted-token matrix
+        ``[lanes, n]`` (host numpy).  The paged engine overrides this
+        to grow page tables first and thread them through its step."""
+        if n not in self._steps:
+            self._steps[n] = self._make_step(n)
+        self.cache, self.cur, self.pos, toks = self._steps[n](
+            self.cache, self.cur, self.pos, self.keys,
+            self.temps, self.tps, self.mps)
+        return np.asarray(toks)
 
 
 __all__ = ["ContinuousBatcher", "KV_INT8_LANE_ADVISORY"]

@@ -232,4 +232,78 @@ class PrefixPool:
         return len(self._entries)
 
 
-__all__ = ["PrefixPool"]
+@dataclasses.dataclass
+class _Stem:
+    blocks: tuple          # block ids pinned for this prefix, in order
+    length: int            # prefix token count (a multiple of block)
+
+
+class PinnedStems:
+    """Host-side registry of PINNED block runs on a paged KV slab —
+    the :class:`PrefixPool` story re-expressed in the paged engine's
+    one-allocator world (round 12).
+
+    Where the pool holds prefix segments in its OWN device slab and
+    requests name them by ``prefix_id``, a pinned stem is just a run
+    of ordinary cache blocks in the engine's slab whose refcounts this
+    registry holds up (so the allocator can never recycle them), each
+    block hash-registered like any admission-prefilled block.
+    Requests need no id at all: a prompt that starts with the pinned
+    tokens hash-hits the blocks through normal stem sharing — one
+    mechanism serves "registered system prompt" and "two requests
+    happened to share a stem" alike.
+
+    Pure bookkeeping: the engine
+    (:meth:`~distkeras_tpu.serving.paged.PagedBatcher.pin_prefix`)
+    prefills the blocks and takes the references; this class only
+    records which blocks each pin holds so ``unpin`` releases exactly
+    them.  Engines call it under their admission lock; the leaf lock
+    keeps a shared registry safe anyway (same posture as the pool).
+    """
+
+    def __init__(self):
+        self._entries: dict[int, _Stem] = {}
+        self._next_id = 0
+        self._lock = TracedRLock("serving.pinned_stems")
+
+    def add(self, blocks, length: int) -> int:
+        with self._lock:
+            pid = self._next_id
+            self._next_id += 1
+            self._entries[pid] = _Stem(tuple(blocks), int(length))
+            return pid
+
+    def pop(self, prefix_id: int) -> tuple:
+        """Remove the pin and return its block run (the caller
+        releases the references)."""
+        with self._lock:
+            e = self._entries.pop(prefix_id, None)
+            if e is None:
+                raise KeyError(
+                    f"unknown pinned prefix {prefix_id} (unpinned "
+                    "already or never pinned; ids are never reused)")
+            return e.blocks
+
+    def length_of(self, prefix_id: int) -> int:
+        return self._entry(prefix_id).length
+
+    def blocks_of(self, prefix_id: int) -> tuple:
+        return self._entry(prefix_id).blocks
+
+    def _entry(self, prefix_id: int) -> _Stem:
+        e = self._entries.get(prefix_id)
+        if e is None:
+            raise KeyError(f"unknown pinned prefix {prefix_id}")
+        return e
+
+    def ids(self) -> list[int]:
+        return sorted(self._entries)
+
+    def __contains__(self, prefix_id: int) -> bool:
+        return prefix_id in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+__all__ = ["PrefixPool", "PinnedStems"]

@@ -406,13 +406,8 @@ class SpeculativeBatcher(_LaneEngine):
     def _submit_locked(self, prompt, max_new_tokens, key, eos_token,
                        ttl, deadline, prefix_id=None):
         self._check_open()
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        prompt = self._validate_request_args(prompt, max_new_tokens)
         p = prompt.size
-        if p < 1:
-            raise ValueError("prompt must contain at least one token")
-        if max_new_tokens < 1:
-            raise ValueError(
-                f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if (key is None) == (self.temperature > 0):
             raise ValueError(
                 "pass a per-request key iff the engine samples "

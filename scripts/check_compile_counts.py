@@ -417,6 +417,83 @@ def _session_obs_live_sanitized():
         + "\n".join(v.format() for v in vs)
 
 
+def session_serving_paged():
+    """Paged-KV ContinuousBatcher session (round 12): EVERY program —
+    the page-table-gather step windows, one admission program per
+    bucket, the CoW block copy / row fork — compiles at construction;
+    the serve phase (a plain admission, a stem-SHARING admission that
+    refcounts the first request's prompt blocks, decode, drain, and a
+    re-admission) must be COMPILE-FREE (asserted: a compile here means
+    some paged program shape was missed and a request paid it)."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.serving import PagedBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    eng = PagedBatcher(params, cfg, lanes=2, block=8, n_blocks=9,
+                       prompt_buckets=(8,))
+    built = _COMPILES["n"]
+    rng = np.random.default_rng(0)
+    stem = rng.integers(0, 64, (8,)).astype(np.int32)
+    tails = rng.integers(0, 64, (2, 4)).astype(np.int32)
+    lanes = [eng.submit(np.concatenate([stem, tails[0]]), 6),
+             eng.submit(np.concatenate([stem, tails[1]]), 6)]
+    assert eng.allocator.stats()["shared"] >= 1  # the stem hash hit
+    for lane in lanes:
+        while lane in eng.running():
+            eng.step()
+        eng.drain(lane)
+    again = eng.submit(rng.integers(0, 64, (5,)).astype(np.int32), 4)
+    while again in eng.running():
+        eng.step()
+    eng.drain(again)
+    serve = _COMPILES["n"] - built
+    assert serve == 0, (
+        f"paged serve phase compiled {serve} program(s); every paged "
+        "program must compile at construction")
+
+
+def session_serving_paged_cow():
+    """Paged CoW session: forking a mid-decode lane (share full
+    blocks, copy the divergent tail block) and decoding both branches
+    must ride the construction-compiled block-copy/row-fork programs —
+    the fork path itself is asserted compile-free."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.serving import PagedBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    eng = PagedBatcher(params, cfg, lanes=3, block=8, n_blocks=13,
+                       prompt_buckets=(8,))
+    built = _COMPILES["n"]
+    rng = np.random.default_rng(0)
+    src = eng.submit(rng.integers(0, 64, (6,)).astype(np.int32), 10)
+    for _ in range(3):
+        eng.step()
+    alt = (eng._lane_state[src].tokens[-1] + 1) % 64
+    fork = eng.fork(src, token=alt)
+    assert fork is not None
+    for lane in (src, fork):
+        while lane in eng.running():
+            eng.step()
+        eng.drain(lane)
+    serve = _COMPILES["n"] - built
+    assert serve == 0, (
+        f"paged CoW serve phase compiled {serve} program(s); the fork "
+        "must ride the construction-compiled block-copy/row-fork "
+        "programs")
+
+
 # NOTE: new sessions append at the END — inserting one mid-dict would
 # shift every later session's warm-cache delta budget (module
 # docstring).
@@ -448,6 +525,12 @@ SESSIONS = {
     "lm_zero3": lambda: session_lm(zero=3),
     "lm_codec_rules": lambda: session_lm(
         compress=(("emb", "topk"), (".*", "int8"))),
+    # Paged KV (round 12): construction compiles everything — gather
+    # steps, per-bucket block-scatter admission, CoW block copy + row
+    # fork — and both serve phases are ASSERTED compile-free inside
+    # the session (the budget is the construction warm-up only).
+    "serving_paged": session_serving_paged,
+    "serving_paged_cow": session_serving_paged_cow,
 }
 
 

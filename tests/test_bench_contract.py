@@ -188,3 +188,38 @@ def test_bench_load_elastic_and_spec_rows(monkeypatch):
     assert rate > 0 and not extras["degraded"]
     for key in ("ttft_p99_ms", "tpot_p50_ms", "n_draft"):
         assert key in extras
+
+
+def test_bench_paged_rows(monkeypatch):
+    """Round-12 paged-KV rows: the lanes-at-fixed-HBM row reports a
+    >= 2x lane multiple at identical slab block counts, the shared-
+    stem row reports refcounted block savings, and the CoW row
+    reports fork vs whole-row-copy latency — all self-scaled to the
+    config (block must divide max_len)."""
+    import bench_serving as bs
+
+    monkeypatch.setattr(bs, "_cfg", lambda window=None:
+                        _tiny_serving_cfg())
+    rate, step_s, _, extras = bs.bench_paged_lanes(4)(
+        mono_lanes=2, p_len=6, new=4)
+    assert rate > 0 and abs(rate * step_s - 1.0) < 1e-9
+    assert extras["paged_lanes"] == extras["mono_lanes"] * 4
+    # lanes_ratio is MEASURED peak concurrency, not the configured
+    # constant — the >=2x acceptance claim must be falsifiable.
+    assert extras["peak_lanes_paged"] <= extras["paged_lanes"]
+    assert extras["peak_lanes_mono"] <= extras["mono_lanes"]
+    assert extras["lanes_ratio"] >= 2.0
+    assert extras["mono_tok_s"] > 0 and extras["slab_blocks"] > 0
+    assert _tiny_serving_cfg().max_len % extras["block"] == 0
+
+    rate, _, _, extras = bs.bench_paged_shared_stem(4)(
+        stem_len=12, tail_len=4, new=4, lanes=2)
+    assert rate > 0
+    assert extras["blocks_saved"] > 0
+    assert extras["noshare_tok_s"] > 0 and extras["share_speedup"] > 0
+
+    ratio, fork_s, _, extras = bs.bench_paged_cow_fork()(
+        p_len=8, warm_steps=2, iters=3)
+    assert ratio > 0 and fork_s > 0
+    assert extras["fork_ms"] > 0 and extras["cache_copy_ms"] > 0
+    assert extras["bytes_ratio"] > 1
