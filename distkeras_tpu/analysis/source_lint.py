@@ -75,14 +75,20 @@ _STEP_NAME_HINT = ("step", "train", "update")
 # lazily — the live telemetry plane (scrape/SLO threads must not be
 # able to trigger device work or compilation), the offline obs
 # modules (obs_report.py imports them through a no-framework stub
-# loader on hosts with no jax installed), and the lock sanitizer
+# loader on hosts with no jax installed), the lock sanitizer
 # (utils/locks.py feeds the obs metrics registry and is imported by
-# every module above).
+# every module above), and — round 13 — the fleet router plane
+# (serving/router.py + serving/residency.py: routing is host
+# bookkeeping and HTTP; a router process must never be able to
+# compile a program — the serving_router compile session pins the
+# dynamic half of that claim).
 _JAX_FREE_FILES = tuple(
     os.path.join("distkeras_tpu", "obs", f)
     for f in ("live.py", "slo.py", "metrics.py", "trace.py",
               "report.py")) + (
-    os.path.join("distkeras_tpu", "utils", "locks.py"),)
+    os.path.join("distkeras_tpu", "utils", "locks.py"),
+    os.path.join("distkeras_tpu", "serving", "router.py"),
+    os.path.join("distkeras_tpu", "serving", "residency.py"))
 
 
 def _attr_chain(node) -> list[str]:

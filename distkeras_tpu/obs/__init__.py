@@ -71,7 +71,8 @@ class ObsSession:
                  run_id: str | None = None,
                  serve_port: int | None = None,
                  serve_host: str = "127.0.0.1", health=None,
-                 slo_rules=None, slo_tick_s: float = 1.0):
+                 slo_rules=None, slo_tick_s: float = 1.0,
+                 residency=None):
         self.registry = MetricsRegistry()
         self.trace = (EventTrace(trace_path, run_id=run_id)
                       if trace_path else None)
@@ -87,7 +88,8 @@ class ObsSession:
             if serve_port is not None:
                 self.server = TelemetryServer(
                     self.registry, port=serve_port, bind=serve_host,
-                    trace_path=trace_path, health=health).start()
+                    trace_path=trace_path, health=health,
+                    residency=residency).start()
         except BaseException:
             # A failed live-plane start (e.g. the fixed serve_port is
             # already bound) must not leak the already-running ticker

@@ -17,6 +17,11 @@ endpoint               serves
                        tolerant, like ``read_trace``)
 ``/metrics/cluster``   every cluster host's ``/metrics`` merged, each
                        series labeled ``host="N"`` (federation)
+``/residency``         the attached engine's residency digest
+                       (resident stem hashes / prefix ids / live
+                       load — ``residency=engine.residency``): the
+                       cache-aware router's affinity ground truth
+                       (round 13)
 =====================  ==================================================
 
 Started via ``obs.session(serve_port=...)`` (port 0 = ephemeral; the
@@ -217,10 +222,21 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/metrics/cluster":
                 self._send(200, tel.cluster_metrics(),
                            "text/plain; version=0.0.4; charset=utf-8")
+            elif url.path == "/residency":
+                doc = tel.residency_doc()
+                if doc is None:
+                    self._send(404, "no residency source attached "
+                               "to this server (pass residency= a "
+                               "callable, e.g. engine.residency)\n",
+                               "text/plain")
+                else:
+                    self._send(200, json.dumps(doc, default=str),
+                               "application/json")
             else:
                 self._send(404, f"unknown endpoint {url.path}\n"
                            "(try /metrics /snapshot.json /healthz "
-                           "/trace/tail /metrics/cluster)\n",
+                           "/trace/tail /metrics/cluster "
+                           "/residency)\n",
                            "text/plain")
         except BrokenPipeError:  # pragma: no cover — client went away
             pass
@@ -257,9 +273,15 @@ class TelemetryServer:
                  bind: str = "127.0.0.1", trace_path: str | None = None,
                  health=None, cluster_dir: str | None = None,
                  host_id: int | None = None, advertise: str | None = None,
-                 scrape_timeout: float = 1.0):
+                 scrape_timeout: float = 1.0, residency=None):
         self.registry = registry
         self.trace_path = trace_path
+        # ``/residency`` source (round 13): a callable returning the
+        # engine's residency digest dict (``engine.residency`` — the
+        # cache-aware router's affinity ground truth).  Injected as a
+        # callable so this module stays jax-free: the server only
+        # relays the dict.
+        self._residency = residency
         self._health = health if health is not None \
             else _health_from_env()
         env = os.environ
@@ -335,6 +357,18 @@ class TelemetryServer:
             ok, detail = out
             return bool(ok), dict(detail)
         return bool(out), {}
+
+    # ------------------------------------------------------- residency
+
+    def residency_doc(self):
+        """The attached residency source's digest, or None when no
+        source is attached.  Never runs under a sanitized lock (the
+        source is engine code that takes the admission lock
+        itself)."""
+        if self._residency is None:
+            return None
+        assert_unlocked("obs.live residency source")
+        return self._residency()
 
     # ------------------------------------------------------- trace tail
 

@@ -113,57 +113,116 @@ def request_waterfall(records: list[dict], request_id: int) -> dict:
     spans (chunked prefill) -> ``serving.emit`` events (decode; the
     inter-token gaps) -> ``serving.finish``.
 
+    **Routed requests** (round 13): when ``request_id`` is a
+    fleet-wide router id, its ``router.route`` events name the
+    replica-local ids each hop admitted under, and the waterfall
+    follows them — the routing decision, any ``router.reroute`` hop,
+    and every replica's engine-side stages render as ONE story (pass
+    the MERGED records of all hosts' traces for a cross-process
+    fleet; ``scripts/obs_report.py --request`` with several trace
+    files does exactly that).
+
     Returns a plain dict: ``{"request_id", "found", "submit_t",
     "stages": [{"t", "name", "dur", ...}], "queue_wait_s", "ttft_s",
-    "total_s", "status", "tokens", "gaps": {...}}`` with every ``t``
-    relative to the submit event (or the earliest record seen)."""
+    "total_s", "status", "tokens", "reroutes", "gaps": {...}}`` with
+    every ``t`` relative to the submit event (or the earliest record
+    seen)."""
+    # Follow router hops first: replica-local ids this fleet-wide id
+    # was admitted under, each tagged with its replica name.
+    ids: dict = {request_id: None}
+    final_id = request_id
+    for r in records:
+        if r.get("kind") != "event" or r.get("name") != "router.route":
+            continue
+        f = r.get("fields") or {}
+        if f.get("request_id") != request_id:
+            continue
+        rrid = f.get("replica_request_id")
+        if rrid is not None:
+            ids[rrid] = f.get("replica")
+            final_id = rrid
     mine_events, mine_spans = [], []
     for r in records:
         fields = r.get("fields") or {}
-        if fields.get("request_id") != request_id:
+        rid = fields.get("request_id")
+        if rid not in ids:
             continue
+        tagged = dict(r)
+        if ids[rid] is not None:
+            tagged["_replica"] = ids[rid]
         if r.get("kind") == "event":
-            mine_events.append(r)
+            mine_events.append(tagged)
         elif r.get("kind") == "span":
-            mine_spans.append(r)
+            mine_spans.append(tagged)
     if not mine_events and not mine_spans:
         return {"request_id": request_id, "found": False}
 
     def at(r):
         return r["t"] if r.get("kind") == "event" else r["t0"]
 
+    def tag(stage, rec):
+        if rec.get("_replica") is not None:
+            stage["replica"] = rec["_replica"]
+        return stage
+
     submit = next((e for e in mine_events
-                   if e["name"] == "serving.submit"), None)
+                   if e["name"] in ("router.submit",
+                                    "serving.submit")), None)
     t0 = at(submit) if submit else min(at(r) for r in
                                        mine_events + mine_spans)
     stages = []
     for sp in mine_spans:
-        stages.append({"t": sp["t0"] - t0, "name": sp["name"],
-                       "dur_s": sp["dur"], **{
-                           k: v for k, v in sp["fields"].items()
-                           if k != "request_id"}})
+        stages.append(tag({"t": sp["t0"] - t0, "name": sp["name"],
+                           "dur_s": sp["dur"], **{
+                               k: v for k, v in sp["fields"].items()
+                               if k != "request_id"}}, sp))
     emits = sorted((e for e in mine_events
                     if e["name"] == "serving.emit"),
                    key=lambda e: e["t"])
     for e in emits:
-        stages.append({"t": e["t"] - t0, "name": "serving.emit",
-                       "n": e["fields"].get("n"),
-                       "first": e["fields"].get("first")})
-    finish = next((e for e in mine_events
-                   if e["name"] == "serving.finish"), None)
-    if finish is not None:
-        stages.append({"t": finish["t"] - t0, "name": "serving.finish",
-                       "status": finish["fields"].get("status")})
+        stages.append(tag({"t": e["t"] - t0, "name": "serving.emit",
+                           "n": e["fields"].get("n"),
+                           "first": e["fields"].get("first")}, e))
+    # Router hops: the routing decision(s) and any re-route render as
+    # first-class stages (round 13).
+    hops = [e for e in mine_events
+            if e["name"] in ("router.route", "router.reroute",
+                             "router.finish")]
+    for e in hops:
+        stages.append({"t": e["t"] - t0, "name": e["name"],
+                       **{k: v for k, v in e["fields"].items()
+                          if k != "request_id"}})
+    finishes = sorted((e for e in mine_events
+                       if e["name"] == "serving.finish"),
+                      key=lambda e: e["t"])
+    for e in finishes:
+        stages.append(tag({"t": e["t"] - t0, "name": "serving.finish",
+                           "status": e["fields"].get("status")}, e))
     stages.sort(key=lambda s: s["t"])
 
     admit = next((sp for sp in mine_spans
                   if sp["name"] == "serving.admit"), None)
-    gaps = [b["t"] - a["t"] for a, b in zip(emits, emits[1:])]
+    # Token/gap accounting over the FINAL hop only: a rerouted
+    # request re-decodes from scratch on its new replica, and the
+    # caller-visible transcript is the final hop's.
+    final_emits = [e for e in emits
+                   if (e["fields"].get("request_id",
+                                       request_id)) == final_id] \
+        if len(ids) > 1 else emits
+    gaps = [b["t"] - a["t"]
+            for a, b in zip(final_emits, final_emits[1:])]
     gapstats = None
     if gaps:
         s = sorted(gaps)
         gapstats = {"count": len(gaps), "p50_s": statistics.median(s),
                     "max_s": s[-1]}
+    finish = finishes[-1] if finishes else None
+    status = finish["fields"].get("status") if finish else None
+    if status is None:
+        rf = next((e for e in hops if e["name"] == "router.finish"),
+                  None)
+        if rf is not None:
+            status = rf["fields"].get("status")
     out = {
         "request_id": request_id, "found": True,
         "submit_t": t0,
@@ -174,8 +233,10 @@ def request_waterfall(records: list[dict], request_id: int) -> dict:
         "ttft_s": (emits[0]["t"] - t0) if emits and submit else None,
         "prefill_chunks": sum(1 for sp in mine_spans
                               if sp["name"] == "serving.admit_chunk"),
-        "tokens": sum(e["fields"].get("n") or 0 for e in emits),
-        "status": finish["fields"].get("status") if finish else None,
+        "tokens": sum(e["fields"].get("n") or 0 for e in final_emits),
+        "reroutes": sum(1 for e in hops
+                        if e["name"] == "router.reroute"),
+        "status": status,
         "total_s": (finish["t"] - t0) if finish else None,
         "gaps": gapstats,
         "stages": stages,
@@ -196,6 +257,9 @@ def render_waterfall(wf: dict) -> str:
         f"  queue wait {_fmt_s(wf.get('queue_wait_s'))}   ttft "
         f"{_fmt_s(wf.get('ttft_s'))}   prefill chunks "
         f"{wf.get('prefill_chunks')}   tokens {wf.get('tokens')}")
+    if wf.get("reroutes"):
+        out.append(f"  re-route hops: {wf['reroutes']} (a replica "
+                   "died or drained mid-request)")
     g = wf.get("gaps")
     if g:
         out.append(f"  inter-token gaps: {g['count']}  p50 "
@@ -210,6 +274,32 @@ def render_waterfall(wf: dict) -> str:
 
 
 # ------------------------------------------------------ multi-host merge
+
+
+def merged_records(paths) -> list[dict]:
+    """Raw event/span records from SEVERAL traces, wall-clock aligned
+    (each trace's monotonic ``t``/``t0`` rebased through its meta
+    anchor, the :func:`merge_traces` alignment) — what
+    :func:`request_waterfall` consumes when one request crossed
+    processes (a routed fleet request: the router's trace plus each
+    replica's).  Single-trace callers can keep passing ``read_trace``
+    output; the relative timing math is identical."""
+    out: list[dict] = []
+    for path in paths:
+        records = read_trace(path)
+        meta = next((r for r in records if r.get("kind") == "meta"), {})
+        off = 0.0
+        if meta.get("time_unix") is not None \
+                and meta.get("t") is not None:
+            off = meta["time_unix"] - meta["t"]
+        for r in records:
+            if r.get("kind") == "span":
+                out.append({**r, "t0": r["t0"] + off})
+            elif r.get("kind") == "event":
+                out.append({**r, "t": r["t"] + off})
+            else:
+                out.append(r)
+    return out
 
 
 def merge_traces(paths) -> dict:
@@ -398,5 +488,5 @@ def render_compare(base: dict, new: dict) -> str:
 
 
 __all__ = ["build_report", "load_report", "render_report",
-           "render_compare", "merge_traces", "render_merged",
-           "request_waterfall", "render_waterfall"]
+           "render_compare", "merge_traces", "merged_records",
+           "render_merged", "request_waterfall", "render_waterfall"]

@@ -26,6 +26,15 @@ did against the old ``serving.py``.  Layout:
   :class:`BlockAllocator`: block-granular paged KV with per-lane page
   tables, content-hash stem sharing, and copy-on-write lane forks
   (round 12).
+- :mod:`~distkeras_tpu.serving.router` — :class:`Router`: the
+  jax-free fleet layer over N engine replicas (round 13) —
+  cache-aware routing off each replica's residency digest,
+  health-gated membership, drain-and-reroute, ``QueueFull``
+  spillover, and cross-process trace propagation; with
+  :class:`InProcessReplica` / :class:`HttpReplica` handles and the
+  :class:`EngineEndpoint` HTTP admission server.
+- :mod:`~distkeras_tpu.serving.residency` — the jax-free chain-hash
+  digest language the paged engine and the router share.
 
 The reference has no serving story at all (its ModelPredictor runs the
 training forward over a static batch — reference:
@@ -46,6 +55,10 @@ from distkeras_tpu.serving.lanes import (KV_INT8_LANE_ADVISORY,
                                          ContinuousBatcher)
 from distkeras_tpu.serving.paged import BlockAllocator, PagedBatcher
 from distkeras_tpu.serving.prefix import PinnedStems, PrefixPool
+from distkeras_tpu.serving.router import (EngineEndpoint, HttpReplica,
+                                          InProcessReplica,
+                                          ReplicaUnreachable, Router,
+                                          discover_replicas)
 from distkeras_tpu.serving.speculative import SpeculativeBatcher
 
 __all__ = [
@@ -55,6 +68,12 @@ __all__ = [
     "BlockAllocator",
     "PrefixPool",
     "PinnedStems",
+    "Router",
+    "InProcessReplica",
+    "HttpReplica",
+    "EngineEndpoint",
+    "ReplicaUnreachable",
+    "discover_replicas",
     "RequestResult",
     "QueueFull",
     "EngineClosed",

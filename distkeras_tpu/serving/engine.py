@@ -184,6 +184,27 @@ class _LaneEngine(_AdmissionMixin, _ElasticMixin):
         engine drops the lane's block references here — the ONE place,
         so no eviction path can leak a block."""
 
+    def residency(self) -> dict:
+        """The engine's residency digest (round 13): what a cache-
+        aware router needs to route on — resident prefix-pool ids,
+        resident paged stem hashes (the paged engine overrides to
+        fill them), and the live load signals.  Ground truth, cheap
+        (host counters + id lists, no device work), JSON-safe; served
+        live by the ``/residency`` telemetry endpoint and consumed by
+        :class:`~distkeras_tpu.serving.router.Router`."""
+        with self._admission_lock:
+            return {
+                "engine": type(self).__name__,
+                "lanes": self.lanes,
+                "lanes_busy": len(self.running()),
+                "queue_depth": len(self._pending),
+                "block": None,
+                "prefix_ids": (self._prefix_pool.ids()
+                               if self._prefix_pool is not None
+                               else []),
+                "stem_hashes": [],
+            }
+
     def _validate_request_args(self, prompt, max_new_tokens: int):
         """The prompt/budget checks every engine's submit() runs —
         one definition (ContinuousBatcher and SpeculativeBatcher must

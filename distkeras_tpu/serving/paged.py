@@ -66,7 +66,6 @@ docs/serving_guide.md#paged-kv.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 
 import jax
 import jax.numpy as jnp
@@ -79,6 +78,7 @@ from distkeras_tpu.models.transformer import TransformerConfig
 from distkeras_tpu.serving.engine import _Lane
 from distkeras_tpu.serving.lanes import ContinuousBatcher
 from distkeras_tpu.serving.prefix import PinnedStems
+from distkeras_tpu.serving.residency import chain_hash as _chain_hash
 from distkeras_tpu.utils.locks import TracedRLock
 
 # Physical block 0 is never handed out: unallocated page-table entries
@@ -93,15 +93,6 @@ TRASH_BLOCK = 0
 # test_kv_int8_prefill_admission_tolerance — if this grows, the
 # prefill-built write path regressed, not the tolerance.
 KV_INT8_PREFILL_LOGIT_TOL = 0.05
-
-
-def _chain_hash(prev: bytes, tokens) -> bytes:
-    """Chain hash of one full block of prompt tokens: a pure function
-    of the whole token prefix up to and including this block, so equal
-    digests imply equal (position, content) — the stem-sharing key."""
-    h = hashlib.blake2b(prev, digest_size=16)
-    h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
-    return h.digest()
 
 
 def _gather_view(leaf, tables):
@@ -220,6 +211,13 @@ class BlockAllocator:
     def refs_of(self, bid: int) -> int:
         with self._lock:
             return self._refs.get(bid, 0)
+
+    def resident_hashes(self) -> list[bytes]:
+        """Every digest currently resident (live OR free-but-not-yet-
+        recycled — both hit on :meth:`share_by_hash`): the paged half
+        of the engine's residency digest (round 13)."""
+        with self._lock:
+            return list(self._by_hash)
 
     def stats(self) -> dict:
         """``used``/``free``/``shared`` block counts (shared = live
@@ -900,6 +898,21 @@ class PagedBatcher(ContinuousBatcher):
             for bid in self._stems.pop(prefix_id):
                 self._alloc.free(bid)
             self._obs_blocks()
+
+    def residency(self) -> dict:
+        """The paged residency digest: the base load/pool fields plus
+        the slab geometry and every resident stem hash (hex, JSON-
+        safe) — the ground truth a cache-aware router's affinity
+        table is built from, matching
+        :func:`distkeras_tpu.serving.residency.stem_hexes` digests by
+        construction (one chain-hash definition)."""
+        out = super().residency()
+        out["block"] = self.block
+        out["stem_hashes"] = [h.hex()
+                              for h in self._alloc.resident_hashes()]
+        out["prefix_ids"] = self._stems.ids()
+        out["kv_blocks_free"] = self._alloc.stats()["free"]
+        return out
 
     @property
     def pinned(self) -> PinnedStems:

@@ -1,0 +1,65 @@
+"""Content-hash stem residency: the digest language the paged engine
+and the fleet router share.
+
+The paged engine (:mod:`distkeras_tpu.serving.paged`) identifies a
+resident KV block by the chain hash of the whole token prefix up to
+and including that block; the cache-aware router
+(:mod:`distkeras_tpu.serving.router`) routes a request to the replica
+whose resident digest set covers the longest prefix of the request's
+prompt.  Both sides MUST compute the same bytes for the same tokens —
+one definition lives here, jax-free (the router runs on hosts that
+never import jax; source lint ``jax-free`` rule), and everything else
+imports it.
+
+A digest is a pure function of ``(block size, token content,
+position)``: equal digests imply equal full-block prefixes, so a
+digest set is a complete description of which prompt stems a replica
+can serve without re-prefilling — the "residency digest" the
+``/residency`` telemetry endpoint publishes and the router's affinity
+table consumes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def chain_hash(prev: bytes, tokens) -> bytes:
+    """Chain hash of one full block of prompt tokens: a pure function
+    of the whole token prefix up to and including this block, so equal
+    digests imply equal (position, content) — the stem-sharing key."""
+    h = hashlib.blake2b(prev, digest_size=16)
+    h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+    return h.digest()
+
+
+def stem_hashes(tokens, block: int) -> list[bytes]:
+    """Chain hashes of every FULL ``block``-token block of ``tokens``
+    (a partial tail block has no stable identity and gets no digest).
+
+    NOTE for routing: engines prefill the WARM prompt — every token
+    but the last, which the decode loop processes — so the residency
+    a request can hit is ``stem_hashes(prompt[:-1], block)``, not the
+    full prompt's.
+    """
+    if block < 1:
+        raise ValueError(f"block must be >= 1, got {block}")
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    out: list[bytes] = []
+    digest = b""
+    for k in range(tokens.size // block):
+        digest = chain_hash(digest, tokens[k * block:(k + 1) * block])
+        out.append(digest)
+    return out
+
+
+def stem_hexes(tokens, block: int) -> list[str]:
+    """:func:`stem_hashes` rendered as hex strings — the JSON-safe
+    spelling ``/residency`` serves and the router's affinity table
+    stores."""
+    return [h.hex() for h in stem_hashes(tokens, block)]
+
+
+__all__ = ["chain_hash", "stem_hashes", "stem_hexes"]

@@ -1,0 +1,1206 @@
+"""Fleet serving: a cache-aware router over N engine replicas.
+
+One ``ContinuousBatcher``/``PagedBatcher`` in one process was the
+whole serving plane through round 12; this module is the layer ABOVE
+it (ROADMAP item 1): a host-side :class:`Router` that fronts N engine
+replicas — in-process objects or cross-host endpoints discovered over
+the ``DKT_CLUSTER_*`` substrate — behind the familiar
+``submit``/``enqueue``/``poll``/``drain``/``shutdown`` surface.  Four
+pillars:
+
+- **Cache-aware routing.**  The router keeps a per-replica affinity
+  table of resident paged stem digests and prefix-pool ids, built
+  from the replicas' residency digests (``engine.residency()`` /
+  the ``/residency`` endpoint — ground truth) plus optimistic inserts
+  from routed request history.  A request whose warm-prompt stems are
+  resident on replica k routes to k (the same locality trick
+  production LLM gateways use: a stem hit refcounts blocks instead of
+  re-prefilling them); everything else falls back to least-loaded by
+  the live queue-depth/lanes-busy signals, with ``slo.breach``
+  subscriber callbacks demoting a breaching replica for a cooldown.
+- **Health-gated membership.**  Replicas join and leave off health
+  probes (``/healthz``, heartbeat freshness, or any injected
+  callable).  A replica that stops answering is marked DOWN within
+  one health interval and takes no new routes; when it answers again
+  it rejoins under a new route epoch with a fresh affinity entry (its
+  cache died with it).  ``QueueFull`` from one replica spills to the
+  next candidate — the caller sees QueueFull only when every live
+  replica is saturated.
+- **Drain-and-reroute.**  A dead or draining replica's un-finished
+  ACCEPTED requests are re-admitted elsewhere, idempotently by
+  request id: the router polls only a request's CURRENT assignment,
+  stamps every route with the route epoch (the same
+  generation-counter idea as ``resilience/cluster.py``'s
+  :class:`~distkeras_tpu.resilience.cluster.EpochStore`), and records
+  only the first terminal result — so a replica kill costs latency
+  (the re-prefill on the new replica), never a caller-visible loss.
+- **Trace propagation.**  The router assigns fleet-wide request ids
+  and emits ``router.submit`` / ``router.route`` /
+  ``router.reroute`` / ``router.finish`` events carrying them; each
+  route event also records the replica-local request id, so
+  ``scripts/obs_report.py --request`` stitches the full cross-process
+  waterfall — routing decision, re-route hop, and the engine-side
+  admit/emit/finish stages — from the merged traces.
+
+Guaranteed jax-free (source lint ``jax-free`` ledger): routing is
+host bookkeeping and HTTP; a router process never compiles a program
+(the ``serving_router`` session in ``scripts/check_compile_counts.py``
+pins a zero-compile route-and-serve phase over in-process replicas).
+
+Thread safety: one ``serving.router`` :class:`TracedRLock` guards the
+router's tables; replica engine locks nest INSIDE it (the router is
+the outermost lock in the serving plane — docs/concurrency.md).
+``enqueue``/``poll``/``take`` are safe from any thread; one thread
+drives ``step()``/``pump()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from distkeras_tpu import obs
+from distkeras_tpu.resilience.admission import (EngineClosed, QueueFull,
+                                                 RequestResult)
+from distkeras_tpu.serving.residency import stem_hexes
+from distkeras_tpu.utils.locks import TracedRLock
+
+# Replica-local request-id bases: the router gives each attached
+# in-process replica a disjoint id range (base, base + span) so one
+# merged trace never holds two engines' colliding ids — what makes the
+# cross-replica waterfall unambiguous.  Router-level ids stay below
+# the first base.
+RID_SPAN = 1_000_000
+
+
+class ReplicaUnreachable(RuntimeError):
+    """A remote replica stopped answering (connection refused/reset or
+    timeout) — the router treats it as a death signal, not an error
+    surfaced to callers."""
+
+
+# ----------------------------------------------------------- replicas
+
+
+class InProcessReplica:
+    """A replica handle over an engine object in THIS process.
+
+    ``engine`` is any serving engine exposing the admission surface
+    (``enqueue``/``poll``/``step``/``residency``/``queued``/
+    ``running``/``closed``) — the router never imports the engine
+    classes, so this module stays jax-free.  ``health`` overrides the
+    default liveness check (engine not closed) — e.g. a heartbeat-
+    freshness callable for replicas whose process publishes beats.
+
+    ``rid_base``: the replica-local request-id floor; assigned by
+    :meth:`Router.add_replica` when None (disjoint ranges per replica,
+    see module docstring).  ``start()`` optionally runs the decode
+    loop on a daemon thread (the deployment shape where each replica
+    steps itself — what the cross-host endpoint does in its own
+    process); without it the router's ``step()`` drives the engine.
+    """
+
+    remote = False
+
+    def __init__(self, name: str, engine, health=None,
+                 rid_base: int | None = None):
+        self.name = str(name)
+        self.engine = engine
+        self._health = health
+        self._failed = None
+        if rid_base is not None:
+            self.set_rid_base(rid_base)
+        self._stop = threading.Event()
+        self._thread = None
+
+    def set_rid_base(self, base: int) -> None:
+        if self.engine._next_id < base:
+            self.engine._next_id = base
+
+    # ----------------------------------------------- admission surface
+
+    def enqueue(self, prompt, max_new_tokens: int, **kw) -> int:
+        return self.engine.enqueue(prompt, max_new_tokens, **kw)
+
+    def poll(self, request_id: int):
+        return self.engine.poll(request_id)
+
+    def step(self) -> None:
+        self.engine.step()
+
+    # ------------------------------------------------- routing signals
+
+    def healthy(self) -> bool:
+        if self._failed is not None:
+            return False
+        if self._health is not None:
+            return bool(self._health())
+        return not self.engine.closed
+
+    def residency(self) -> dict:
+        return self.engine.residency()
+
+    def load(self) -> tuple[int, int, int]:
+        """``(queue_depth, lanes_busy, lanes)`` read live off the
+        engine (cheap host counters)."""
+        return (self.engine.queued, len(self.engine.running()),
+                self.engine.lanes)
+
+    # -------------------------------------------------- self-stepping
+
+    def start(self, idle_s: float = 0.005) -> "InProcessReplica":
+        """Run the decode loop on a daemon thread: step whenever work
+        exists, nap ``idle_s`` when idle.  The per-replica step thread
+        is what lets N in-process replicas decode CONCURRENTLY (XLA
+        releases the GIL during execution) — the bench rows' fleet
+        shape."""
+        if self._thread is not None:
+            raise RuntimeError(f"replica {self.name} already started")
+        self._stop.clear()
+        self._failed = None
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    if self.engine.running() or self.engine.queued:
+                        self.engine.step()
+                    else:
+                        self._stop.wait(idle_s)
+                except Exception as e:  # noqa: BLE001 — a dead step
+                    # thread must flip healthy() so the router
+                    # reroutes, not hang its requests forever.
+                    self._failed = e
+                    return
+
+        self._thread = threading.Thread(
+            target=run, name=f"dkt-replica-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class HttpReplica:
+    """A replica handle over a cross-host :class:`EngineEndpoint`.
+
+    ``addr`` is ``host:port`` (the endpoint publishes it into
+    ``<coord_dir>/serve/host<N>.addr`` under the ``DKT_CLUSTER_*``
+    substrate — see :func:`discover_replicas`).  Admission maps HTTP
+    status to the engine contract: 429 -> :class:`QueueFull`, 503 ->
+    :class:`EngineClosed`, connection failure ->
+    :class:`ReplicaUnreachable` (a death signal the router turns into
+    drain-and-reroute, never a caller-visible error).  Load/residency
+    ride the ``/residency`` document and are cached between refreshes
+    so routing decisions never block on the network.
+    """
+
+    remote = True
+
+    def __init__(self, name: str, addr: str, timeout: float = 2.0):
+        self.name = str(name)
+        self.addr = addr
+        self.timeout = timeout
+        self._cached: dict = {}
+
+    def _url(self, path: str) -> str:
+        return f"http://{self.addr}{path}"
+
+    def _get(self, path: str) -> tuple[int, bytes]:
+        try:
+            with urllib.request.urlopen(self._url(path),
+                                        timeout=self.timeout) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+        except Exception as e:  # noqa: BLE001 — refused/reset/timeout
+            raise ReplicaUnreachable(
+                f"replica {self.name} at {self.addr}: {e}") from e
+
+    def enqueue(self, prompt, max_new_tokens: int, **kw) -> int:
+        body = {"prompt": np.asarray(prompt, np.int32).tolist(),
+                "max_new_tokens": int(max_new_tokens), **kw}
+        req = urllib.request.Request(
+            self._url("/enqueue"), data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                return int(json.loads(resp.read())["request_id"])
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode("utf-8", "replace")
+            if e.code == 429:
+                raise QueueFull(detail) from e
+            if e.code == 503:
+                raise EngineClosed(detail) from e
+            raise ValueError(detail) from e
+        except (QueueFull, EngineClosed):
+            raise
+        except Exception as e:  # noqa: BLE001 — refused/reset/timeout
+            raise ReplicaUnreachable(
+                f"replica {self.name} at {self.addr}: {e}") from e
+
+    def poll(self, request_id: int):
+        code, body = self._get(f"/poll?id={int(request_id)}")
+        if code == 404:
+            return None
+        if code != 200:
+            # A 5xx means the endpoint is up but erroring — treat it
+            # like a death signal (drain-and-reroute is idempotent),
+            # never let an error document parse as a result.
+            raise ReplicaUnreachable(
+                f"replica {self.name} at {self.addr}: poll returned "
+                f"HTTP {code}: {body[:200]!r}")
+        rec = json.loads(body)
+        return RequestResult(
+            request_id=int(rec["request_id"]),
+            tokens=np.asarray(rec["tokens"], np.int32),
+            status=rec["status"], prompt_len=int(rec["prompt_len"]),
+            error=rec.get("error"))
+
+    def step(self) -> None:
+        """No-op: a remote replica's endpoint steps its own engine."""
+
+    def healthy(self) -> bool:
+        try:
+            code, _ = self._get("/healthz")
+        except ReplicaUnreachable:
+            return False
+        return code == 200
+
+    def residency(self) -> dict:
+        _, body = self._get("/residency")
+        self._cached = json.loads(body)
+        return self._cached
+
+    def load(self) -> tuple[int, int, int]:
+        c = self._cached
+        return (int(c.get("queue_depth", 0)),
+                int(c.get("lanes_busy", 0)), int(c.get("lanes", 1)))
+
+
+def discover_replicas(coord_dir: str, timeout: float = 2.0
+                      ) -> list[HttpReplica]:
+    """Build :class:`HttpReplica` handles from the ``serve/`` address
+    ledger an :class:`EngineEndpoint` publishes under the cluster
+    coordination directory (the same atomic-file pattern as the
+    telemetry federation's ``telemetry/`` ledger)."""
+    import os
+
+    d = os.path.join(coord_dir, "serve")
+    out = []
+    if not os.path.isdir(d):
+        return out
+    for name in sorted(os.listdir(d)):
+        if not (name.startswith("host") and name.endswith(".addr")):
+            continue
+        try:
+            with open(os.path.join(d, name), encoding="utf-8") as f:
+                rec = json.load(f)
+            out.append(HttpReplica(f"host{int(rec['host'])}",
+                                   rec["addr"], timeout=timeout))
+        except (OSError, ValueError, KeyError):
+            continue  # torn publish mid-replace: skip this pass
+    return out
+
+
+# ------------------------------------------------------------- router
+
+
+@dataclasses.dataclass
+class _Member:
+    replica: object
+    up: bool = True
+    draining: bool = False
+    degraded_until: float = 0.0
+    last_health: float = 0.0
+    inflight: int = 0
+
+
+@dataclasses.dataclass
+class _Routed:
+    request_id: int
+    prompt: np.ndarray
+    max_new: int
+    kw: dict
+    deadline: float | None
+    born: float
+    prefix_id: object
+    replica: str | None = None
+    replica_rid: int | None = None
+    epoch: int = 0
+    hops: int = 0
+    # Warm-prompt stem digests per block size, computed lazily (one
+    # request may be scored against replicas with different blocks).
+    stems: dict = dataclasses.field(default_factory=dict)
+
+    def stems_at(self, block: int) -> list[str]:
+        if block not in self.stems:
+            self.stems[block] = stem_hexes(self.prompt[:-1], block)
+        return self.stems[block]
+
+
+class Router:
+    """Cache-aware request router over N engine replicas (module
+    docstring has the full story).
+
+    ``replicas``: initial handles (:class:`InProcessReplica` /
+    :class:`HttpReplica` / any object with the same surface); more
+    join via :meth:`add_replica`.  ``policy``: ``"affinity"`` (stem/
+    prefix residency first, least-loaded fallback — the default),
+    ``"least_loaded"`` (residency ignored), or ``"round_robin"`` (the
+    bench baseline).  ``health_interval`` / ``residency_interval``:
+    probe cadences (seconds on ``clock``, injectable for tests).
+
+    The admission surface mirrors the engines': :meth:`enqueue`
+    returns a fleet-wide request id immediately (``QueueFull`` only
+    when EVERY live replica is saturated; ``EngineClosed`` after
+    :meth:`begin_shutdown` — and EngineClosed wins the race, same
+    contract as the engines); results arrive via :meth:`poll` /
+    :meth:`take` / :meth:`results`; :meth:`drain` blocks for one
+    request; :meth:`shutdown` drains everything.  :meth:`step` drives
+    in-process replicas one decode step and pumps; self-stepping
+    replicas (``InProcessReplica.start()`` / remote endpoints) only
+    need :meth:`pump`.
+    """
+
+    def __init__(self, replicas=(), *, policy: str = "affinity",
+                 clock=None, health_interval: float = 0.5,
+                 residency_interval: float = 2.0,
+                 degrade_cooldown: float = 5.0,
+                 poll_s: float = 0.005):
+        if policy not in ("affinity", "least_loaded", "round_robin"):
+            raise ValueError(
+                f"policy must be affinity|least_loaded|round_robin, "
+                f"got {policy!r}")
+        self.policy = policy
+        self._clock = clock if clock is not None else time.monotonic
+        self.health_interval = health_interval
+        self.residency_interval = residency_interval
+        self.degrade_cooldown = degrade_cooldown
+        self.poll_s = poll_s
+        # Outermost serving-plane lock: replica engine admission locks
+        # nest INSIDE it (docs/concurrency.md lock inventory).
+        self._lock = TracedRLock("serving.router")
+        self._members: dict[str, _Member] = {}
+        self._affinity: dict[str, dict] = {}
+        self._requests: dict[int, _Routed] = {}
+        self._completed: dict[int, RequestResult] = {}
+        self._pending: list[int] = []   # accepted but currently unrouted
+        self._next_id = 0
+        # Router-assigned in-process bases start HIGH so they can
+        # never collide with EngineEndpoint's host-id-derived bases
+        # ((host_id + 1) * RID_SPAN) in a mixed fleet — the waterfall
+        # leans on fleet-wide id disjointness.
+        self._next_base = 1000 * RID_SPAN
+        self._rr = 0
+        self._closed = False
+        self.epoch = 0
+        self._last_residency = -float("inf")
+        for r in replicas:
+            self.add_replica(r)
+
+    # ------------------------------------------------------ membership
+
+    def add_replica(self, replica) -> None:
+        """Join a replica.  In-process replicas get a disjoint
+        request-id range; the affinity table seeds from the replica's
+        residency digest (best effort — a dead-on-arrival replica
+        joins DOWN and is retried by health probing)."""
+        with self._lock:
+            name = replica.name
+            if name in self._members:
+                raise ValueError(f"replica {name!r} already attached")
+            if not getattr(replica, "remote", False):
+                replica.set_rid_base(self._next_base)
+            self._next_base += RID_SPAN
+            self._members[name] = _Member(replica,
+                                          last_health=self._clock())
+            self.epoch += 1
+        ok = self._refresh_one(name)
+        with self._lock:
+            if name in self._members:
+                self._members[name].up = ok
+        obs.event("router.replica_join", replica=name, up=ok,
+                  epoch=self.epoch)
+
+    def remove_replica(self, name: str) -> None:
+        """Leave: reroute the replica's unfinished requests, then drop
+        it from membership."""
+        with self._lock:
+            if name not in self._members:
+                raise KeyError(f"unknown replica {name!r}")
+            self._members[name].draining = True
+            self.epoch += 1
+            self._reroute_from_locked(name, why="removed")
+            del self._members[name]
+            self._affinity.pop(name, None)
+        obs.event("router.replica_leave", replica=name,
+                  epoch=self.epoch)
+
+    def drain_replica(self, name: str) -> None:
+        """Graceful drain: stop routing to the replica and re-admit
+        its unfinished accepted requests elsewhere.  The replica
+        object itself is untouched (its owner decides shutdown)."""
+        with self._lock:
+            m = self._members.get(name)
+            if m is None:
+                raise KeyError(f"unknown replica {name!r}")
+            m.draining = True
+            self.epoch += 1
+            self._reroute_from_locked(name, why="draining")
+        obs.event("router.replica_drain", replica=name,
+                  epoch=self.epoch)
+
+    def replicas_up(self) -> list[str]:
+        with self._lock:
+            return sorted(n for n, m in self._members.items()
+                          if m.up and not m.draining)
+
+    def mark_degraded(self, name: str,
+                      cooldown: float | None = None) -> None:
+        """Demote a replica in the least-loaded ordering for
+        ``cooldown`` seconds — the `slo.breach` hook (see
+        :meth:`breach_demoter`)."""
+        with self._lock:
+            m = self._members.get(name)
+            if m is None:
+                return
+            m.degraded_until = self._clock() + (
+                self.degrade_cooldown if cooldown is None else cooldown)
+        obs.event("router.replica_degraded", replica=name)
+
+    def breach_demoter(self, name: str):
+        """A subscriber for ``obs.SloRule`` breach callbacks: any
+        breach demotes ``name`` for the degrade cooldown.  Wire one
+        per replica whose SLO stream is replica-scoped (cross-host:
+        each replica process runs its own rules and the operator maps
+        breaches to names)."""
+        def on_breach(event):
+            del event
+            self.mark_degraded(name)
+        return on_breach
+
+    # ------------------------------------------------------- admission
+
+    def enqueue(self, prompt, max_new_tokens: int, ttl=None,
+                deadline=None, **submit_kw) -> int:
+        """Route and admit one request; returns the fleet-wide request
+        id.  ``QueueFull`` spills to the next candidate replica and
+        reaches the caller only when every live replica is saturated;
+        an expired-on-arrival deadline records a structured timeout
+        (engine contract).  ``submit_kw`` forwards to the replica's
+        ``enqueue`` (per-request keys / sampling overrides /
+        ``prefix_id``)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if ttl is not None and deadline is not None:
+            raise ValueError("pass ttl (relative) OR deadline "
+                             "(absolute), not both")
+        with self._lock:
+            if self._closed:
+                raise EngineClosed(
+                    "router is shutting down (begin_shutdown was "
+                    "called); no new requests are admitted during "
+                    "drain")
+            now = self._clock()
+            dl = now + ttl if ttl is not None else deadline
+            rid = self._next_id
+            self._next_id += 1
+            obs.event("router.submit", request_id=rid,
+                      prompt_len=int(prompt.size),
+                      max_new=int(max_new_tokens))
+            req = _Routed(request_id=rid, prompt=prompt,
+                          max_new=int(max_new_tokens), kw=submit_kw,
+                          deadline=dl, born=now,
+                          prefix_id=submit_kw.get("prefix_id"))
+            if dl is not None and dl <= now:
+                self._finish_locked(req, prompt, "timeout",
+                                    prompt.size)
+                return rid
+            self._requests[rid] = req
+            try:
+                self._route_locked(req)
+            except BaseException:
+                # Not accepted (QueueFull everywhere / no live
+                # replica / validation): the id must not linger as an
+                # accepted request for shutdown to "cancel".
+                self._requests.pop(rid, None)
+                raise
+            return rid
+
+    # submit() is enqueue() here on purpose: a fleet has no stable
+    # lane ids to hand back, so the id-keyed surface IS the surface
+    # (the same argument as the elastic engine's enqueue-only rule).
+    submit = enqueue
+
+    def poll(self, request_id: int):
+        """The request's :class:`RequestResult` (re-keyed to the
+        fleet-wide id), or None while it decodes."""
+        with self._lock:
+            return self._completed.get(request_id)
+
+    def take(self, request_id: int):
+        with self._lock:
+            return self._completed.pop(request_id)
+
+    def results(self) -> dict:
+        with self._lock:
+            out = self._completed
+            self._completed = {}
+            return out
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def queued(self) -> int:
+        """Accepted requests currently awaiting a replica slot (the
+        router-level backlog; replica-level queues are on top)."""
+        with self._lock:
+            return len(self._pending)
+
+    # --------------------------------------------------------- routing
+
+    def _load_key(self, m: _Member):
+        q, busy, lanes = m.replica.load()
+        load = (busy + q) / max(lanes, 1) + m.inflight
+        obs.gauge("router.replica_load", load, replica=m.replica.name)
+        return load
+
+    def _candidates_locked(self, req: _Routed, exclude):
+        now = self._clock()
+        cands = [m for n, m in self._members.items()
+                 if m.up and not m.draining and n not in exclude]
+        if req.prefix_id is not None:
+            have = [m for m in cands
+                    if req.prefix_id in self._affinity.get(
+                        m.replica.name, {}).get("prefix_ids", ())]
+            if not have:
+                raise ValueError(
+                    f"prefix_id {req.prefix_id} is not resident on "
+                    "any live replica (pool entries are replica-"
+                    "local; pin it somewhere first)")
+            cands = have
+        return cands, now
+
+    def _affinity_score(self, req: _Routed, name: str) -> int:
+        tab = self._affinity.get(name)
+        if not tab:
+            return 0
+        score = 0
+        block = tab.get("block")
+        if block:
+            resident = tab.get("stem_hashes", ())
+            for h in req.stems_at(block):
+                if h in resident:
+                    score += block
+                else:
+                    break
+        if req.prefix_id is not None and \
+                req.prefix_id in tab.get("prefix_ids", ()):
+            score += 1
+        return score
+
+    def _route_locked(self, req: _Routed, exclude=frozenset(),
+                      rerouting: bool = False) -> bool:
+        """Pick a replica and admit ``req`` on it.  Returns True on
+        acceptance; parks the request in the router backlog (False)
+        when every candidate is saturated AND the request was already
+        accepted (a reroute must never surface QueueFull to a caller
+        who holds an id); raises QueueFull for a fresh enqueue."""
+        try:
+            cands, now = self._candidates_locked(req, exclude)
+        except ValueError:
+            if not rerouting:
+                raise
+            # Pool entries are replica-local: a prefix_id request
+            # whose only advertising replica died cannot be served
+            # anywhere — terminal structured error, never an
+            # exception out of the pump round.
+            self._finish_locked(
+                req, req.prompt, "error", req.prompt.size,
+                error=f"prefix_id {req.prefix_id} is no longer "
+                      "resident on any live replica (its replica "
+                      "died or drained)")
+            return True
+        if not cands and not rerouting:
+            raise RuntimeError("router has no live replicas")
+        scored = []
+        for m in cands:
+            s = (self._affinity_score(req, m.replica.name)
+                 if self.policy == "affinity" else 0)
+            degraded = 1 if m.degraded_until > now else 0
+            scored.append((m, s, degraded))
+        if self.policy == "round_robin":
+            order = sorted(scored, key=lambda t: t[2])
+            order = order[self._rr % len(order):] \
+                + order[:self._rr % len(order)] if order else order
+            self._rr += 1
+        else:
+            order = sorted(
+                scored, key=lambda t: (-t[1], t[2],
+                                       self._load_key(t[0]),
+                                       t[0].replica.name))
+        saw_full = False
+        for i, (m, score, _deg) in enumerate(order):
+            name = m.replica.name
+            kw = dict(req.kw)
+            if req.deadline is not None:
+                remaining = req.deadline - self._clock()
+                if remaining <= 0:
+                    self._finish_locked(req, req.prompt, "timeout",
+                                        req.prompt.size)
+                    return True
+                kw["ttl"] = remaining
+            try:
+                rrid = m.replica.enqueue(req.prompt, req.max_new, **kw)
+            except QueueFull:
+                saw_full = True
+                continue
+            except (EngineClosed, ReplicaUnreachable):
+                # Racing its own shutdown/death: health probing will
+                # flip it down; skip it for this route.
+                continue
+            reason = ("reroute" if rerouting
+                      else "spillover" if i > 0
+                      else "affinity" if score > 0
+                      else self.policy if self.policy != "affinity"
+                      else "least_loaded")
+            req.replica, req.replica_rid = name, rrid
+            req.epoch = self.epoch
+            m.inflight += 1
+            obs.count("router.requests", replica=name, reason=reason)
+            if reason == "affinity":
+                obs.count("router.affinity_hits")
+            obs.event("router.route", request_id=req.request_id,
+                      replica=name, replica_request_id=rrid,
+                      reason=reason, hop=req.hops)
+            # Optimistic history insert: the stems this request
+            # prefills become resident on that replica.
+            tab = self._affinity.setdefault(
+                name, {"stem_hashes": set(), "prefix_ids": set(),
+                       "block": None})
+            if tab.get("block"):
+                tab["stem_hashes"].update(
+                    req.stems_at(tab["block"]))
+            return True
+        if rerouting:
+            # Accepted request, fleet momentarily saturated: park in
+            # the router backlog; pump() retries.
+            req.replica, req.replica_rid = None, None
+            if req.request_id not in self._pending:
+                self._pending.append(req.request_id)
+            obs.gauge("router.pending", len(self._pending))
+            return False
+        if saw_full:
+            raise QueueFull(
+                f"all {len(cands)} live replica(s) are saturated "
+                "(every admission queue full); shed load or add "
+                "replicas")
+        raise RuntimeError(
+            "no live replica accepted the request (all closed or "
+            "unreachable)")
+
+    def _reroute_from_locked(self, name: str, why: str) -> None:
+        for req in list(self._requests.values()):
+            if req.replica != name or req.request_id \
+                    in self._completed:
+                continue
+            req.hops += 1
+            obs.count("router.reroutes")
+            obs.event("router.reroute", request_id=req.request_id,
+                      src=name, why=why, hop=req.hops)
+            self._route_locked(req, exclude={name}, rerouting=True)
+        m = self._members.get(name)
+        if m is not None:
+            m.inflight = 0
+
+    # ---------------------------------------------------- result pump
+
+    def _finish_locked(self, req: _Routed, tokens, status: str,
+                       prompt_len: int, error=None) -> None:
+        self._completed[req.request_id] = RequestResult(
+            request_id=req.request_id,
+            tokens=np.asarray(tokens, np.int32), status=status,
+            prompt_len=prompt_len, error=error)
+        self._requests.pop(req.request_id, None)
+        obs.count("router.finished", status=status)
+        obs.event("router.finish", request_id=req.request_id,
+                  status=status, replica=req.replica,
+                  hops=req.hops)
+        if obs.active() is not None:
+            obs.observe("router.request_s", self._clock() - req.born,
+                        status=status)
+
+    def _refresh_one(self, name: str) -> bool:
+        """Pull one replica's residency digest into the affinity
+        table (network I/O for remote replicas — runs OUTSIDE the
+        router lock).  Returns reachability."""
+        with self._lock:
+            m = self._members.get(name)
+            if m is None:
+                return False
+            replica = m.replica
+        try:
+            res = replica.residency()
+        except Exception:  # noqa: BLE001 — unreachable OR a malformed
+            return False   # doc: either way, not a usable table yet
+        tab = {"stem_hashes": set(res.get("stem_hashes", ())),
+               "prefix_ids": set(res.get("prefix_ids", ())),
+               "block": res.get("block")}
+        with self._lock:
+            if name in self._members:
+                self._affinity[name] = tab
+        return True
+
+    def refresh_residency(self) -> None:
+        """Rebuild the affinity table from every up replica's
+        residency digest (ground truth replaces the optimistic
+        history)."""
+        with self._lock:
+            names = [n for n, m in self._members.items() if m.up]
+            self._last_residency = self._clock()
+        for n in names:
+            self._refresh_one(n)
+
+    def pump(self) -> list[int]:
+        """One router bookkeeping round: poll every routed request's
+        CURRENT replica, collect results, health-gate membership
+        (down replicas trigger drain-and-reroute, recovered ones
+        rejoin under a new epoch), retry the parked backlog, and
+        refresh residency on cadence.  Returns newly completed
+        request ids.  Poll and health network I/O run OUTSIDE the
+        router lock; re-admission to a replica (the reroute/backlog
+        ``enqueue``) runs under it — route-and-record must be atomic
+        — so with remote replicas that leg can hold the lock for up
+        to the replica timeout per candidate (the bounded stall the
+        lock inventory documents)."""
+        with self._lock:
+            now = self._clock()
+            todo = [(req.request_id, req.replica, req.replica_rid,
+                     req.epoch)
+                    for req in self._requests.values()
+                    if req.replica is not None]
+            due = [(n, m.replica) for n, m in self._members.items()
+                   if now - m.last_health >= self.health_interval]
+            replicas = {n: m.replica for n, m in self._members.items()}
+            residency_due = (now - self._last_residency
+                             >= self.residency_interval)
+
+        polled: dict[int, object] = {}
+        assignment = {rid: (name, rrid, ep)
+                      for rid, name, rrid, ep in todo}
+        dead: set[str] = set()
+        for rid, name, rrid, _ep in todo:
+            if name in dead:
+                continue
+            try:
+                polled[rid] = replicas[name].poll(rrid)
+            except ReplicaUnreachable:
+                dead.add(name)
+        health: dict[str, bool] = {}
+        for n, replica in due:
+            if n in dead:
+                health[n] = False
+                continue
+            try:
+                health[n] = replica.healthy()
+            except Exception:  # noqa: BLE001 — a broken probe is down
+                health[n] = False
+
+        completed = []
+        with self._lock:
+            now = self._clock()
+            # Results FIRST, membership second: a request its replica
+            # finished just before dying must be recorded, not
+            # rerouted (and the inflight accounting must hit the
+            # replica that actually served it).
+            for rid, res in polled.items():
+                req = self._requests.get(rid)
+                if req is None or res is None:
+                    continue
+                name, rrid, _ep = assignment[rid]
+                if (req.replica != name or req.replica_rid != rrid
+                        or rid in self._completed):
+                    # Rerouted/finished while the poll was in flight:
+                    # the result belongs to a STALE hop — drop it
+                    # (the epoch-stamped-assignment check; recording
+                    # it would also debit the new replica's inflight
+                    # for work it is still doing).
+                    continue
+                m = self._members.get(name)
+                if m is not None and m.inflight > 0:
+                    m.inflight -= 1
+                self._finish_locked(req, res.tokens, res.status,
+                                    res.prompt_len, error=res.error)
+                completed.append(rid)
+            for n, ok in health.items():
+                m = self._members.get(n)
+                if m is None:
+                    continue
+                m.last_health = now
+                if m.up and not ok:
+                    m.up = False
+                    self.epoch += 1
+                    obs.event("router.replica_down", replica=n,
+                              epoch=self.epoch)
+                    self._reroute_from_locked(n, why="health")
+                elif not m.up and ok:
+                    m.up = True
+                    self.epoch += 1
+                    # Its cache died with it: a fresh affinity entry,
+                    # refilled from residency on the next refresh.
+                    self._affinity.pop(n, None)
+                    obs.event("router.replica_up", replica=n,
+                              epoch=self.epoch)
+            for n in dead:
+                m = self._members.get(n)
+                if m is not None and m.up:
+                    m.up = False
+                    self.epoch += 1
+                    obs.event("router.replica_down", replica=n,
+                              epoch=self.epoch)
+                    self._reroute_from_locked(n, why="unreachable")
+            # Parked backlog: a replica may have freed capacity.
+            still = []
+            for rid in self._pending:
+                req = self._requests.get(rid)
+                if req is None:
+                    continue
+                if not self._route_locked(req, rerouting=True):
+                    still.append(rid)
+            self._pending = still
+            obs.gauge("router.pending", len(self._pending))
+        if residency_due:
+            self.refresh_residency()
+        return completed
+
+    def step(self) -> list[int]:
+        """Drive one decode step on every up in-process replica, then
+        :meth:`pump`.  Replica engine locks are taken OUTSIDE the
+        router lock here (step is long; holding the router lock
+        across it would stall concurrent enqueues)."""
+        with self._lock:
+            reps = [m.replica for m in self._members.values()
+                    if m.up and not getattr(m.replica, "remote", False)]
+        for r in reps:
+            try:
+                r.step()
+            except Exception:  # noqa: BLE001 — a dying replica's step
+                pass           # failure is health probing's to report
+        return self.pump()
+
+    # -------------------------------------------------------- lifecycle
+
+    def drain(self, request_id: int, max_steps: int = 100_000):
+        """Block until ``request_id`` finishes (driving
+        :meth:`step`); returns its result."""
+        for _ in range(max_steps):
+            with self._lock:
+                res = self._completed.get(request_id)
+                known = request_id in self._requests
+            if res is not None:
+                return res
+            if not known:
+                raise KeyError(f"unknown request {request_id}")
+            self.step()
+            if self._all_remote():
+                time.sleep(self.poll_s)
+        raise TimeoutError(
+            f"request {request_id} did not finish in {max_steps} "
+            "steps")
+
+    def _all_remote(self) -> bool:
+        with self._lock:
+            return all(getattr(m.replica, "remote", False)
+                       for m in self._members.values()) \
+                and bool(self._members)
+
+    def begin_shutdown(self) -> None:
+        """Stop admission (enqueue raises :class:`EngineClosed`;
+        EngineClosed wins the race with an in-flight enqueue — the
+        engine contract, one level up)."""
+        with self._lock:
+            self._closed = True
+
+    def shutdown(self, max_steps: int | None = None) -> dict:
+        """Drain-then-shutdown: stop admission, pump until every
+        accepted request is terminal (or ``max_steps`` trips —
+        stragglers get structured ``"cancelled"`` results), and return
+        all results.  Replica objects are left open: the router does
+        not own their lifecycle."""
+        self.begin_shutdown()
+        steps = 0
+        while True:
+            with self._lock:
+                live = bool(self._requests)
+            if not live:
+                break
+            if max_steps is not None and steps >= max_steps:
+                break
+            self.step()
+            steps += 1
+            if self._all_remote():
+                time.sleep(self.poll_s)
+        with self._lock:
+            for req in list(self._requests.values()):
+                self._finish_locked(req, req.prompt, "cancelled",
+                                    req.prompt.size)
+            self._pending = []
+        return self.results()
+
+
+# ------------------------------------------------------- the endpoint
+
+
+class EngineEndpoint:
+    """Serve one engine's admission surface over HTTP — the remote
+    half of :class:`HttpReplica` (stdlib ``ThreadingHTTPServer``; the
+    handlers call the engine's thread-safe admission surface, so this
+    module stays jax-free and an endpoint thread can never compile a
+    program).
+
+    ================  ====================================================
+    route             serves
+    ================  ====================================================
+    ``POST /enqueue``  ``{"prompt": [...], "max_new_tokens": n, ...}``
+                       -> ``{"request_id": id}``; 429 = QueueFull
+                       (backpressure), 503 = EngineClosed, 400 =
+                       validation error
+    ``GET /poll?id=``  the terminal ``RequestResult`` as JSON, or 404
+                       while the request decodes
+    ``GET /residency`` the engine's residency digest (stem hashes,
+                       prefix ids, block, live load) — the router's
+                       affinity/ load source
+    ``GET /healthz``   200 while the engine admits, 503 once closed
+    ================  ====================================================
+
+    ``start(step=True)`` also runs the decode loop on a daemon thread
+    (the replica-process deployment shape).  When the ``DKT_CLUSTER_*``
+    env contract is present (or ``coord_dir=`` is given), the bound
+    address publishes to ``<coord_dir>/serve/host<N>.addr`` for
+    :func:`discover_replicas` — the same ledger pattern as telemetry
+    federation.
+    """
+
+    def __init__(self, engine, *, port: int = 0,
+                 bind: str = "127.0.0.1", coord_dir: str | None = None,
+                 host_id: int | None = None, rid_base: int | None = None):
+        import os
+
+        self.engine = engine
+        self._want_port = port
+        self._bind = bind
+        env = os.environ
+        if coord_dir is None and "DKT_CLUSTER_DIR" in env:
+            coord_dir = env["DKT_CLUSTER_DIR"]
+        if host_id is None:
+            host_id = int(env.get("DKT_CLUSTER_HOST", "0"))
+        self.coord_dir = coord_dir
+        self.host_id = host_id
+        if rid_base is None:
+            rid_base = (host_id + 1) * RID_SPAN
+        if engine._next_id < rid_base:
+            engine._next_id = rid_base
+        self.port = None
+        self._httpd = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # ---------------------------------------------------------- serve
+
+    def start(self, step: bool = True,
+              idle_s: float = 0.005) -> "EngineEndpoint":
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        from urllib.parse import parse_qs, urlparse
+
+        endpoint = self
+
+        class Handler(BaseHTTPRequestHandler):
+            server_version = "dkt-engine/1.0"
+
+            def log_message(self, *a):  # pragma: no cover — quiet
+                pass
+
+            def _send(self, code, obj):
+                data = json.dumps(obj, default=_jsonable).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                url = urlparse(self.path)
+                try:
+                    if url.path == "/poll":
+                        q = parse_qs(url.query)
+                        rid = int(q.get("id", ["-1"])[0])
+                        res = endpoint.engine.poll(rid)
+                        if res is None:
+                            self._send(404, {"pending": rid})
+                        else:
+                            self._send(200, _result_doc(res))
+                    elif url.path == "/residency":
+                        self._send(200, endpoint.engine.residency())
+                    elif url.path == "/healthz":
+                        ok = not endpoint.engine.closed
+                        self._send(200 if ok else 503, {"ok": ok})
+                    else:
+                        self._send(404, {"error": f"unknown "
+                                         f"{url.path}"})
+                except BrokenPipeError:  # pragma: no cover
+                    pass
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    try:
+                        self._send(500,
+                                   {"error": f"{type(e).__name__}: "
+                                             f"{e}"})
+                    except Exception:  # pragma: no cover
+                        pass
+
+            def do_POST(self):  # noqa: N802 — http.server API
+                url = urlparse(self.path)
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    if url.path != "/enqueue":
+                        self._send(404, {"error": f"unknown "
+                                         f"{url.path}"})
+                        return
+                    prompt = np.asarray(body.pop("prompt"), np.int32)
+                    max_new = int(body.pop("max_new_tokens"))
+                    try:
+                        rid = endpoint.engine.enqueue(prompt, max_new,
+                                                      **body)
+                    except QueueFull as e:
+                        self._send(429, {"error": str(e)})
+                        return
+                    except EngineClosed as e:
+                        self._send(503, {"error": str(e)})
+                        return
+                    except (ValueError, KeyError) as e:
+                        self._send(400, {"error": str(e)})
+                        return
+                    self._send(200, {"request_id": rid})
+                except BrokenPipeError:  # pragma: no cover
+                    pass
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    try:
+                        self._send(500,
+                                   {"error": f"{type(e).__name__}: "
+                                             f"{e}"})
+                    except Exception:  # pragma: no cover
+                        pass
+
+        if self._httpd is not None:
+            raise RuntimeError("endpoint already started")
+        self._httpd = ThreadingHTTPServer((self._bind, self._want_port),
+                                          Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             kwargs={"poll_interval": 0.2},
+                             name="dkt-engine-endpoint", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if step:
+            s = threading.Thread(target=self._step_loop,
+                                 args=(idle_s,),
+                                 name="dkt-engine-step", daemon=True)
+            s.start()
+            self._threads.append(s)
+        self._publish_addr()
+        return self
+
+    def _step_loop(self, idle_s: float) -> None:
+        while not self._stop.is_set():
+            eng = self.engine
+            if eng.running() or eng.queued:
+                try:
+                    eng.step()
+                except Exception:  # noqa: BLE001 — a step crash must
+                    self._stop.wait(idle_s)  # not spin the thread hot
+            else:
+                self._stop.wait(idle_s)
+
+    @property
+    def addr(self) -> str:
+        return f"{self._bind}:{self.port}"
+
+    def _publish_addr(self) -> None:
+        import os
+
+        if self.coord_dir is None:
+            return
+        d = os.path.join(self.coord_dir, "serve")
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".addr.{self.host_id}.{os.getpid()}.tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"host": self.host_id, "addr": self.addr,
+                       "pid": os.getpid()}, f)
+        os.replace(tmp, os.path.join(d, f"host{self.host_id}.addr"))
+
+    def _unpublish_addr(self) -> None:
+        import os
+
+        if self.coord_dir is None:
+            return
+        try:
+            os.remove(os.path.join(self.coord_dir, "serve",
+                                   f"host{self.host_id}.addr"))
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        self._unpublish_addr()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def _result_doc(res: RequestResult) -> dict:
+    return {"request_id": int(res.request_id),
+            "tokens": np.asarray(res.tokens, np.int32).tolist(),
+            "status": res.status,
+            "prompt_len": int(res.prompt_len), "error": res.error}
+
+
+def _jsonable(o):
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, np.generic):
+        return o.item()
+    return str(o)
+
+
+__all__ = ["Router", "InProcessReplica", "HttpReplica",
+           "EngineEndpoint", "ReplicaUnreachable", "discover_replicas",
+           "RID_SPAN"]

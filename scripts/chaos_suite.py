@@ -36,9 +36,19 @@ bit-for-bit identical to an uninterrupted two-host run.  Every
 attempt's obs trace is merged (obs_report --merge machinery) into ONE
 cross-host fault/recovery timeline, printed as JSON lines.
 
+Round 13 adds the SERVING leg of ``--cluster``: ``serve_kill`` runs
+two engine-replica processes (PagedBatcher behind an EngineEndpoint,
+heartbeats + federation-published telemetry, lock sanitizer on) under
+a cache-aware Router in the suite process, SIGKILLs one replica
+mid-stream, and asserts drain-and-reroute completes every accepted
+request, the dead replica's series drop out of ``/metrics/cluster``
+and return after its restart, the merged timeline shows the re-route
+hop, and every lock report is clean.
+
 Usage: python scripts/chaos_suite.py [--seed N] [--kill-rounds 3,7,12]
                                      [--trace chaos.jsonl]
        python scripts/chaos_suite.py --cluster [--scenarios kill,stall]
+       python scripts/chaos_suite.py --cluster --scenarios serve_kill
 """
 
 import argparse
@@ -319,6 +329,282 @@ def _free_port():
         return s.getsockname()[1]
 
 
+# ------------------------------------------------ router serve ladder
+#
+# The round-13 serving leg of --cluster: TWO engine-replica processes
+# (each: a PagedBatcher behind an EngineEndpoint, heartbeats, the
+# live telemetry server federation-published, lock sanitizer on), a
+# cache-aware Router in THIS process streaming requests at them, and
+# a SIGKILL of replica 1 mid-stream.  Drain-and-reroute must complete
+# every accepted request, the dead replica's series must drop out of
+# /metrics/cluster and return after the restart, the merged timeline
+# must show the re-route hop, and every lock report must be clean.
+
+ROUTER_CHILD = '''
+import os, sys, time
+os.environ["KERAS_BACKEND"] = "jax"
+os.environ.setdefault("DKT_LOCK_SANITIZER", "1")
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+
+host = int(os.environ["DKT_CLUSTER_HOST"])
+from distkeras_tpu import obs
+from distkeras_tpu.resilience.health import HeartbeatWriter
+
+trace = os.path.join({tracedir!r},
+                     "replica%d.%d.jsonl" % (host, os.getpid()))
+# serve_port=0: /metrics etc on an ephemeral port, published into the
+# coord dir's telemetry/ ledger via the DKT_CLUSTER_* env — what the
+# federation scraper proves drops and returns across the kill.
+obs.enable(trace_path=trace, serve_port=0)
+hb = HeartbeatWriter(os.path.join(os.environ["DKT_CLUSTER_DIR"], "hb"),
+                     host, interval=0.2).start()
+
+import numpy as np
+from distkeras_tpu.models import transformer as tfm
+from distkeras_tpu.serving import PagedBatcher
+from distkeras_tpu.serving.router import EngineEndpoint
+
+cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_len=128,
+                            rope=True)
+params = tfm.init_params(jax.random.key({seed}), cfg)
+eng = PagedBatcher(params, cfg, lanes=2, block=8, n_blocks=33,
+                   max_queue=16, prompt_buckets=(16,))
+# Fixed port (parent-chosen): a restarted replica binds the SAME
+# address, so the router's handle revives on the next health probe.
+ep = EngineEndpoint(eng, port=int(os.environ["DKT_SERVE_PORT"]))
+ep.start(step=True)
+obs.event("router_child", host=host, phase="serving", port=ep.port)
+print("REPLICA", host, "UP", ep.port, flush=True)
+stop = os.path.join(os.environ["DKT_CLUSTER_DIR"], "stop%d" % host)
+while not os.path.exists(stop):
+    time.sleep(0.1)
+ep.stop()
+from distkeras_tpu.utils import locks as _locks
+_rep = _locks.lock_report()
+obs.event("locks.report", host=host, **_rep)
+assert not _rep["violations"], (
+    "lock sanitizer violations on replica %d:\\n" % host
+    + "\\n".join(v.format() for v in _locks.violations()))
+hb.mark_done()
+obs.disable()
+print("REPLICA", host, "DONE", flush=True)
+'''
+
+
+def run_router_kill_scenario(seed, workdir, n_req=12, kill_after=4):
+    """The kill-a-replica-mid-stream leg.  Returns the number of
+    failed assertions (0 = green), printing the same PASS/FAIL +
+    timeline blocks as the training scenarios."""
+    import glob
+    import json
+    import urllib.request
+
+    import numpy as np
+
+    from distkeras_tpu import obs
+    from distkeras_tpu.obs.report import merge_traces
+    from distkeras_tpu.serving.router import HttpReplica, Router
+    from distkeras_tpu.utils import locks
+
+    print("== cluster scenario: serve_kill (router drain-and-reroute)"
+          " ==", flush=True)
+    base = os.path.join(workdir, "serve_kill")
+    coord = os.path.join(base, "coord")
+    tracedir = os.path.join(base, "traces")
+    os.makedirs(tracedir, exist_ok=True)
+    os.makedirs(coord, exist_ok=True)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(base, "replica.py")
+    with open(script, "w", encoding="utf-8") as f:
+        f.write(ROUTER_CHILD.format(repo=repo, tracedir=tracedir,
+                                    seed=seed))
+    ports = [_free_port(), _free_port()]
+
+    def launch(h):
+        import subprocess
+
+        env = {**os.environ,
+               "DKT_CLUSTER_DIR": coord,
+               "DKT_CLUSTER_HOST": str(h),
+               "DKT_CLUSTER_NHOSTS": "2",
+               "DKT_CLUSTER_WINDOW": "2.0",
+               "DKT_SERVE_PORT": str(ports[h])}
+        return subprocess.Popen([sys.executable, script], env=env)
+
+    def wait_port(h, deadline):
+        import time as _time
+
+        while True:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{ports[h]}/healthz",
+                    timeout=1.0).read()
+                return
+            except Exception:  # noqa: BLE001 — still starting
+                assert _time.time() < deadline, \
+                    f"replica {h} never came up on port {ports[h]}"
+                _time.sleep(0.2)
+
+    import time as _time
+
+    locks.enable_sanitizer()
+    children = [launch(0), launch(1)]
+    scraper = _FederationScraper(coord)
+    scraper.start()
+    rng = np.random.default_rng(seed)
+    router_trace = os.path.join(tracedir, "router.jsonl")
+    failures = 0
+    sess = None
+    try:
+        wait_port(0, _time.time() + 180)
+        wait_port(1, _time.time() + 180)
+        sess = obs.enable(trace_path=router_trace)
+        router = Router(
+            [HttpReplica("host0", f"127.0.0.1:{ports[0]}"),
+             HttpReplica("host1", f"127.0.0.1:{ports[1]}")],
+            policy="least_loaded", health_interval=0.3)
+        stem = rng.integers(0, 64, (8,)).astype(np.int32)
+        prompts = [np.concatenate(
+            [stem, rng.integers(0, 64, (4,)).astype(np.int32)])
+            for _ in range(n_req)]
+
+        def serve_wave(wave_rids, deadline):
+            done = set()
+            while len(done) < len(wave_rids):
+                assert _time.time() < deadline, (
+                    f"serve_kill stalled: {len(done)}/"
+                    f"{len(wave_rids)} done, "
+                    f"up={router.replicas_up()}")
+                router.pump()
+                for r in wave_rids:
+                    if r not in done and router.poll(r) is not None:
+                        done.add(r)
+                _time.sleep(0.05)
+
+        # Wave 1: short requests, both replicas serving (also warms
+        # every program outside the kill window).
+        first = [router.enqueue(p, 8) for p in prompts[:kill_after]]
+        serve_wave(first, _time.time() + 180)
+        # Wave 2: LONG decodes, and the SIGKILL lands immediately
+        # after their acceptance — the victim is guaranteed to hold
+        # accepted, unfinished requests when it dies (enqueue is
+        # synchronous: an id returned means the replica accepted).
+        rest = [router.enqueue(p, 100) for p in prompts[kill_after:]]
+        on_victim = sum(
+            1 for r in rest
+            if router._requests[r].replica == "host1")
+        children[1].kill()
+        children[1].wait(timeout=30)
+        print(f"  killed replica 1 holding {on_victim} accepted "
+              "request(s)", flush=True)
+        assert on_victim >= 1, (
+            "least-loaded spread put nothing on the victim — the "
+            "kill exercised no reroute")
+        serve_wave(rest, _time.time() + 300)
+        rids = first + rest
+        results = {r: router.take(r) for r in rids}
+        lost = [r for r, v in results.items() if not v.ok]
+        assert not lost, (
+            f"accepted requests lost across the kill: "
+            f"{[(r, results[r].status) for r in lost]}")
+        snap = sess.registry.snapshot()
+        n_reroutes = sum(
+            s.get("value", 0) for s in
+            snap.get("router.reroutes", {}).get("series", []))
+        assert n_reroutes >= 1, \
+            "the kill produced no drain-and-reroute"
+        # Coordinated-restart half: the SAME address comes back and
+        # the router's handle revives on a health probe.
+        children[1] = launch(1)
+        wait_port(1, _time.time() + 180)
+        deadline = _time.time() + 60
+        while "host1" not in router.replicas_up():
+            assert _time.time() < deadline, \
+                "restarted replica never rejoined the router"
+            router.pump()
+            _time.sleep(0.1)
+        extra = router.enqueue(prompts[0], 4)
+        deadline = _time.time() + 120
+        while router.poll(extra) is None:
+            assert _time.time() < deadline, \
+                "post-restart request never finished"
+            router.pump()
+            _time.sleep(0.05)
+        assert router.take(extra).ok
+        print(f"  PASS  cluster/serve_kill: {n_req} streamed + 1 "
+              f"post-restart request ok, {int(n_reroutes)} "
+              "reroute(s), replica rejoined", flush=True)
+    except Exception as e:  # noqa: BLE001 — report the ladder
+        failures += 1
+        print(f"  FAIL  cluster/serve_kill: {type(e).__name__}: {e}")
+    finally:
+        if sess is not None:
+            obs.disable()
+        for h in (0, 1):
+            with open(os.path.join(coord, f"stop{h}"), "w"):
+                pass
+        for c in children:
+            try:
+                c.wait(timeout=60)
+            except Exception:  # noqa: BLE001 — force it down
+                c.kill()
+        samples = scraper.stop()
+
+    # Federation: both hosts seen, the killed one's series drop out,
+    # then return after the restart.
+    hosts_seen = [up for _, up in samples]
+    try:
+        both = next(i for i, up in enumerate(hosts_seen)
+                    if up >= {0, 1})
+        gone = next(i for i in range(both, len(hosts_seen))
+                    if 0 in hosts_seen[i] and 1 not in hosts_seen[i])
+        assert any(up >= {0, 1} for up in hosts_seen[gone:]), (
+            "killed replica's series never returned to "
+            "/metrics/cluster")
+    except (StopIteration, AssertionError) as e:
+        failures += 1
+        print(f"  FAIL  cluster/serve_kill federation: "
+              f"{type(e).__name__}: {e} (samples: {hosts_seen[:30]})")
+
+    # Merged cross-process timeline: the re-route hop must be visible,
+    # and every completing process must report a clean lock ledger.
+    traces = sorted(glob.glob(os.path.join(tracedir, "*.jsonl")))
+    merged = merge_traces(traces)
+    print("--- cross-process serve timeline (serve_kill, JSONL) ---")
+    for e in merged["timeline"]:
+        if e["name"].startswith(("router", "locks", "serving.finish")):
+            print(json.dumps({"t": round(e["t"], 4),
+                              "host": e["host"], "event": e["name"],
+                              **e["fields"]}))
+    if not any(e["name"] == "router.reroute"
+               for e in merged["timeline"]):
+        failures += 1
+        print("  FAIL  cluster/serve_kill: no router.reroute hop in "
+              "the merged timeline")
+    reports = [e for e in merged["timeline"]
+               if e["name"] == "locks.report"]
+    hosts_reported = {e["fields"].get("host") for e in reports}
+    if not hosts_reported >= {0, 1}:
+        failures += 1
+        print(f"  FAIL  cluster/serve_kill: lock report missing for "
+              f"replica(s) {sorted({0, 1} - hosts_reported)}")
+    bad = [e for e in reports if e["fields"].get("violations")]
+    if bad:
+        failures += 1
+        print("  FAIL  cluster/serve_kill: lock sanitizer "
+              "violation(s) in replica report(s)")
+    if locks.violation_count():
+        failures += 1
+        print("  FAIL  cluster/serve_kill: router-process lock "
+              "sanitizer violations:")
+        for v in locks.violations():
+            print("  VIOLATION " + v.format())
+    return failures
+
+
 # SLO breach classes (metric names) the cluster ladder tolerates.
 # Empty on purpose: the in-child rule (train.step_s p99 < 60s over a
 # 30s window) is generous enough that ANY breach means a real latency
@@ -432,20 +718,28 @@ def run_cluster_scenario(scenario, seed, workdir, window=2.0,
 
 def run_cluster_ladder(scenarios, seed, workdir):
     """The --cluster entry: reference run + one chaos run per
-    scenario, bit-for-bit weight comparison, merged cross-host
-    timeline per scenario.  Returns the number of failures."""
+    training scenario (bit-for-bit weight comparison, merged
+    cross-host timeline), plus the round-13 ``serve_kill`` router leg
+    (kill-a-replica-mid-stream).  Returns the number of failures."""
     import json
 
     import numpy as np
 
     from distkeras_tpu.obs.report import merge_traces
 
+    failures = 0
+    scenarios = list(scenarios)
+    if "serve_kill" in scenarios:
+        scenarios.remove("serve_kill")
+        failures += run_router_kill_scenario(seed, workdir)
+    if not scenarios:
+        return failures
+
     print("== cluster ladder: uninterrupted 2-host reference ==",
           flush=True)
     _, ref_out, _, _ = run_cluster_scenario(None, seed, workdir)
     ref = np.load(ref_out)
 
-    failures = 0
     for scenario in scenarios:
         print(f"== cluster scenario: {scenario} ==", flush=True)
         try:
@@ -554,10 +848,12 @@ def main():
     ap.add_argument("--cluster", action="store_true",
                     help="run the multi-host coordinated-restart "
                          "ladder instead of the single-host matrix")
-    ap.add_argument("--scenarios", default="kill,stall,drop",
+    ap.add_argument("--scenarios", default="kill,stall,drop,serve_kill",
                     help="--cluster fault kinds to run "
                          "(kill = host loss, stall = wedged heartbeat "
-                         "writer, drop = partition)")
+                         "writer, drop = partition, serve_kill = "
+                         "kill-a-serving-replica-mid-stream under the "
+                         "router)")
     ap.add_argument("--workdir", default=None,
                     help="--cluster scratch dir (default: a temp dir, "
                          "kept on failure)")

@@ -494,6 +494,62 @@ def session_serving_paged_cow():
         "programs")
 
 
+def session_serving_router():
+    """Fleet router session (round 13): TWO in-process paged replicas
+    behind a cache-aware Router.  Engine construction compiles
+    everything (the recorded budget — a warm-cache delta after the
+    serving_paged sessions); the ROUTE-AND-SERVE phase — affinity
+    scoring, stem-shared and fresh admissions through the router,
+    drain-and-reroute off a drained replica, residency refresh — is
+    asserted to compile ZERO programs: the router is jax-free host
+    bookkeeping, and a routing decision must never trigger device
+    work."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.serving import (InProcessReplica, PagedBatcher,
+                                       Router)
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    engines = [PagedBatcher(params, cfg, lanes=2, block=8, n_blocks=17,
+                            max_queue=4, prompt_buckets=(8,))
+               for _ in range(2)]
+    built = _COMPILES["n"]
+    router = Router([InProcessReplica(f"r{i}", e)
+                     for i, e in enumerate(engines)])
+    rng = np.random.default_rng(0)
+    stem = rng.integers(0, 64, (8,)).astype(np.int32)
+    rids = [router.enqueue(np.concatenate(
+        [stem, rng.integers(0, 64, (4,)).astype(np.int32)]), 5)
+        for _ in range(3)]
+    rids.append(router.enqueue(
+        rng.integers(0, 64, (5,)).astype(np.int32), 5))
+    while any(router.poll(r) is None for r in rids):
+        router.step()
+    assert all(router.take(r).status == "ok" for r in rids)
+    assert sum(e.stem_hit_blocks for e in engines) >= 2, \
+        "affinity routing never hit a resident stem"
+    # Drain-and-reroute rides the same warm programs.
+    busy = router.replicas_up()[0]
+    more = [router.enqueue(np.concatenate(
+        [stem, rng.integers(0, 64, (4,)).astype(np.int32)]), 5)
+        for _ in range(2)]
+    router.drain_replica(busy)
+    while any(router.poll(r) is None for r in more):
+        router.step()
+    assert all(router.take(r).status == "ok" for r in more)
+    router.refresh_residency()
+    serve = _COMPILES["n"] - built
+    assert serve == 0, (
+        f"router route-and-serve phase compiled {serve} program(s); "
+        "routing is host bookkeeping — a routing decision must never "
+        "trigger device work")
+
+
 # NOTE: new sessions append at the END — inserting one mid-dict would
 # shift every later session's warm-cache delta budget (module
 # docstring).
@@ -531,6 +587,10 @@ SESSIONS = {
     # the session (the budget is the construction warm-up only).
     "serving_paged": session_serving_paged,
     "serving_paged_cow": session_serving_paged_cow,
+    # Fleet router (round 13): engine construction is the budget; the
+    # route-and-serve phase over 2 in-process replicas is ASSERTED
+    # zero-compile inside the session (the router is jax-free).
+    "serving_router": session_serving_router,
 }
 
 

@@ -14,6 +14,7 @@ Usage::
     python scripts/obs_report.py run.jsonl --json   # the report dict
     python scripts/obs_report.py --merge host0.jsonl host1.jsonl ...
     python scripts/obs_report.py serve.jsonl --request 3
+    python scripts/obs_report.py router.jsonl replica*.jsonl --request 7
 
 ``--compare BASE`` prints a regression diff of NEW (the positional
 trace) against BASE instead of the full report — per-phase total/mean
@@ -22,7 +23,11 @@ deltas, latency percentile deltas, counter drift.
 ``--request ID`` renders ONE serving request's waterfall instead:
 submit -> queue wait -> admission (chunked-prefill spans included) ->
 per-step token emissions with inter-token gaps -> finish, filtered
-from the round-11 per-request ``request_id`` trace propagation.
+from the round-11 per-request ``request_id`` trace propagation.  With
+SEVERAL traces (round 13) the records are wall-clock aligned first
+and the waterfall follows a fleet-wide router id across processes:
+the routing decision, any re-route hop, and each replica's engine
+stages render as one story.
 
 ``--merge`` takes SEVERAL per-host traces (a multi-host run writes one
 file per host per attempt) and renders ONE cross-host event timeline,
@@ -103,13 +108,14 @@ def main(argv):
                 rep, max_events=args.max_events
                 if args.max_events is not None else 200))
         return 0
-    if len(args.trace) != 1:
-        ap.error("several traces need --merge")
+    if len(args.trace) != 1 and args.request is None:
+        ap.error("several traces need --merge or --request")
     if args.request is not None:
-        from distkeras_tpu.obs.trace import read_trace
-
-        wf = report.request_waterfall(read_trace(args.trace[0]),
-                                      args.request)
+        # Several traces: the cross-process fleet case (a routed
+        # request's story spans the router's trace and each replica's)
+        # — records are wall-clock aligned before the waterfall.
+        records = report.merged_records(args.trace)
+        wf = report.request_waterfall(records, args.request)
         if args.json:
             print(json.dumps(wf, indent=1, default=str))
         else:

@@ -190,6 +190,48 @@ def test_bench_load_elastic_and_spec_rows(monkeypatch):
         assert key in extras
 
 
+def test_bench_router_scale_row(monkeypatch):
+    """Round-13 fleet row: router_scale_N drives the enqueue/poll
+    load flow over N in-process replicas on per-replica step threads
+    and reports achieved rps plus TTFT/TPOT percentiles off the obs
+    histograms (needs the active session main() provides)."""
+    import bench_serving as bs
+    from distkeras_tpu import obs
+
+    monkeypatch.setattr(bs, "_cfg", lambda window=None:
+                        _tiny_serving_cfg())
+    sess = obs.enable()
+    try:
+        rate, step_s, _, extras = bs.bench_router_scale(2)(
+            n_req=4, p_len=6, new=5, lanes=1, per_replica_rps=200.0)
+    finally:
+        obs.disable()
+    assert rate > 0 and abs(rate * step_s - 1.0) < 1e-9
+    assert extras["replicas"] == 2 and extras["ok"] == 4
+    for key in ("achieved_rps", "lanes_per_replica", "offered_rps",
+                "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                "tpot_p99_ms"):
+        assert key in extras
+
+
+def test_bench_router_affinity_row(monkeypatch):
+    """The affinity policy must beat (or tie, never lose to)
+    round-robin on stem_hit_blocks over the SAME shuffled trace — the
+    re-prefill work the cache-aware policy exists to avoid."""
+    import bench_serving as bs
+
+    monkeypatch.setattr(bs, "_cfg", lambda window=None:
+                        _tiny_serving_cfg())
+    rate, _, _, extras = bs.bench_router_affinity()(
+        n_stems=2, reqs_per_stem=3, tail_len=4, new=4, lanes=2)
+    assert rate > 0
+    assert extras["affinity_hit_blocks"] > 0
+    assert (extras["affinity_hit_blocks"]
+            >= extras["round_robin_hit_blocks"])
+    assert extras["round_robin_tok_s"] > 0
+    assert _tiny_serving_cfg().max_len % extras["block"] == 0
+
+
 def test_bench_paged_rows(monkeypatch):
     """Round-12 paged-KV rows: the lanes-at-fixed-HBM row reports a
     >= 2x lane multiple at identical slab block counts, the shared-
