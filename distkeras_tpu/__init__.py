@@ -67,8 +67,9 @@ from distkeras_tpu.parallel.exchange import (ExchangeConfig,
                                               exchange_optimizer)
 from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
 from distkeras_tpu.parallel.rules import match_partition_rules
-from distkeras_tpu.parallel.sharding import (ShardingPlan, dp_plan,
-                                              fsdp_plan, tp_plan,
+from distkeras_tpu.parallel.sharding import (ServingPlan, ShardingPlan,
+                                              dp_plan, fsdp_plan,
+                                              serving_plan, tp_plan,
                                               zero1_plan, zero3_plan)
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.data.packing import pack_documents, packing_efficiency
@@ -122,6 +123,8 @@ __all__ = [
     "tp_plan",
     "zero1_plan",
     "zero3_plan",
+    "ServingPlan",
+    "serving_plan",
     "zero1_optimizer",
     "match_partition_rules",
     "collectives",

@@ -138,8 +138,23 @@ def serving_targets() -> list[TraceSpec]:
     dparams = tfm.init_params(jax.random.key(1), draft)
     sb = dk.SpeculativeBatcher(params, dparams, cfg, draft, lanes=2,
                                n_draft=2, temperature=0.7)
+    # Pod-sharded engine (round 14): the decode step whose census
+    # pins the per-step collectives GSPMD inserts for the TP layout
+    # (one psum pair per block + the unembed exchange) — the serve
+    # path's wire budget, the way the training steps pin theirs.
+    # NOTE the CPU partitioner's AR+slice artifact applies here too:
+    # payload/op counts are exact, the reduce-scatter spelling is
+    # declared-level until a hardware session (ROADMAP item 5).
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+    from distkeras_tpu.parallel.sharding import serving_plan
+
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    cbs = dk.ContinuousBatcher(params, cfg, lanes=2,
+                               prompt_buckets=(8,),
+                               plan=serving_plan(), mesh=mesh)
     return (cb.traced_for_analysis() + cbp.traced_for_analysis()
-            + pgd.traced_for_analysis() + sb.traced_for_analysis())
+            + pgd.traced_for_analysis() + sb.traced_for_analysis()
+            + cbs.traced_for_analysis())
 
 
 def _pair(specs: list[TraceSpec]) -> list[TraceSpec]:

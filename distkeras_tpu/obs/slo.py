@@ -58,12 +58,21 @@ class SloRule:
     ``metric`` names a registry histogram (all label sets of the name
     are aggregated — an SLO is about the workload, not one series);
     ``percentile`` is a quantile in (0, 1]; ``threshold`` is in the
-    metric's own unit (seconds for the ``*_s`` conventions)."""
+    metric's own unit (seconds for the ``*_s`` conventions).
+
+    ``replica`` (round 14): the serving-fleet replica this rule is
+    scoped to.  Pure label plumbing — evaluation is unchanged — but
+    every ``slo.breach`` event and subscriber callback for the rule
+    carries it, so a fleet-level consumer
+    (:meth:`~distkeras_tpu.serving.router.Router.breach_demoter`) can
+    demote the RIGHT replica without a hand-built closure per replica;
+    :meth:`Router.slo_rules` stamps one copy per attached replica."""
 
     metric: str
     percentile: float
     threshold: float
     window_s: float = 30.0
+    replica: str | None = None
 
     def __post_init__(self):
         if not 0.0 < self.percentile <= 1.0:
@@ -213,10 +222,12 @@ class SloEngine:
             assert_unlocked("slo.breach subscribers")
         for rule, value in fired:
             if self._emit is not None:
+                labels = ({"replica": rule.replica}
+                          if rule.replica is not None else {})
                 self._emit("slo.breach", metric=rule.metric,
                            q=rule.q_label, value=value,
                            threshold=rule.threshold,
-                           window_s=rule.window_s)
+                           window_s=rule.window_s, **labels)
             for fn in subscribers:
                 try:
                     fn(rule, value)
@@ -257,10 +268,12 @@ class SloEngine:
                     value = win[rule.q_label]
             breached = value is not None and value > rule.threshold
             if breached and not self._breached.get(i):
+                labels = ({"replica": rule.replica}
+                          if rule.replica is not None else {})
                 self.registry.counter(
                     "slo.breaches",
                     "ok->breach transitions per SLO rule").inc(
-                        metric=rule.metric, q=rule.q_label)
+                        metric=rule.metric, q=rule.q_label, **labels)
                 fired.append((rule, value))
             self._breached[i] = breached
         # Ring maintenance: append, prune beyond the longest window.

@@ -190,7 +190,121 @@ def zero3_param_shardings(view_tree, mesh: Mesh, axis: str = "data"):
     return jax.tree.map(lambda _: sh, view_tree)
 
 
+# ----------------------------------------------- the serving KV rules
+#
+# Pod-sharded serving (round 14): the KV cache's placement is DERIVED
+# from the param rules, never authored separately — the rule that
+# shards attention projections over a mesh axis determines which axis
+# the cache's kv-heads dimension shards over, so plan and cache can
+# never disagree (a cache sharded differently from the heads that
+# write it would make GSPMD reshard the slab every token).
+
+# Canonical attention-projection paths of the functional transformer
+# (models/transformer.py init_params), with the index of the HEADS
+# dimension in each kernel's [L, ...] stacked shape.  wq carries
+# n_heads; wk/wv carry kv_heads — both must divide by the axis.
+_ATTN_HEAD_PATHS = (
+    ("layers/attn/wq", 2, "n_heads"),
+    ("layers/attn/wk", 2, "kv_heads"),
+    ("layers/attn/wv", 2, "kv_heads"),
+)
+
+
+def _axes_of(entry) -> tuple:
+    """Mesh axes one PartitionSpec entry names (an entry may be an
+    axis name or a tuple of them)."""
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, tuple) else (entry,)
+
+
+def serving_kv_axis(plan, mesh: Mesh, cfg) -> str | None:
+    """The mesh axis a serving plan shards attention HEADS over — and
+    therefore the axis the KV cache/slab's kv-heads dimension must
+    shard over.  None when the plan leaves attention heads whole
+    (pure-FSDP / replicated plans: params gather on use, the cache
+    replicates, GSPMD still compiles one program).
+
+    Validates head divisibility eagerly and names the offending rule:
+    a head count the axis cannot split would otherwise surface as an
+    inscrutable GSPMD error at first trace.
+    """
+    axis, culprit = None, None
+    for path, head_dim, attr in _ATTN_HEAD_PATHS:
+        for pat, spec in plan.rules:
+            if pat.search(path) is None:
+                continue
+            if callable(spec):
+                # First-match-wins: a callable claiming an attention
+                # path would decide the param placement at device_put
+                # time, where this derivation cannot follow it —
+                # skipping it silently could leave the cache placed
+                # against the heads that write it.  Loud, like every
+                # plan-validation failure in this module.
+                raise ValueError(
+                    f"serving plan rule ({pat.pattern!r}, <callable>) "
+                    f"matches attention path {path!r}; the KV-cache "
+                    "placement is derived from the attention rules, "
+                    "which therefore must be concrete PartitionSpecs "
+                    "— spell the attention rule out (callable rules "
+                    "remain fine for every other path)")
+            spec_t = tuple(spec)
+            entries = (spec_t[head_dim]
+                       if len(spec_t) > head_dim else None)
+            for a in _axes_of(entries):
+                n = int(mesh.shape[a])
+                if n <= 1:
+                    continue
+                heads = int(getattr(cfg, attr))
+                if heads % n:
+                    raise ValueError(
+                        f"serving plan rule ({pat.pattern!r}, "
+                        f"{spec}) shards the head dimension of "
+                        f"{path!r} over mesh axis {a!r} (size {n}), "
+                        f"but {attr}={heads} is not divisible by it — "
+                        "shrink the axis or pick a head count the "
+                        "mesh can split")
+                if axis is not None and a != axis:
+                    raise ValueError(
+                        f"serving plan shards attention heads over "
+                        f"two different mesh axes ({axis!r} via "
+                        f"{culprit!r}, {a!r} via {pat.pattern!r}); "
+                        "the KV cache has ONE heads dimension — use "
+                        "one axis")
+                axis, culprit = a, pat.pattern
+            break  # first-match-wins, like every plan lookup
+    return axis
+
+
+def kv_slab_specs(tree, axis: str | None):
+    """PartitionSpecs for a KV cache / paged block slab / prefix-pool
+    slab: the kv-heads dimension shards over ``axis``, everything else
+    replicates.  Works on every KV layout in the repo because they all
+    end ``[..., kv_heads, head_dim]`` for data leaves and
+    ``[..., kv_heads]`` for the int8 scale leaves — the heads dim is
+    ``ndim-2`` or ``ndim-1`` keyed on the leaf name.  ``axis=None``
+    replicates everything (the pure-FSDP serving layout)."""
+    def leaf(path, a):
+        ndim = getattr(a, "ndim", len(a.shape))
+        if axis is None:
+            return P()
+        hd = ndim - 1 if leaf_name(path).endswith("scale") else ndim - 2
+        spec = [None] * (hd + 1)
+        spec[hd] = axis
+        return P(*spec)
+
+    return jax.tree_util.tree_map_with_path(leaf, tree)
+
+
+def kv_slab_shardings(mesh: Mesh, tree, axis: str | None):
+    """:func:`kv_slab_specs` wrapped into ``NamedSharding`` — the form
+    ``jax.device_put`` and ``with_sharding_constraint`` consume."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), kv_slab_specs(tree, axis))
+
+
 __all__ = ["UnmatchedLeafError", "leaf_name", "compile_rules",
            "first_match", "match_rules", "match_partition_rules",
            "tree_shardings", "shard_view_rule", "zero_state_rules",
-           "zero_state_shardings", "zero3_param_shardings"]
+           "zero_state_shardings", "zero3_param_shardings",
+           "serving_kv_axis", "kv_slab_specs", "kv_slab_shardings"]

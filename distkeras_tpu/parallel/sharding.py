@@ -276,6 +276,52 @@ class Zero3Plan(ShardingPlan):
         )
 
 
+class ServingPlan(ShardingPlan):
+    """A :class:`ShardingPlan` for the SERVE path (round 14): regex
+    rules over the functional transformer's param paths place the
+    parameters, and the KV cache / paged block slab / prefix-pool slab
+    placement is DERIVED from them (``parallel/rules.py``'s
+    ``serving_kv_axis``/``kv_slab_specs``) — the rule that shards
+    attention heads over a mesh axis is what shards the cache's
+    kv-heads dimension, so plan and cache can never disagree.
+
+    Lane/row metadata (positions, current tokens, PRNG keys, page
+    tables) always replicates: it is O(lanes) host bookkeeping, and
+    replicating it keeps the admission scatters collective-free.
+
+    Built by :func:`serving_plan`; consumed by
+    ``ContinuousBatcher(plan=..., mesh=...)`` and
+    ``PagedBatcher(plan=..., mesh=...)`` — which derive the KV axis
+    through ``rules.serving_kv_axis`` (the ONE entry point; it works
+    on any ShardingPlan, so this class adds no method for it).
+    """
+
+
+def serving_plan(extra_rules: Sequence[tuple[str, P]] = (),
+                 fsdp_axis: str | None = None) -> ServingPlan:
+    """The pod-sharded serving plan (ROADMAP item 1, arXiv
+    2004.13336 applied to the serve path): Megatron tensor-parallel
+    rules over the ``model`` axis for the functional transformer's
+    params — the SAME ``tp_rules()`` spellings ``fsdp=True``-era
+    training shards with — so one engine replica spans a whole mesh:
+    attention projections and FFN matmuls shard over ``model``, the KV
+    cache's kv-heads dimension shards with them, per-device param+KV
+    bytes drop ~``model``× and GSPMD inserts the per-token collectives
+    (one psum pair per block + the unembed gather) when the engine
+    compiles its step.
+
+    ``extra_rules`` prepend (first-match-wins, so they override);
+    ``fsdp_axis`` additionally scatters still-unsharded params over
+    that axis (gather-on-use — params only; the cache follows the
+    attention-head rules, never fsdp).  See docs/serving_guide.md
+    "Pod-sharded serving".
+    """
+    from distkeras_tpu.models.transformer import tp_rules
+
+    return ServingPlan(rules=list(extra_rules) + tp_rules(),
+                       batch_spec=P(), fsdp_axis=fsdp_axis)
+
+
 def dp_plan() -> ShardingPlan:
     """Pure data parallelism: replicate weights, split batch on ``data``."""
     return ShardingPlan(rules=(), batch_spec=P("data"))

@@ -138,6 +138,11 @@ class _ElasticLanesMixin:
                            jnp.float32)
         else:
             temps = tps = mps = jnp.zeros((tier,), jnp.float32)
+        # Sharded engines commit rows replicated (lanes.py
+        # _place_rows): dummy and live placement must agree or the
+        # warm-up misses the live state's jit cache entries.
+        cur, pos, keys, temps, tps, mps = self._place_rows(
+            cur, pos, keys, temps, tps, mps)
         return cache, cur, pos, keys, temps, tps, mps
 
     def _warm_tier(self, tier: int) -> None:
@@ -184,13 +189,17 @@ class _ElasticLanesMixin:
     def _warm_host_writes(self, tier: int) -> None:
         # submit()'s host bookkeeping (lane-slot writes) specializes
         # per tier too — tiny scatters, but a compile is a compile.
-        ints = jnp.zeros((tier,), jnp.int32)
+        # Placed like the live rows (sharded engines commit them
+        # replicated), or the live scatter would miss this warm entry.
+        ints = self._place_replicated(jnp.zeros((tier,), jnp.int32))
         ints.at[0].set(0)
         if self._keyed:
-            jnp.stack([jax.random.key(0)] * tier).at[0].set(
+            self._place_replicated(
+                jnp.stack([jax.random.key(0)] * tier)).at[0].set(
                 jax.random.key(0))
         if self.per_request_sampling:
-            jnp.zeros((tier,), jnp.float32).at[0].set(0.0)
+            self._place_replicated(
+                jnp.zeros((tier,), jnp.float32)).at[0].set(0.0)
 
     def _compile_tiers(self) -> None:
         """Compile EVERY tier's programs up front, plus the resize

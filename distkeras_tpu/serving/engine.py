@@ -54,7 +54,8 @@ class _Lane:
 
 
 def _make_lane_admit(model_params, model_cfg, prefix_lane=None,
-                     pooled: bool = False, seed: bool = True):
+                     pooled: bool = False, seed: bool = True,
+                     constrain=None):
     """ONE-lane admission program factory shared by both engines:
     prefill ``rows`` (bucket-padded) into a single lane's cache slice
     at traced start position ``off``, seeded from the engine's static
@@ -68,8 +69,14 @@ def _make_lane_admit(model_params, model_cfg, prefix_lane=None,
 
     ``off`` is traced, so one program per bucket-padded ``rows`` shape
     serves every prefix length and every chunk offset.
+
+    ``constrain``: sharding-constraint hook (pod-sharded engines pass
+    the KV-slab constraint so GSPMD pins the cache layout inside the
+    compiled program instead of inferring it per call).
     """
     def admit(cache, rows, lane, off, *pool):
+        if constrain is not None:
+            cache = constrain(cache)
         lane_cache = jax.tree.map(
             lambda a: jax.lax.dynamic_slice_in_dim(a, lane, 1, axis=1),
             cache)
@@ -101,13 +108,15 @@ def _make_lane_admit(model_params, model_cfg, prefix_lane=None,
             model_params, lane_cache, rows,
             jnp.reshape(off, (1,)).astype(jnp.int32), model_cfg,
             uniform_pos=True)
-        return jax.tree.map(
+        out = jax.tree.map(
             lambda a, u: jax.lax.dynamic_update_slice_in_dim(
                 a, u, lane, axis=1), cache, lane_cache)
+        return constrain(out) if constrain is not None else out
     return jax.jit(admit, donate_argnums=0)
 
 
-def _make_lane_reseed(prefix_lane=None, pooled: bool = False):
+def _make_lane_reseed(prefix_lane=None, pooled: bool = False,
+                      constrain=None):
     """Prefix copy into one lane WITHOUT an admission chunk (1-token
     prompts skip the chunk but still need the prefix K/V)."""
     def reseed(cache, lane, *pool):
@@ -116,9 +125,10 @@ def _make_lane_reseed(prefix_lane=None, pooled: bool = False):
             pre = jax.tree.map(lambda a: jnp.take(a, slot, axis=0), slab)
         else:
             pre = prefix_lane
-        return jax.tree.map(
+        out = jax.tree.map(
             lambda a, p: jax.lax.dynamic_update_slice_in_dim(
                 a, p.astype(a.dtype), lane, axis=1), cache, pre)
+        return constrain(out) if constrain is not None else out
     return jax.jit(reseed, donate_argnums=0)
 
 
@@ -140,6 +150,90 @@ class _LaneEngine(_AdmissionMixin, _ElasticMixin):
     # Engines without a pool leave this None; ContinuousBatcher /
     # SpeculativeBatcher set it from their ``prefix_pool=`` argument.
     _prefix_pool = None
+
+    # Pod-sharded serving (round 14): ``mesh``/``_kv_axis`` are set by
+    # ContinuousBatcher(plan=..., mesh=...); every other engine runs
+    # single-placement and these defaults keep the helpers no-ops.
+    mesh = None
+    plan = None
+    _kv_axis = None
+
+    # ----------------------------------------- sharded-placement hooks
+
+    def _place_replicated(self, x):
+        """Commit a host/device array REPLICATED over the serving mesh
+        (no-op unsharded).  Row metadata and page tables go through
+        here: placement is part of the jit cache key for committed
+        arrays, so warm-up dummies and live state must agree or the
+        serve phase pays a recompile."""
+        if self.mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(x, NamedSharding(self.mesh,
+                                               PartitionSpec()))
+
+    def _put_host(self, arr):
+        """Host numpy -> device array: plain ``device_put`` unsharded,
+        replicated over the serving mesh when sharded (page tables and
+        table rows ride this — their placement must be identical
+        between warm-up and live pushes)."""
+        if self.mesh is None:
+            return jax.device_put(arr)
+        return self._place_replicated(arr)
+
+    def _kv_shardings(self, tree):
+        """NamedShardings placing a KV cache/slab tree under the
+        engine's plan: kv-heads dimension over the derived axis,
+        everything else replicated (``parallel/rules.py``)."""
+        from distkeras_tpu.parallel.rules import kv_slab_shardings
+
+        return kv_slab_shardings(self.mesh, tree, self._kv_axis)
+
+    def _place_kv(self, tree):
+        """Commit a KV cache/slab with the plan-derived sharding
+        (no-op unsharded)."""
+        if self.mesh is None:
+            return tree
+        return jax.device_put(tree, self._kv_shardings(tree))
+
+    def _constrain_kv(self, tree):
+        """``with_sharding_constraint`` pinning the KV layout inside a
+        compiled program, or None when the engine is unsharded — the
+        program factories pass this straight to their ``constrain=``
+        hooks, so GSPMD places the per-token collectives against a
+        DECLARED slab layout instead of one inferred per call."""
+        return jax.lax.with_sharding_constraint(
+            tree, self._kv_shardings(tree))
+
+    @property
+    def _kv_constraint(self):
+        return self._constrain_kv if self.mesh is not None else None
+
+    def memory_footprint(self) -> dict:
+        """Param and KV bytes, total and per device (max over
+        addressable devices) — read from the LIVE arrays' addressable
+        shards, the same ground-truth accounting ``zero=3`` uses for
+        its per-device claim.  Replicated leaves count fully on every
+        device; sharded leaves count 1/n — so the per-device figures
+        ARE the claim ``plan=`` makes (bench rows and
+        tests/test_serving_sharded.py assert from here)."""
+        def account(tree):
+            total, per_dev = 0, {}
+            for leaf in jax.tree.leaves(tree):
+                total += leaf.nbytes
+                for sh in leaf.addressable_shards:
+                    key = repr(sh.device)
+                    per_dev[key] = per_dev.get(key, 0) \
+                        + sh.data.nbytes
+            return total, max(per_dev.values())
+
+        p_total, p_dev = account(self.params)
+        kv_total, kv_dev = account(self.cache)
+        return {"param_bytes": p_total,
+                "param_bytes_per_device": p_dev,
+                "kv_bytes": kv_total,
+                "kv_bytes_per_device": kv_dev}
 
     def free_lanes(self):
         return [i for i, s in enumerate(self._lane_state) if s is None]
@@ -191,11 +285,21 @@ class _LaneEngine(_AdmissionMixin, _ElasticMixin):
         fill them), and the live load signals.  Ground truth, cheap
         (host counters + id lists, no device work), JSON-safe; served
         live by the ``/residency`` telemetry endpoint and consumed by
-        :class:`~distkeras_tpu.serving.router.Router`."""
+        :class:`~distkeras_tpu.serving.router.Router`.
+
+        Mesh-agnostic by construction: the digests are host-side chain
+        hashes of token content (serving/residency.py), so a
+        pod-SHARDED engine publishes exactly the digests its solo twin
+        would — to the router, one sharded engine is ONE replica
+        handle whose mesh is an implementation detail
+        (``model_shards`` is surfaced for operators only, never
+        scored)."""
         with self._admission_lock:
             return {
                 "engine": type(self).__name__,
                 "lanes": self.lanes,
+                "model_shards": (int(self.mesh.shape[self._kv_axis])
+                                 if self._kv_axis is not None else 1),
                 "lanes_busy": len(self.running()),
                 "queue_depth": len(self._pending),
                 "block": None,

@@ -141,6 +141,21 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
     chunked-prefill and prefix-pool engines warm the declared set at
     construction (undeclared windows still compile lazily); plain
     engines ignore it beyond validation.
+
+    **Pod-sharded serving** (round 14, ``plan=``/``mesh=``): ONE
+    engine spans a whole device mesh — params placed by the plan's
+    regex partition rules (``serving_plan()`` is the standard TP
+    layout; ``fsdp_plan()`` works too), the KV cache's kv-heads
+    dimension sharded over whatever axis the plan shards attention
+    heads over (derived — ``parallel/rules.py``), row state
+    replicated, every program compiled at construction under sharding
+    constraints so GSPMD inserts the per-token collectives and the
+    serve phase never compiles.  Emitted tokens are bit-exact vs the
+    solo engine, greedy and sampled; per-device param+KV bytes drop
+    ~axis-size× (see :meth:`memory_footprint`).  Composes with paged
+    KV, chunked prefill, and mesh-matched prefix pools; rejects
+    ``lane_tiers``/``prompt_cache``/rolling configs (the composition
+    table lives in docs/serving_guide.md "Pod-sharded serving").
     """
 
     def __init__(self, params, cfg: TransformerConfig, lanes: int = 8,
@@ -152,7 +167,8 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                  max_queue: int = 0, clock=None,
                  lane_tiers=None, scale_up_after: int = 2,
                  scale_down_after: int = 8, step_windows=(1,),
-                 prefill_chunk: int | None = None, prefix_pool=None):
+                 prefill_chunk: int | None = None, prefix_pool=None,
+                 plan=None, mesh=None):
         # Windowed configs: the engine runs ROLLING lanes — each lane
         # decodes past max_len on the ring-buffer cache (the unbounded
         # streaming-chat shape), which needs rope (positions beyond
@@ -160,6 +176,48 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
         # fits the ring.  Non-rope windowed configs have no rolling
         # semantics, so they stay rejected rather than silently
         # becoming bounded.
+        # Pod-sharded serving (round 14, ``plan=``/``mesh=``): one
+        # engine replica spans a whole device mesh.  Params are placed
+        # by the plan's regex partition rules (the same TP/FSDP
+        # spellings training uses), the KV cache's kv-heads dimension
+        # shards over whatever mesh axis the plan shards attention
+        # heads over (DERIVED, never authored — parallel/rules.py's
+        # serving_kv_axis), row metadata replicates, and every program
+        # compiles ONCE with sharding constraints so GSPMD inserts the
+        # per-token collectives — emitted tokens stay bit-exact vs the
+        # solo engine (tests/test_serving_sharded.py).
+        if (plan is None) != (mesh is None):
+            raise ValueError(
+                "pass plan= and mesh= together: the plan's rules only "
+                "mean something against a concrete mesh (use "
+                "parallel.sharding.serving_plan() for the standard TP "
+                "layout)")
+        if plan is not None:
+            if cfg.attention_window is not None:
+                raise ValueError(
+                    "pod-sharded serving needs a full-cache config "
+                    "(no attention_window): the ring slab's rolling "
+                    "scatter has no stable sharded layout to pin")
+            if lane_tiers is not None:
+                raise ValueError(
+                    "plan= does not compose with lane_tiers= yet: a "
+                    "tier resize would recompile every tier's sharded "
+                    "programs — raise lanes= instead (the sharded "
+                    "slab already decouples per-device bytes from "
+                    "lane count)")
+            if prompt_cache is not None:
+                raise ValueError(
+                    "plan= does not compose with prompt_cache= (one "
+                    "baked-in prefix); use prefix_pool= built with "
+                    "the same mesh, or a PagedBatcher pinned stem")
+        self.plan, self.mesh = plan, mesh
+        if plan is not None:
+            # Any ShardingPlan works (fsdp_plan/tp_plan/serving_plan):
+            # the KV axis derives from its attention rules, with the
+            # head-divisibility rejection naming the offending rule.
+            from distkeras_tpu.parallel.rules import serving_kv_axis
+
+            self._kv_axis = serving_kv_axis(plan, mesh, cfg)
         self._rolling = False
         if cfg.attention_window is not None:
             if not rolling_eligible(cfg):
@@ -263,7 +321,21 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
             raise ValueError(
                 f"eos_token {eos_token} outside vocab [0, "
                 f"{cfg.vocab_size})")
-        self.params = _device_tree(params)
+        if plan is not None:
+            # Sharded device placement per the plan's rules: the big
+            # matmul operands scatter over the mesh, small leaves
+            # (norm scales) replicate — per-device param bytes drop
+            # ~axis-size×, asserted from addressable shards by
+            # memory_footprint().  Already-placed trees re-place
+            # cheaply (device_put is a no-op per unchanged leaf).
+            self.params = jax.device_put(
+                params, plan.tree_shardings(mesh, params))
+            # Every program must exist before the first request: the
+            # serving_sharded compile sessions assert a zero-compile
+            # serve phase, same contract as elastic/paged engines.
+            self._always_warm = True
+        else:
+            self.params = _device_tree(params)
         self.cfg = cfg
         self.lanes = lanes
         # Shared prefix (system prompt): every lane's request decodes
@@ -292,6 +364,21 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                 raise ValueError(
                     "prefix_pool quantization must match kv_int8= "
                     "(build the pool with the engine's kv_int8)")
+            if getattr(prefix_pool, "mesh", None) != mesh:
+                raise ValueError(
+                    "prefix_pool placement must match the engine's: "
+                    "build the pool with PrefixPool(..., mesh=, "
+                    "kv_axis=) matching plan=/mesh= (a slab placed "
+                    "differently from the cache would make every "
+                    "pooled admission reshard the segment)")
+            if (mesh is not None
+                    and getattr(prefix_pool, "kv_axis", None)
+                    != self._kv_axis):
+                raise ValueError(
+                    f"prefix_pool kv_axis="
+                    f"{getattr(prefix_pool, 'kv_axis', None)!r} does "
+                    f"not match the plan-derived KV axis "
+                    f"{self._kv_axis!r}")
             want = jax.eval_shape(
                 lambda: init_cache(cfg, 1, kv_int8=kv_int8))
             got = jax.tree.map(
@@ -423,12 +510,27 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
     def _fresh_cache(self, lanes: int):
         """A zeroed KV store for ``lanes`` decode rows — the ONE
         cache-layout decision point (monolithic here; the paged
-        engine overrides with its block slab)."""
-        return init_cache(self.cfg, lanes, kv_int8=self.kv_int8)
+        engine overrides with its block slab).  Sharded engines place
+        it with the plan-derived kv-heads sharding (``_place_kv`` is a
+        no-op unsharded) — warm-up dummies come through here too, so
+        they always carry the live layout."""
+        return self._place_kv(
+            init_cache(self.cfg, lanes, kv_int8=self.kv_int8))
 
     def _init_device_state(self, lanes: int) -> None:
         self.cache = self._fresh_cache(lanes)
         self._init_lane_rows(lanes)
+
+    def _place_rows(self, cur, pos, keys, temps, tps, mps):
+        """Commit per-lane row state REPLICATED over the serving mesh
+        (identity unsharded).  Shared by the live init and the warm-up
+        dummies: for committed arrays the sharding is part of the jit
+        cache key, so the two must agree or the serve phase pays a
+        recompile."""
+        if self.mesh is None:
+            return cur, pos, keys, temps, tps, mps
+        return tuple(self._place_replicated(x)
+                     for x in (cur, pos, keys, temps, tps, mps))
 
     def _init_lane_rows(self, lanes: int) -> None:
         """Per-lane row state shared by every storage layout: next
@@ -463,6 +565,9 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
             self._keyed = False
         else:
             self._keyed = True
+        (self.cur, self.pos, self.keys, self.temps, self.tps,
+         self.mps) = self._place_rows(self.cur, self.pos, self.keys,
+                                      self.temps, self.tps, self.mps)
 
     def _build_one_step(self):
         """The per-token decode body over a CONTIGUOUS [lanes, S]
@@ -547,8 +652,17 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
 
     def _make_step(self, n: int):
         one_step = self._one_step
+        constrain = self._kv_constraint
 
         def step_n(cache, cur, pos, keys, temps, tps, mps):
+            if constrain is not None:
+                # Pod-sharded engines pin the cache layout here: GSPMD
+                # then inserts the per-token collectives (psum per
+                # block + the unembed gather) against the DECLARED
+                # kv-heads sharding — compiled once, zero steady-state
+                # compiles (the serving_sharded session asserts it).
+                cache = constrain(cache)
+
             def body(carry, _):
                 cache, cur, pos = carry
                 cache, cur, pos = one_step(cache, cur, pos, keys,
@@ -556,6 +670,8 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                 return (cache, cur, pos), cur
             (cache, cur, pos), toks = jax.lax.scan(
                 body, (cache, cur, pos), None, length=n)
+            if constrain is not None:
+                cache = constrain(cache)
             return cache, cur, pos, toks.T        # [lanes, n]
         return jax.jit(step_n, donate_argnums=0)
 
@@ -566,18 +682,23 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
         # the start offset and pool slot are traced, so every prefix
         # length and chunk offset shares it.
         pooled = self._prefix_pool is not None
+        constrain = self._kv_constraint
         self._admit = _make_lane_admit(self.params, self.cfg,
                                        prefix_lane=self._prefix_lane,
-                                       pooled=pooled)
+                                       pooled=pooled,
+                                       constrain=constrain)
         # Chunked prefill: the continuation program lands chunk k > 0
         # on the lane's existing cache (no reseed — that would erase
         # the earlier chunks).
         self._admit_cont = (_make_lane_admit(self.params, self.cfg,
-                                             seed=False)
+                                             seed=False,
+                                             constrain=constrain)
                             if self.prefill_chunk is not None else None)
-        self._reseed = (_make_lane_reseed(prefix_lane=self._prefix_lane)
+        self._reseed = (_make_lane_reseed(prefix_lane=self._prefix_lane,
+                                          constrain=constrain)
                         if self._prefix_lane is not None else None)
-        self._reseed_pool = (_make_lane_reseed(pooled=True)
+        self._reseed_pool = (_make_lane_reseed(pooled=True,
+                                               constrain=constrain)
                              if pooled else None)
 
     # ------------------------------------------------------------ API
@@ -938,6 +1059,10 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                 else "sampled" if self.temperature > 0 else "greedy")
         if self._prefix_pool is not None:
             mode += "_pooled"
+        if self._kv_axis is not None:
+            # Pod-sharded engine: the census pins this step's per-token
+            # collectives (scripts/comm_budget.json).
+            mode += f"_tp{int(self.mesh.shape[self._kv_axis])}"
         rows = jnp.zeros((1, self._buckets[0]), jnp.int32)
         admit_args = (self.cache, rows, jnp.int32(0),
                       jnp.int32(self._off))

@@ -264,6 +264,16 @@ class PagedBatcher(ContinuousBatcher):
       admission at a measured, test-pinned parity tolerance
       (full-precision in-chunk attention, quantized once at the end).
 
+    - ``plan=``/``mesh=`` (round 14): pod-sharded paging — the block
+      slab's kv-heads dimension shards over the plan-derived mesh
+      axis exactly like the monolithic cache (the slab layout ends
+      ``[..., kv_heads, head_dim]`` too), page tables and the
+      allocator stay host-side/replicated, so stem sharing, pinned
+      stems, and CoW forks work unchanged on a slab that spans the
+      mesh.  Same bit-parity/bytes/zero-compile contract as the
+      sharded ContinuousBatcher (docs/serving_guide.md "Pod-sharded
+      serving").
+
     Not supported (structurally): ``attention_window`` (ring slots
     have no stable block identity), ``prompt_cache=`` / ``prefix_pool=``
     (subsumed by pinned stems), ``lane_tiers`` (the slab already
@@ -284,7 +294,8 @@ class PagedBatcher(ContinuousBatcher):
                  prompt_buckets=(8, 32, 128, 512), kv_int8=False,
                  per_request_sampling: bool = False,
                  max_queue: int = 0, clock=None, step_windows=(1,),
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None, plan=None,
+                 mesh=None):
         if cfg.attention_window is not None:
             raise ValueError(
                 "paged KV needs a full-cache config (no "
@@ -340,7 +351,8 @@ class PagedBatcher(ContinuousBatcher):
                          per_request_sampling=per_request_sampling,
                          max_queue=max_queue, clock=clock,
                          step_windows=step_windows,
-                         prefill_chunk=prefill_chunk)
+                         prefill_chunk=prefill_chunk, plan=plan,
+                         mesh=mesh)
 
     # ------------------------------------------------ storage layout
 
@@ -351,27 +363,36 @@ class PagedBatcher(ContinuousBatcher):
         # scale leaves included.
         del lanes
         slab_cfg = dataclasses.replace(self.cfg, max_len=self.block)
-        return init_cache(slab_cfg, self.n_blocks,
-                          kv_int8=self.kv_int8)
+        # _place_kv: pod-sharded engines shard the slab's kv-heads
+        # dimension exactly like the monolithic cache (the block
+        # layout ends [..., kv_heads, head_dim] too) — the per-lane
+        # gather/scatter stays lane-and-position-local, so sharding
+        # composes with paging for free.
+        return self._place_kv(init_cache(slab_cfg, self.n_blocks,
+                                         kv_int8=self.kv_int8))
 
     def _init_device_state(self, lanes: int) -> None:
         super()._init_device_state(lanes)
         self._tables_np = np.zeros((lanes, self._mb), np.int32)
-        self.tables = jax.device_put(self._tables_np.copy())
+        self.tables = self._put_host(self._tables_np.copy())
 
     def _push_tables(self) -> None:
         # Authoritative copy is host-side numpy; the device array is
-        # re-materialized on change.  An explicit copy: device_put may
+        # re-materialized on change (replicated over the mesh on
+        # sharded engines).  An explicit copy: device_put may
         # alias host memory on CPU, and the host copy keeps mutating.
-        self.tables = jax.device_put(self._tables_np.copy())
+        self.tables = self._put_host(self._tables_np.copy())
 
     # ---------------------------------------------- compiled programs
 
     def _make_step(self, n: int):
         one_step = self._one_step
         B, s_len = self.block, self.cfg.max_len
+        constrain = self._kv_constraint
 
         def step_n(slab, tables, cur, pos, keys, temps, tps, mps):
+            if constrain is not None:
+                slab = constrain(slab)
             # Gather every lane's contiguous [max_len] view through its
             # page table, run the SHARED monolithic window body on it,
             # then scatter only the window's new K/V back to the slab.
@@ -400,13 +421,18 @@ class PagedBatcher(ContinuousBatcher):
                 return s.at[:, blk, off].set(vals.astype(s.dtype))
 
             slab = jax.tree.map(write_back, slab, view)
+            if constrain is not None:
+                slab = constrain(slab)
             return slab, cur2, pos2, toks.T
         return jax.jit(step_n, donate_argnums=0)
 
     def _build_admission_programs(self) -> None:
         params, cfg, B = self.params, self.cfg, self.block
+        constrain = self._kv_constraint
 
         def admit(slab, table_row, rows, start, limit):
+            if constrain is not None:
+                slab = constrain(slab)
             # One program per bucket width (start/limit traced): the
             # lane's view is gathered, the chunk runs the SAME
             # uniform-pos _decode_chunk as monolithic admission, and
@@ -428,7 +454,8 @@ class PagedBatcher(ContinuousBatcher):
                 seg = jax.lax.dynamic_slice_in_dim(vw, start, w,
                                                    axis=2)[:, 0]
                 return s.at[:, blk, off].set(seg.astype(s.dtype))
-            return jax.tree.map(write_back, slab, view)
+            out = jax.tree.map(write_back, slab, view)
+            return constrain(out) if constrain is not None else out
 
         self._admit = jax.jit(admit, donate_argnums=0)
         # The chunked-prefill continuation IS the same program (no
@@ -459,18 +486,21 @@ class PagedBatcher(ContinuousBatcher):
                 def write_back(s, c):
                     return s.at[:, blk, off].set(
                         c[:, 0, :w].astype(s.dtype))
-                return jax.tree.map(write_back, slab, cache)
+                out = jax.tree.map(write_back, slab, cache)
+                return (constrain(out) if constrain is not None
+                        else out)
             self._admit_prefill = jax.jit(admit_prefill,
                                           donate_argnums=0)
 
         def copy_block(slab, src, dst):
             # The CoW fork's divergent-tail copy: O(block) bytes, the
             # whole point vs copying a max_len lane cache.
-            return jax.tree.map(
+            out = jax.tree.map(
                 lambda a: jax.lax.dynamic_update_slice_in_dim(
                     a, jax.lax.dynamic_slice_in_dim(a, src, 1, axis=1),
                     dst, axis=1),
                 slab)
+            return constrain(out) if constrain is not None else out
         self._copy_block = jax.jit(copy_block, donate_argnums=0)
 
         def fork_rows(cur, pos, keys, temps, tps, mps, src, dst,
@@ -493,7 +523,7 @@ class PagedBatcher(ContinuousBatcher):
         for n in self._step_windows:
             if n not in self._steps:
                 self._steps[n] = self._make_step(n)
-        tabs = jax.device_put(np.zeros((tier, self._mb), np.int32))
+        tabs = self._put_host(np.zeros((tier, self._mb), np.int32))
         for n in self._step_windows:
             cache, cur, pos, keys, temps, tps, mps = \
                 self._tier_state(tier)
@@ -501,7 +531,7 @@ class PagedBatcher(ContinuousBatcher):
                            mps)
 
     def _warm_admission(self, tier: int) -> None:
-        row = jax.device_put(np.zeros((self._mb,), np.int32))
+        row = self._put_host(np.zeros((self._mb,), np.int32))
         for width in self._buckets:
             rows = jnp.zeros((1, width), jnp.int32)
             self._admit(self._fresh_cache(tier), row, rows,
@@ -620,7 +650,7 @@ class PagedBatcher(ContinuousBatcher):
 
     def _exec_chunk(self, lane, start, rows) -> None:
         limit = self._lane_limit[lane]
-        row = jax.device_put(self._tables_np[lane].copy())
+        row = self._put_host(self._tables_np[lane].copy())
         w = rows.shape[1]
         if (self._admit_prefill is not None and start == 0
                 and w >= limit):
@@ -852,7 +882,7 @@ class PagedBatcher(ContinuousBatcher):
                 if shared < full:
                     row = np.full((self._mb,), TRASH_BLOCK, np.int32)
                     row[:len(blocks)] = blocks
-                    row_j = jax.device_put(row)
+                    row_j = self._put_host(row)
                     # _chunk_rows reads warm = prompt.size - 1 tokens;
                     # the pseudo prompt makes the pinned span exactly
                     # the warm region.
@@ -944,8 +974,10 @@ class PagedBatcher(ContinuousBatcher):
             self._steps[1] = self._make_step(1)
         mode = ("per_request" if self.per_request_sampling
                 else "sampled" if self.temperature > 0 else "greedy")
+        if self._kv_axis is not None:
+            mode += f"_tp{int(self.mesh.shape[self._kv_axis])}"
         rows = jnp.zeros((1, self._buckets[0]), jnp.int32)
-        row = jax.device_put(np.zeros((self._mb,), np.int32))
+        row = self._put_host(np.zeros((self._mb,), np.int32))
         return [
             TraceSpec(
                 name=f"pagedbatcher_{mode}/decode_step",

@@ -71,13 +71,25 @@ class PrefixPool:
     Thread-safe: one lock serializes ``put``/``acquire``/``release``
     (engines call acquire/release under their own admission locks, but
     a pool may be shared across engines).
+
+    ``mesh``/``kv_axis`` (round 14): build the pool for a pod-sharded
+    engine — the slab commits with the engine's KV sharding (kv-heads
+    over ``kv_axis``) so the pooled admission gather stays a sharded
+    device gather with zero resharding; the engine validates the
+    match at construction.
     """
 
     def __init__(self, cfg: TransformerConfig, slots: int = 4,
                  kv_int8: bool = False,
-                 draft_cfg: TransformerConfig | None = None):
+                 draft_cfg: TransformerConfig | None = None,
+                 mesh=None, kv_axis: str | None = "model"):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if mesh is not None and draft_cfg is not None:
+            raise ValueError(
+                "sharded pools serve pod-sharded ContinuousBatchers; "
+                "SpeculativeBatcher has no plan= mode, so a sharded "
+                "speculative pool has no consumer")
         if cfg.attention_window is not None or (
                 draft_cfg is not None
                 and draft_cfg.attention_window is not None):
@@ -101,11 +113,37 @@ class PrefixPool:
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), seg)
         self.slab = jax.tree.map(
             lambda a: jnp.zeros((slots,) + a.shape, a.dtype), seg)
+        # Pod-sharded placement (round 14): a pool serving a
+        # ``plan=``/``mesh=`` engine commits its slab with the SAME
+        # kv-heads sharding the engine's cache uses (the shared
+        # kv_slab_specs rule — the slab layout just carries a leading
+        # [slots] axis), so the pooled admission gather is a sharded
+        # device gather with zero resharding.  The engine validates
+        # the match at construction.
+        self.mesh = mesh
+        self.kv_axis = kv_axis if mesh is not None else None
+        constrain = None
+        if mesh is not None:
+            from distkeras_tpu.parallel.rules import kv_slab_shardings
+
+            if self.kv_axis is not None \
+                    and cfg.kv_heads % int(mesh.shape[self.kv_axis]):
+                raise ValueError(
+                    f"kv_heads={cfg.kv_heads} is not divisible by "
+                    f"mesh axis {self.kv_axis!r} "
+                    f"(size {int(mesh.shape[self.kv_axis])})")
+            slab_sh = kv_slab_shardings(mesh, self.slab, self.kv_axis)
+            self.slab = jax.device_put(self.slab, slab_sh)
+
+            def constrain(slab):
+                return jax.lax.with_sharding_constraint(
+                    slab, kv_slab_shardings(mesh, slab, self.kv_axis))
 
         def put(slab, seg, slot):
-            return jax.tree.map(
+            out = jax.tree.map(
                 lambda s, g: jax.lax.dynamic_update_slice_in_dim(
                     s, g.astype(s.dtype)[None], slot, axis=0), slab, seg)
+            return constrain(out) if constrain is not None else out
 
         # Slot is traced: ONE compiled write program for the pool's
         # lifetime, warmed here so put() never compiles at serve time.

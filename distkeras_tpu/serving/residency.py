@@ -17,6 +17,13 @@ digest set is a complete description of which prompt stems a replica
 can serve without re-prefilling — the "residency digest" the
 ``/residency`` telemetry endpoint publishes and the router's affinity
 table consumes.
+
+Deliberately PLACEMENT-independent (round 14): digests hash token
+content on the host, never device layout, so a pod-sharded engine
+(``plan=``/``mesh=``) publishes exactly the digests its solo twin
+would — the router routes to a whole mesh through one replica handle
+without knowing the mesh exists (tests/test_serving_sharded.py pins
+the sharded-vs-solo digest equality).
 """
 
 from __future__ import annotations

@@ -94,7 +94,11 @@ class InProcessReplica:
     ``engine`` is any serving engine exposing the admission surface
     (``enqueue``/``poll``/``step``/``residency``/``queued``/
     ``running``/``closed``) — the router never imports the engine
-    classes, so this module stays jax-free.  ``health`` overrides the
+    classes, so this module stays jax-free.  A pod-SHARDED engine
+    (``plan=``/``mesh=``, round 14) is ONE replica handle like any
+    other: its residency digests are host-side content hashes, so the
+    affinity table never sees the mesh — a replica behind the router
+    can be a whole pod.  ``health`` overrides the
     default liveness check (engine not closed) — e.g. a heartbeat-
     freshness callable for replicas whose process publishes beats.
 
@@ -479,15 +483,39 @@ class Router:
                 self.degrade_cooldown if cooldown is None else cooldown)
         obs.event("router.replica_degraded", replica=name)
 
-    def breach_demoter(self, name: str):
-        """A subscriber for ``obs.SloRule`` breach callbacks: any
-        breach demotes ``name`` for the degrade cooldown.  Wire one
-        per replica whose SLO stream is replica-scoped (cross-host:
-        each replica process runs its own rules and the operator maps
-        breaches to names)."""
-        def on_breach(event):
-            del event
-            self.mark_degraded(name)
+    def slo_rules(self, *templates) -> list:
+        """Stamp ``SloRule`` templates per attached replica (round
+        14): one ``replica=``-labeled copy of each template for every
+        replica currently attached, in name order.  Pass the result to
+        ``obs.session(slo_rules=...)`` and subscribe
+        :meth:`breach_demoter` ONCE — a breach then demotes the
+        replica its rule is scoped to, no hand-built closure per
+        replica.  (Rules are snapshots: re-derive after membership
+        changes if new replicas need coverage.)"""
+        import dataclasses as _dc
+
+        with self._lock:
+            names = sorted(self._members)
+        return [_dc.replace(t, replica=n)
+                for n in names for t in templates]
+
+    def breach_demoter(self, name: str | None = None):
+        """A subscriber for ``obs.SloRule`` breach callbacks
+        (``fn(rule, value)``).
+
+        With ``name``: any breach demotes that fixed replica — the
+        shape for cross-host fleets where each replica process runs
+        its own rules and the operator maps streams to names.  With
+        no argument (round 14): the subscriber reads the RULE's own
+        ``replica=`` label (see :meth:`slo_rules`) and demotes that
+        replica — attach it once for the whole fleet; breaches from
+        unlabeled rules are ignored."""
+        def on_breach(rule, value):
+            del value
+            target = name if name is not None \
+                else getattr(rule, "replica", None)
+            if target is not None:
+                self.mark_degraded(target)
         return on_breach
 
     # ------------------------------------------------------- admission

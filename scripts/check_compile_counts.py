@@ -550,6 +550,97 @@ def session_serving_router():
         "trigger device work")
 
 
+def session_serving_sharded():
+    """Pod-sharded ContinuousBatcher (round 14): ONE engine replica
+    spans the 8-CPU mesh (data=4, model=2) under ``serving_plan()`` —
+    params TP-sharded, the KV cache's kv-heads dim sharded over
+    ``model``, GSPMD's per-token collectives compiled in.  EVERY
+    program compiles at construction (the recorded budget); the serve
+    phase — two admissions in different buckets, interleaved decode,
+    drain, and a same-bucket re-admission — is ASSERTED compile-free:
+    a compile here means some sharded program shape (or a committed-
+    array placement mismatch between warm-up and live state) was
+    missed and a request paid it."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+    from distkeras_tpu.parallel.sharding import serving_plan
+    from distkeras_tpu.serving import ContinuousBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    eng = ContinuousBatcher(params, cfg, lanes=2,
+                            prompt_buckets=(8, 16),
+                            plan=serving_plan(), mesh=mesh)
+    built = _COMPILES["n"]
+    rng = np.random.default_rng(0)
+    lanes = [eng.submit(rng.integers(0, 64, (5,)).astype(np.int32), 6),
+             eng.submit(rng.integers(0, 64, (12,)).astype(np.int32), 6)]
+    for lane in lanes:
+        while lane in eng.running():
+            eng.step()
+        eng.drain(lane)
+    again = eng.submit(rng.integers(0, 64, (7,)).astype(np.int32), 4)
+    while again in eng.running():
+        eng.step()
+    eng.drain(again)
+    serve = _COMPILES["n"] - built
+    assert serve == 0, (
+        f"sharded serve phase compiled {serve} program(s); every "
+        "sharded program must compile at construction and live state "
+        "placement must match the warm-up's")
+
+
+def session_serving_sharded_paged():
+    """Pod-sharded PagedBatcher: the block slab's kv-heads dim shards
+    over ``model`` exactly like the monolithic cache; stem-sharing
+    admission, decode growth, drain, and re-admission on the sharded
+    slab are ASSERTED compile-free after construction (the page-table
+    pushes are transfers, never compiles — replicated placement is
+    pinned by the warm-up)."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+    from distkeras_tpu.parallel.sharding import serving_plan
+    from distkeras_tpu.serving import PagedBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    eng = PagedBatcher(params, cfg, lanes=2, block=8, n_blocks=9,
+                       prompt_buckets=(8,), plan=serving_plan(),
+                       mesh=mesh)
+    built = _COMPILES["n"]
+    rng = np.random.default_rng(0)
+    stem = rng.integers(0, 64, (8,)).astype(np.int32)
+    tails = rng.integers(0, 64, (2, 4)).astype(np.int32)
+    lanes = [eng.submit(np.concatenate([stem, tails[0]]), 6),
+             eng.submit(np.concatenate([stem, tails[1]]), 6)]
+    assert eng.allocator.stats()["shared"] >= 1  # sharing still works
+    for lane in lanes:
+        while lane in eng.running():
+            eng.step()
+        eng.drain(lane)
+    again = eng.submit(rng.integers(0, 64, (5,)).astype(np.int32), 4)
+    while again in eng.running():
+        eng.step()
+    eng.drain(again)
+    serve = _COMPILES["n"] - built
+    assert serve == 0, (
+        f"sharded paged serve phase compiled {serve} program(s); "
+        "paging must compose with the sharded slab at zero "
+        "steady-state compiles")
+
+
 # NOTE: new sessions append at the END — inserting one mid-dict would
 # shift every later session's warm-cache delta budget (module
 # docstring).
@@ -591,6 +682,13 @@ SESSIONS = {
     # route-and-serve phase over 2 in-process replicas is ASSERTED
     # zero-compile inside the session (the router is jax-free).
     "serving_router": session_serving_router,
+    # Pod-sharded serving (round 14): construction compiles every
+    # sharded program (params TP-placed, KV heads sharded over
+    # ``model``, GSPMD collectives in the step); both serve phases are
+    # ASSERTED compile-free inside the session — the acceptance bar
+    # for "one router replica is a whole mesh".
+    "serving_sharded": session_serving_sharded,
+    "serving_sharded_paged": session_serving_sharded_paged,
 }
 
 

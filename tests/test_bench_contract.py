@@ -232,6 +232,79 @@ def test_bench_router_affinity_row(monkeypatch):
     assert _tiny_serving_cfg().max_len % extras["block"] == 0
 
 
+def test_bench_serving_probe_failure_skips_all_rows(monkeypatch,
+                                                    capsys):
+    """Round-14 small fix: bench_serving.py under a dead accelerator
+    tunnel emits one ``status: skipped`` line per requested row (null
+    value, last_green when a prior record exists) and exits 0 — the
+    same poisoned-run hazard PR 2 fixed for the training bench."""
+    import bench_serving as bs
+    import bench_suite
+
+    monkeypatch.setattr(bs, "_probe_with_retries",
+                        lambda *a, **k: "tunnel down (test)")
+    monkeypatch.setattr(
+        bench_suite, "read_last_green",
+        lambda name=None, **k: ({"metric": name, "value": 7.0}
+                                if name == "engine_throughput"
+                                else None))
+    with pytest.raises(SystemExit) as e:
+        bs.main(["engine_throughput", "engine_sharded_tp2"])
+    assert e.value.code == 0
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    assert [x["metric"] for x in lines] == ["engine_throughput",
+                                           "engine_sharded_tp2"]
+    for x in lines:
+        assert x["status"] == "skipped"
+        assert x["value"] is None and x["ms_per_token"] is None
+        assert x["error"] == "tunnel down (test)"
+    assert lines[0]["last_green"]["value"] == 7.0
+    assert "NOT this run" in lines[0]["last_green"]["note"]
+    assert "last_green" not in lines[1]
+
+
+def test_bench_engine_sharded_row(monkeypatch):
+    """Round-14 pod-sharded row: engine_sharded_tpN serves a real
+    tiny-cfg workload on the 8-CPU mesh and reports per-device
+    param+KV bytes (sharded AND solo — the ~tp× reduction must be
+    visible in the row, not asserted in prose) plus TTFT/TPOT for
+    both engines."""
+    import bench_serving as bs
+
+    monkeypatch.setattr(bs, "_cfg", lambda window=None:
+                        _tiny_serving_cfg())
+    rate, step_s, _, extras = bs.bench_engine_sharded(2)(
+        n_req=4, p_len=6, new=5, lanes=2)
+    assert rate > 0 and abs(rate * step_s - 1.0) < 1e-9
+    assert extras["tp"] == 2
+    # KV shards exactly 2x; params nearly (norm scales replicate).
+    assert extras["solo_kv_mb_per_device"] == pytest.approx(
+        extras["kv_mb_per_device"] * 2, rel=0.01)
+    assert extras["bytes_reduction"] > 1.5
+    for key in ("param_mb_per_device", "solo_param_mb_per_device",
+                "ttft_p50_ms", "tpot_p50_ms", "solo_ttft_p50_ms",
+                "solo_tpot_p50_ms", "solo_tok_s"):
+        assert key in extras
+
+
+def test_bench_engine_sharded_tp4_runs_when_heads_allow(monkeypatch):
+    """tp4 needs n_heads % 4 == 0: a 4-head tiny cfg runs the real
+    row on the 8-CPU mesh (data=2, model=4)."""
+    import bench_serving as bs
+    from distkeras_tpu.models import transformer as tfm
+
+    cfg4 = tfm.TransformerConfig(
+        vocab_size=64, d_model=16, n_heads=4, n_layers=1, d_ff=32,
+        max_len=48, dtype="float32", rope=True)
+    monkeypatch.setattr(bs, "_cfg", lambda window=None: cfg4)
+    rate, _, _, extras = bs.bench_engine_sharded(4)(
+        n_req=2, p_len=6, new=4, lanes=2)
+    assert rate > 0 and extras["tp"] == 4
+    assert extras["solo_kv_mb_per_device"] == pytest.approx(
+        extras["kv_mb_per_device"] * 4, rel=0.01)
+
+
 def test_bench_paged_rows(monkeypatch):
     """Round-12 paged-KV rows: the lanes-at-fixed-HBM row reports a
     >= 2x lane multiple at identical slab block counts, the shared-

@@ -382,6 +382,50 @@ def test_shutdown_cancels_stragglers(rng):
     assert res[rid].status == "cancelled"
 
 
+def test_replica_scoped_slo_breach_demotes_automatically(rng):
+    """Round-14 satellite: ``Router.slo_rules`` stamps one
+    ``replica=``-labeled SloRule per attached replica, the breach
+    event carries the label, and a single ``breach_demoter()``
+    subscriber (no per-replica closure) demotes exactly the replica
+    the breaching rule is scoped to."""
+    from distkeras_tpu.obs.metrics import MetricsRegistry
+    from distkeras_tpu.obs.slo import SloEngine, SloRule
+
+    t = [0.0]
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    router = Router([r0, r1], clock=lambda: t[0])
+    rules = router.slo_rules(
+        SloRule("serving.request_s", percentile=0.5, threshold=1.0,
+                window_s=10.0))
+    assert [r.replica for r in rules] == ["r0", "r1"]
+
+    events = []
+    reg = MetricsRegistry()
+    eng = SloEngine(reg, rules, clock=lambda: t[0],
+                    emit=lambda name, **f: events.append((name, f)))
+    eng.subscribe(router.breach_demoter())
+    h = reg.histogram("serving.request_s")
+    for _ in range(5):
+        h.observe(5.0)
+    eng.tick()
+    breaches = [f for n, f in events if n == "slo.breach"]
+    # Both replicas' rules watch the same aggregated metric here, so
+    # both breach — each event labeled with ITS replica.
+    assert {b["replica"] for b in breaches} == {"r0", "r1"}
+    assert all(m.degraded_until > t[0]
+               for m in router._members.values())
+    assert reg.counter("slo.breaches").value(
+        metric="serving.request_s", q="p50", replica="r0") == 1
+
+    # Degraded replicas sort behind a healthy newcomer until the
+    # cooldown passes — the routing effect the label exists for.
+    r2 = FakeReplica("r2")
+    router.add_replica(r2)
+    router.enqueue(_prompt(rng), 4)
+    assert len(r2.enqueued) == 1 and not r0.enqueued \
+        and not r1.enqueued
+
+
 def test_expired_on_arrival_never_routes(rng):
     t = [10.0]
     r0 = FakeReplica("r0")
