@@ -1,6 +1,6 @@
 """Static analysis: machine-check the invariants the docs only claim.
 
-Two layers over one findings model (``findings.py``):
+The lint layers share one findings model (``findings.py``):
 
 * :mod:`~distkeras_tpu.analysis.ir_lint` — trace the trainers' and
   serving engines' REAL compiled step functions (each subsystem exposes
@@ -12,18 +12,33 @@ Two layers over one findings model (``findings.py``):
   the package source with JAX-specific rules (wall-clock/np.random in
   traced functions, host syncs in hot loops, import-time jnp compute,
   axis-name typos, undonated step jits, ...).
+* :mod:`~distkeras_tpu.analysis.thread_lint` — the concurrency gate's
+  static half (raw locks, callbacks/blocking under a lock, double
+  acquires) over the threaded core.
+* :mod:`~distkeras_tpu.analysis.shard_lint` — the partition-plan gate:
+  pure-host plan lint (dead/shadowed/duplicate rules, axis
+  divisibility, replicated giants) over every shipped rule plan, plus
+  the compiled-placement census (per-tensor shardings + per-device
+  byte ledger vs ``scripts/shard_budget.json``) and resharding
+  attribution over the same trace targets.
 
-Both honor the ``# dkt: ignore[rule]`` suppression syntax and are wired
+All honor the ``# dkt: ignore[rule]`` suppression syntax and are wired
 into CI through ``scripts/graph_lint.py`` and the tier-1 tests
-(``tests/test_graph_lint.py`` / ``tests/test_budget_guards.py``); see
-docs/graph_lint.md for the rule catalogue and the budget-update
-workflow.
+(``tests/test_graph_lint.py`` / ``tests/test_shard_lint.py`` /
+``tests/test_budget_guards.py``); see docs/graph_lint.md for the rule
+catalogue and the budget-update workflow.
 """
 
 from distkeras_tpu.analysis.findings import Finding, format_findings
 from distkeras_tpu.analysis.ir_lint import (CollectiveOp, TraceSpec,
-                                             comm_census, lint_trace)
+                                             comm_census, lint_trace,
+                                             trace_target)
+from distkeras_tpu.analysis.shard_lint import (lint_plan,
+                                               lint_repo_plans,
+                                               placement_census)
 from distkeras_tpu.analysis.source_lint import lint_paths, lint_source
 
 __all__ = ["Finding", "format_findings", "TraceSpec", "CollectiveOp",
-           "comm_census", "lint_trace", "lint_source", "lint_paths"]
+           "comm_census", "lint_trace", "trace_target", "lint_plan",
+           "lint_repo_plans", "placement_census", "lint_source",
+           "lint_paths"]

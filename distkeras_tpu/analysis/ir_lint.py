@@ -561,14 +561,24 @@ def _audit_donation(closed, spec: TraceSpec, lower_warnings) -> list[Finding]:
 # ------------------------------------------------------------ entrypoint
 
 
-def lint_trace(spec: TraceSpec, compile_census: bool = True
-               ) -> tuple[list[Finding], list[CollectiveOp]]:
-    """Run every IR audit on one trace target.
+@dataclasses.dataclass(frozen=True)
+class TraceArtifacts:
+    """One target's trace/lower/compile products, produced ONCE so the
+    IR lint and the shard lint (analysis/shard_lint.py — placement
+    census, resharding attribution) never pay a second backend compile
+    for the same program.  ``compiled``/``hlo`` are None when only the
+    jaxpr-level audits were requested."""
 
-    Returns (findings, collective census).  ``compile_census=False``
-    skips the lower+compile (jaxpr-only audits — cheap when the census
-    is not needed).
-    """
+    closed: Any                 # the ClosedJaxpr (spec.fn traced)
+    compiled: Any | None        # jax.stages.Compiled
+    hlo: str | None             # post-SPMD HLO text of `compiled`
+    lower_warnings: tuple = ()  # warnings captured during trace+lower
+
+
+def trace_target(spec: TraceSpec, compile: bool = True) -> TraceArtifacts:
+    """Trace (jaxpr), lower, and — unless ``compile=False`` — compile
+    one target, capturing the lower-time diagnostics the donation audit
+    reads.  Nothing executes."""
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         traced = spec.fn.trace(*spec.args)
@@ -577,11 +587,28 @@ def lint_trace(spec: TraceSpec, compile_census: bool = True
         # and it emits the donation diagnostics; only the census needs
         # the (expensive) backend compile.
         lowered = traced.lower()
-        census: list[CollectiveOp] = []
-        if compile_census:
-            census = comm_census(lowered.compile().as_text())
-    findings = _audit_jaxpr(closed, spec)
-    findings += _audit_donation(closed, spec, caught)
+        compiled = lowered.compile() if compile else None
+    return TraceArtifacts(closed=closed, compiled=compiled,
+                          hlo=compiled.as_text() if compiled else None,
+                          lower_warnings=tuple(caught))
+
+
+def lint_trace(spec: TraceSpec, compile_census: bool = True,
+               artifacts: TraceArtifacts | None = None
+               ) -> tuple[list[Finding], list[CollectiveOp]]:
+    """Run every IR audit on one trace target.
+
+    Returns (findings, collective census).  ``compile_census=False``
+    skips the lower+compile (jaxpr-only audits — cheap when the census
+    is not needed).  Pass ``artifacts`` (from :func:`trace_target`) to
+    reuse an existing trace+compile.
+    """
+    art = artifacts if artifacts is not None else trace_target(
+        spec, compile=compile_census)
+    census: list[CollectiveOp] = (
+        comm_census(art.hlo) if art.hlo is not None else [])
+    findings = _audit_jaxpr(art.closed, spec)
+    findings += _audit_donation(art.closed, spec, art.lower_warnings)
     return findings, census
 
 
@@ -787,7 +814,8 @@ def save_budgets(path: str, budgets: dict, device_count: int | None = None
         f.write("\n")
 
 
-__all__ = ["TraceSpec", "CollectiveOp", "comm_census", "lint_trace",
+__all__ = ["TraceSpec", "CollectiveOp", "TraceArtifacts",
+           "trace_target", "comm_census", "lint_trace",
            "census_wire_total", "census_to_budget", "check_budget",
            "declared_zero_exchange", "declared_zero1_exchange",
            "check_zero1_parity", "load_budgets", "save_budgets"]

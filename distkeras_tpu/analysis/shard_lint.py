@@ -1,0 +1,648 @@
+"""Shard lint: static partition-plan analysis + compiled-placement census.
+
+The third tier-1 static gate, beside the graph lint (round 3) and the
+thread lint (round 9).  Since the rules engine (``parallel/rules.py``)
+became the source of every sharding, exchange, codec and serving-KV
+plan, a dead or shadowed rule, a silently-replicated large tensor, or a
+GSPMD-inserted resharding collective only surfaced as a perf regression
+on hardware we don't have.  This module makes those defects findings,
+in two halves sharing the round-3 findings/suppression/baseline model:
+
+**Plan lint** (:func:`lint_plan`) — pure-host analysis of an ordered
+rule list against a target pytree, no mesh and no jax trace required:
+
+* ``invalid-regex`` (error) — a pattern that does not compile;
+* ``duplicate-pattern`` (error) — an identical pattern repeated after
+  an earlier occurrence with a *concrete* value (first-match-wins makes
+  it unreachable; repeats after a *callable* occurrence are the legal
+  decline-chain idiom ``zero_state_rules`` uses) — the same spelling
+  ``rules.compile_rules`` now rejects at plan build;
+* ``dead-rule`` (error) — a pattern matching no leaf path in the tree
+  (the typo'd rule that silently replicates its target);
+* ``shadowed-rule`` (warn) — a rule whose every pattern match is first
+  claimed by earlier rules, so it can never fire;
+* ``axis-divisibility`` (error) — a leaf dimension not divisible by the
+  product of the mesh-axis sizes its winning PartitionSpec entry names
+  (the round-14 ``serving_kv_axis`` construction check generalized to
+  every rule and run WITHOUT a mesh, from declared ``axis_sizes``);
+* ``replicated-giant`` (warn) — a leaf no rule claims, above a byte
+  threshold: under ShardingPlan semantics it silently replicates on
+  every device.
+
+**Placement census** (:func:`placement_census`) — walk one traced lint
+target (``analysis/targets.py``, the same plumbing as ``ir_lint``) and
+record every input tensor's *compiled* sharding (explicit arguments via
+the executable's input shardings; closed-over parameters — the serving
+engines capture their weights — via the jaxpr consts' live shardings)
+plus a per-device byte ledger derived from the shard shapes.  The table
+is pinned exactly in ``scripts/shard_budget.json`` (``shard-budget``,
+error, mirroring ``comm-budget``), so "the plan and the compiled
+program agree" is a diffable CI artifact.  Alongside, the
+``resharding-collective`` rule (warn — ratchetable through
+``scripts/lint_baseline.json``, the explicitly-justified ledger) flags
+compiled all-gathers / collective-permutes / all-to-alls *not
+attributable to a declared exchange*: attributable means the op's HLO
+metadata name stack carries a declared scope (the zero stages'
+scatter/gather scopes, ``exchange/``, an explicit
+``sharding_constraint``) or ends in an explicit collective primitive
+the author spelled out (``all_gather``/``psum``/``all_to_all``/...,
+underscore-spelled — GSPMD-*inserted* reshardings instead carry the
+consumer op they were materialized for: ``dot_general``, ``mul``,
+``pad``, ...).  A dropped ``with_sharding_constraint`` turns a declared
+gather into exactly such an unattributed one, which is how the gate
+catches it.
+
+Wired into ``scripts/graph_lint.py`` (default run and ``--shardings``)
+and tier-1 (``tests/test_shard_lint.py``,
+``tests/test_budget_guards.py``); rule catalogue in docs/graph_lint.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Sequence
+
+from distkeras_tpu.analysis.findings import Finding
+
+DEFAULT_GIANT_BYTES = 1 << 20
+
+# ------------------------------------------------------------ plan lint
+
+_DTYPE_SHORT = {
+    "float32": "f32", "float64": "f64", "float16": "f16",
+    "bfloat16": "bf16", "int8": "s8", "int16": "s16", "int32": "s32",
+    "int64": "s64", "uint8": "u8", "uint16": "u16", "uint32": "u32",
+    "uint64": "u64", "bool": "pred",
+}
+
+
+def _concrete(val) -> bool:
+    # ONE concreteness predicate with the engine's build-time
+    # duplicate rejection (rules._is_concrete) — the two must never
+    # diverge or compile_rules and the duplicate-pattern lint would
+    # disagree about which repeats are the legal decline-chain idiom.
+    from distkeras_tpu.parallel.rules import _is_concrete
+
+    return _is_concrete(val)
+
+
+def _spec_of(val):
+    """The PartitionSpec a rule value places, if it places one (plain
+    specs and NamedShardings); None for codec strings etc."""
+    from jax.sharding import PartitionSpec as P
+
+    if isinstance(val, P):
+        return val
+    spec = getattr(val, "spec", None)
+    if isinstance(spec, P):
+        return spec
+    return None
+
+
+def _spec_str(spec) -> str:
+    """THE spelling of a PartitionSpec in findings AND census rows —
+    one definition so plan-lint messages and shard_budget.json can
+    never drift apart."""
+    return "P(" + ", ".join(repr(e) for e in tuple(spec)) + ")"
+
+
+def _value_str(val) -> str:
+    spec = _spec_of(val)
+    if spec is not None:
+        return _spec_str(spec)
+    if not _concrete(val):
+        return "<callable>"
+    return repr(val)
+
+
+def _leaf_bytes_of(shape, dtype) -> int:
+    import numpy as np
+
+    try:
+        itemsize = dtype.itemsize
+    except Exception:  # noqa: BLE001 — exotic dtype: assume 4
+        itemsize = 4
+    return int(np.prod(shape)) * itemsize if shape else itemsize
+
+
+def _leaf_bytes(leaf) -> int:
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        return 0
+    return _leaf_bytes_of(shape, leaf.dtype)
+
+
+def _iter_rules(rules) -> list:
+    """Normalize a rule source — a ShardingPlan (compiled rules), a
+    plain ``[(pattern, value)]`` list, or a pre-compiled list — into
+    ``[(pattern_str, value)]``."""
+    items = getattr(rules, "rules", rules)
+    out = []
+    for pat, val in items:
+        out.append((pat if isinstance(pat, str) else pat.pattern, val))
+    return out
+
+
+def lint_plan(rules, tree, *, name: str,
+              axis_sizes: dict | None = None,
+              giant_bytes: int = DEFAULT_GIANT_BYTES,
+              ) -> list[Finding]:
+    """Statically analyze one rule list against the pytree it places.
+
+    ``rules`` — a ShardingPlan, or ordered ``(pattern, value)`` pairs
+    (values may be PartitionSpecs, NamedShardings, codec names, or
+    callable rules, exactly the engine's rule language).  ``tree`` —
+    the target pytree (live arrays or ``ShapeDtypeStruct``s; only
+    ``.shape``/``.dtype`` are read, nothing executes).  ``name`` labels
+    the findings (the ``path`` field, like IR findings use the trace
+    target name).  ``axis_sizes`` — declared mesh-axis sizes (e.g.
+    ``{"data": 4, "model": 2}``) for the divisibility check; axes not
+    listed (and ``None``) skip it, so the lint runs mesh-free.
+
+    Callable rules are *evaluated* per leaf (they are pure shape/path
+    policies); one that raises is conservatively treated as claiming
+    the leaf, so no downstream rule is mis-reported.
+    """
+    import jax
+
+    from distkeras_tpu.parallel.rules import _axes_of, leaf_name
+
+    findings: list[Finding] = []
+
+    def add(rule, sev, msg, hint=""):
+        findings.append(Finding(rule=rule, severity=sev, path=name,
+                                line=None, message=msg, hint=hint))
+
+    norm: list[tuple] = []          # (pattern_str, compiled|None, value)
+    claimed: dict[str, bool] = {}
+    duplicates: set[int] = set()
+    for i, (pat_s, val) in enumerate(_iter_rules(rules)):
+        if claimed.get(pat_s):
+            duplicates.add(i)
+            add("duplicate-pattern", "error",
+                f"rule {i} ({pat_s!r}, {_value_str(val)}) repeats a "
+                "pattern an earlier rule with a concrete value already "
+                "spells — first-match-wins makes it unreachable",
+                "remove one of the duplicates (compile_rules rejects "
+                "this shape at plan build)")
+        claimed[pat_s] = claimed.get(pat_s, False) or _concrete(val)
+        try:
+            comp = re.compile(pat_s)
+        except re.error as e:
+            add("invalid-regex", "error",
+                f"rule {i} pattern {pat_s!r} does not compile: {e}",
+                "fix the regex — compile_rules raises the same error "
+                "at plan construction")
+            comp = None
+        norm.append((pat_s, comp, val))
+
+    leaves = [(leaf_name(p), leaf) for p, leaf
+              in jax.tree_util.tree_flatten_with_path(tree)[0]]
+    matched: list[list] = [[] for _ in norm]     # pattern-level matches
+    consulted: list[set] = [set() for _ in norm]  # reached first-match
+    winners: list[tuple] = []   # (leaf name, leaf, rule idx, spec|None)
+    unmatched: list[tuple] = []
+    for lname, leaf in leaves:
+        won = False
+        for i, (pat_s, comp, val) in enumerate(norm):
+            if comp is None or comp.search(lname) is None:
+                continue
+            matched[i].append(lname)
+            if won:
+                continue
+            consulted[i].add(lname)
+            if _concrete(val):
+                winners.append((lname, leaf, i, _spec_of(val)))
+                won = True
+            else:
+                try:
+                    out = val(lname, leaf)
+                except Exception:  # noqa: BLE001 — see docstring
+                    winners.append((lname, leaf, i, None))
+                    won = True
+                else:
+                    if out is not None:
+                        winners.append((lname, leaf, i, _spec_of(out)))
+                        won = True
+        if not won:
+            unmatched.append((lname, leaf))
+
+    for i, (pat_s, comp, val) in enumerate(norm):
+        if comp is None or i in duplicates:
+            # A duplicate is already reported once, at the defect:
+            # shadowed/dead findings for the same rule would double-
+            # count one authoring bug in the ratchet ledger.
+            continue
+        if not matched[i]:
+            add("dead-rule", "error",
+                f"rule {i} ({pat_s!r}, {_value_str(val)}) matches no "
+                "leaf in the target tree",
+                "a typo'd pattern places nothing and its target leaf "
+                "silently falls through — fix the pattern or drop the "
+                "rule")
+        elif not consulted[i]:
+            mset = set(matched[i])
+            covering = sorted({w_i for lname, _, w_i, _ in winners
+                               if lname in mset})
+            cov = ", ".join(f"rule {j} ({norm[j][0]!r})"
+                            for j in covering[:3])
+            ex = ", ".join(repr(l) for l in matched[i][:3])
+            add("shadowed-rule", "warn",
+                f"rule {i} ({pat_s!r}, {_value_str(val)}) is fully "
+                f"shadowed: every leaf it matches ({ex}) is first "
+                f"claimed by {cov}",
+                "reorder the rules (first-match-wins) or delete the "
+                "shadowed one")
+
+    if axis_sizes:
+        for lname, leaf, i, spec in winners:
+            shape = getattr(leaf, "shape", None)
+            if spec is None or shape is None:
+                continue
+            spec_t = tuple(spec)
+            if len(spec_t) > len(shape):
+                add("axis-divisibility", "error",
+                    f"rule {i} ({norm[i][0]!r}, {_value_str(spec)}) "
+                    f"names {len(spec_t)} dimensions but leaf "
+                    f"{lname!r} has rank {len(shape)}",
+                    "the spec would fail at device_put; match the "
+                    "leaf's rank")
+                continue
+            for d, entry in enumerate(spec_t):
+                size = 1
+                axes = [a for a in _axes_of(entry) if a in axis_sizes]
+                for a in axes:
+                    size *= int(axis_sizes[a])
+                if size > 1 and shape[d] % size:
+                    add("axis-divisibility", "error",
+                        f"rule {i} ({norm[i][0]!r}, "
+                        f"{_value_str(spec)}) shards dim {d} of leaf "
+                        f"{lname!r} (shape {tuple(shape)}) over "
+                        f"{'x'.join(axes)} (size {size}), which does "
+                        f"not divide {shape[d]}",
+                        "shrink the axis, pick a divisible dimension, "
+                        "or leave the leaf replicated")
+
+    # Plans with an fsdp_axis scatter unmatched leaves too
+    # (ShardingPlan.spec_for runs _augment_fsdp on every spec,
+    # including the P() an unmatched leaf falls back to), so
+    # "unmatched" only means "replicated" when the augmentation would
+    # decline the leaf — reuse the REAL augmentation to decide.
+    fsdp_axis = getattr(rules, "fsdp_axis", None)
+
+    def fsdp_shards(leaf) -> bool:
+        from jax.sharding import PartitionSpec as P
+
+        from distkeras_tpu.parallel.sharding import _augment_fsdp
+
+        if fsdp_axis is None:
+            return False
+        shape = getattr(leaf, "shape", None)
+        size = (axis_sizes or {}).get(fsdp_axis)
+        if size is None:
+            # Axis size undeclared: cannot prove replication — no warn.
+            return True
+        return _augment_fsdp(P(), shape, int(size), fsdp_axis) != P()
+
+    for lname, leaf in unmatched:
+        nbytes = _leaf_bytes(leaf)
+        if nbytes > giant_bytes and not fsdp_shards(leaf):
+            add("replicated-giant", "warn",
+                f"no rule claims leaf {lname!r} ({nbytes} bytes) — "
+                "under plan semantics it replicates in full on every "
+                "device",
+                "add a rule (or an explicit ('.*', P()) catch-all if "
+                "replication is intended — intent should be spelled, "
+                "not defaulted)")
+    return findings
+
+
+# ------------------------------------------- the shipped-plan matrix
+
+
+def plan_suite() -> list[tuple]:
+    """``(name, rules, tree, axis_sizes)`` for every shipped plan
+    constructor against the real trees it places — the dry-run matrix
+    ``tests/test_shard_lint.py`` pins (no shipped plan may carry a dead
+    or shadowed rule).  Trees are ``eval_shape`` structs: nothing
+    touches a device.  The transformer tree is the union of a dense and
+    an MoE config so the MoE rules are live; axis sizes are the
+    standard analysis meshes (8-way data for training, 4x2 for the
+    pod-sharded serving plan)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu.analysis.targets import _lm_cfg
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.parallel.collectives import zero1_shard_shapes
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+    from distkeras_tpu.parallel.rules import zero_state_rules
+    from distkeras_tpu.parallel.sharding import serving_plan
+
+    cfg = _lm_cfg()
+    dense = jax.eval_shape(
+        lambda: tfm.init_params(jax.random.key(0), cfg))
+    moe_cfg = dataclasses.replace(cfg, num_experts=2)
+    moe = jax.eval_shape(
+        lambda: tfm.init_params(jax.random.key(0), moe_cfg))
+    lm_union = {"dense": dense, "moe": moe}
+    serving_axes = {"data": 4, "model": 2}
+
+    mesh = make_mesh(MeshSpec())   # the 8-way data mesh tier-1 uses
+
+    def adam_state_over_views(params):
+        """The real ZeRO optimizer-state tree: adam over the [n, cols]
+        shard views the sharded update actually sees."""
+        shapes = sorted(zero1_shard_shapes(jax.tree.leaves(params), 8))
+        views = [jax.ShapeDtypeStruct(s, jnp.float32) for s in shapes]
+        return jax.eval_shape(optax.adam(1e-3).init, views)
+
+    # The ADAG flagship MLP's leaf shapes (analysis/targets.py).
+    mlp = [jax.ShapeDtypeStruct(s, jnp.float32)
+           for s in ((8, 16), (16,), (16, 8), (8,))]
+    mlp_state = adam_state_over_views(mlp)
+    lm_state = adam_state_over_views(dense)
+
+    return [
+        ("serving_plan", serving_plan(), lm_union, serving_axes),
+        ("tp_rules", tfm.tp_rules(), lm_union, serving_axes),
+        ("fsdp_plan+tp_rules", serving_plan(fsdp_axis="data"),
+         lm_union, serving_axes),
+        ("zero1_plan/state_rules", zero_state_rules(mlp, mesh),
+         mlp_state, {"data": 8}),
+        ("zero3_plan/state_rules", zero_state_rules(dense, mesh),
+         lm_state, {"data": 8}),
+        # The shipped per-bucket codec-rule spelling the
+        # lmtrainer_rulesef lint target trains with (docs/lowcomm.md).
+        ("exchange_codec_rules",
+         [("emb", "topk"), (".*", "int8")], dense, None),
+    ]
+
+
+def lint_repo_plans() -> list[Finding]:
+    """The plan lint over every shipped plan constructor — what the
+    ``graph_lint.py --shardings`` run and the tier-1 matrix execute."""
+    out: list[Finding] = []
+    for name, rules, tree, axes in plan_suite():
+        out += lint_plan(rules, tree, name=name, axis_sizes=axes)
+    return out
+
+
+# ----------------------------------------------- resharding attribution
+
+_RESHARD_OPS = ("all-gather", "collective-permute", "all-to-all")
+
+# An op_name containing one of these is a DECLARED exchange: the zero
+# stages' named scatter/gather scopes, the exchange layer's merge
+# scopes, or an explicit with_sharding_constraint (the serve path's
+# KV pin, the zero constraints).
+DECLARED_SCOPES = ("zero1/", "zero2/", "zero3/", "exchange/",
+                   "sharding_constraint")
+
+# ... or whose final name-stack component is an explicit collective
+# primitive (underscore-spelled in jax name stacks; the author wrote
+# the collective).  GSPMD-inserted reshardings instead carry the
+# consumer op they materialize an operand for (dot_general, mul, pad,
+# broadcast_in_dim, concatenate, ...).
+_EXPLICIT_TAILS = frozenset({
+    "all_gather", "all_gather_invariant", "all_to_all", "psum",
+    "psum_scatter", "pmean", "pmax", "pmin", "ppermute",
+    "reduce_scatter", "all_reduce",
+})
+
+_RESHARD_RE = re.compile(
+    r"[\s)](" + "|".join(_RESHARD_OPS) + r")(?:-start)?\(")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def attributed(op_name: str) -> bool:
+    """Is this compiled collective's name stack attributable to a
+    declared exchange (see module docstring)?"""
+    if any(scope in op_name for scope in DECLARED_SCOPES):
+        return True
+    return op_name.rsplit("/", 1)[-1] in _EXPLICIT_TAILS
+
+
+def resharding_census(hlo: str) -> list[dict]:
+    """Every all-gather / collective-permute / all-to-all in one
+    compiled module: ``{"op", "op_name", "attributed"}`` per instance,
+    sorted (op, op_name) so downstream finding order — and therefore
+    the warn-baseline ratchet's encounter order — is stable."""
+    out = []
+    for line in hlo.splitlines():
+        m = _RESHARD_RE.search(line)
+        if m is None:
+            continue
+        nm = _OPNAME_RE.search(line)
+        op_name = nm.group(1) if nm else ""
+        out.append({"op": m.group(1), "op_name": op_name,
+                    "attributed": attributed(op_name)})
+    return sorted(out, key=lambda r: (r["op"], r["op_name"]))
+
+
+def reshard_findings(spec, hlo: str) -> list[Finding]:
+    """``resharding-collective`` findings for one target: one warn per
+    unattributed resharding instance (per-instance so the
+    lint_baseline ratchet pins exact counts; known backend artifacts —
+    the CPU partitioner's hierarchical AR+permute spelling, the
+    fsdp/zero3 gather-on-use materializations — live in that ledger
+    with their justification in docs/graph_lint.md)."""
+    out = []
+    for rec in resharding_census(hlo):
+        if rec["attributed"]:
+            continue
+        tail = rec["op_name"].rsplit("/", 1)[-1] or "<no metadata>"
+        out.append(Finding(
+            rule="resharding-collective", severity="warn",
+            path=spec.name, line=None,
+            message=(f"GSPMD-inserted {rec['op']} not attributable to "
+                     f"a declared sharding scope (op_name tail "
+                     f"`{tail}`)"),
+            hint="a resharding the plan did not declare moves bytes "
+                 "every step; add/restore the with_sharding_constraint "
+                 "or named scope that owns it, or — for a known "
+                 "backend artifact — record it in the "
+                 "lint_baseline.json ratchet with a docs/graph_lint.md "
+                 "justification",
+            suppressed="resharding-collective" in spec.suppress))
+    return out
+
+
+# ------------------------------------------------- placement census
+
+
+def _shape_str(shape, dtype) -> str:
+    short = _DTYPE_SHORT.get(str(dtype), str(dtype))
+    return f"{short}[{','.join(str(d) for d in shape)}]"
+
+
+def _placement_str(sh) -> str:
+    spec = getattr(sh, "spec", None)
+    if spec is not None:
+        return _spec_str(spec)
+    if getattr(sh, "is_fully_replicated", False):
+        return "P()"
+    return type(sh).__name__
+
+
+def _per_device_bytes(sh, shape, dtype) -> int:
+    try:
+        local = sh.shard_shape(tuple(shape))
+    except Exception:  # noqa: BLE001 — shardless leaf: counts in full
+        local = tuple(shape)
+    return _leaf_bytes_of(local, dtype)
+
+
+def placement_census(spec, artifacts) -> dict:
+    """The compiled placement table of one lint target.
+
+    Explicit arguments come from the executable's input shardings
+    (named ``args/<flattened key path>``); closed-over tensors — the
+    serving engines capture their parameters — from the jaxpr consts'
+    live shardings (named ``const/<i>`` in trace order, shape/dtype
+    recorded so the table diffs readably).  Per-device bytes are
+    computed from each sharding's shard shape — replicated leaves count
+    in full, sharded leaves 1/n — the same accounting
+    ``engine.memory_footprint()`` reads off live addressable shards
+    (cross-checked in tests/test_budget_guards.py).
+    """
+    import jax
+
+    from distkeras_tpu.parallel.rules import leaf_name
+
+    closed, compiled = artifacts.closed, artifacts.compiled
+    # None appears on BOTH sides — as an empty argument (a disabled
+    # rng, an absent segment tree) and, on the sharding side only, as
+    # the marker for an argument jit pruned (unused in the program).
+    # Flattening both trees with None-as-leaf keeps them aligned.
+    arg_leaves = jax.tree_util.tree_flatten_with_path(
+        spec.args, is_leaf=lambda x: x is None)[0]
+    shardings = jax.tree_util.tree_leaves(
+        compiled.input_shardings[0],
+        is_leaf=lambda x: x is None or isinstance(x,
+                                                  jax.sharding.Sharding))
+    if len(shardings) != len(arg_leaves):
+        raise RuntimeError(
+            f"{spec.name}: {len(shardings)} compiled input shardings "
+            f"for {len(arg_leaves)} argument leaves — the census "
+            "cannot align them")
+    tensors: dict[str, list] = {}
+
+    def record(name, shape, dtype, sh):
+        if sh is None:
+            # Pruned input: the program never reads it, so XLA assigns
+            # no placement; it still persists between steps, so the
+            # ledger counts its full bytes.
+            tensors[name] = [_shape_str(shape, dtype), "pruned",
+                             _leaf_bytes_of(shape, dtype)]
+            return
+        tensors[name] = [_shape_str(shape, dtype), _placement_str(sh),
+                         _per_device_bytes(sh, shape, dtype)]
+
+    for (path, leaf), sh in zip(arg_leaves, shardings):
+        if leaf is None:
+            continue   # empty argument slot, not a tensor
+        record("args/" + leaf_name(path), leaf.shape, leaf.dtype, sh)
+    for i, const in enumerate(closed.consts):
+        shape = getattr(const, "shape", None)
+        if shape is None or len(shape) == 0:
+            continue   # scalar bookkeeping constants, not tensors
+        sh = getattr(const, "sharding", None)
+        if sh is None:
+            # A host-side constant (plain numpy closure): live and
+            # effectively replicated — distinct from a pruned ARG,
+            # which the program never reads.
+            tensors[f"const/{i}"] = [_shape_str(shape, const.dtype),
+                                     "host-const",
+                                     _leaf_bytes_of(shape, const.dtype)]
+            continue
+        record(f"const/{i}", shape, const.dtype, sh)
+
+    census = resharding_census(artifacts.hlo) if artifacts.hlo else []
+    return {
+        "tensors": tensors,
+        "bytes_global": sum(_leaf_bytes(l) for _, l in arg_leaves)
+        + sum(_leaf_bytes(c) for c in closed.consts
+              if getattr(c, "ndim", 0)),
+        "bytes_per_device": sum(v[2] for v in tensors.values()),
+        "resharding": {
+            "attributed": sum(r["attributed"] for r in census),
+            "unattributed": sum(not r["attributed"] for r in census),
+        },
+    }
+
+
+# ----------------------------------------------------------- budgets
+
+
+def check_shard_budget(name: str, entry: dict, budgets: dict
+                       ) -> list[Finding]:
+    """Compare one target's placement census against the checked-in
+    ``scripts/shard_budget.json``.  Any drift — a tensor's placement,
+    shape, per-device bytes, the byte totals, or the resharding
+    attribution counts — is an error finding; re-record deliberate
+    changes with ``graph_lint.py --update-budgets`` and review the
+    JSON diff (the diff IS the placement review)."""
+    want = budgets.get(name)
+    if want is None:
+        return [Finding(
+            rule="shard-budget", severity="error", path=name, line=None,
+            message="no placement budget recorded for this target",
+            hint="run scripts/graph_lint.py --update-budgets")]
+    if want == entry:
+        return []
+    got_t, want_t = entry.get("tensors", {}), want.get("tensors", {})
+    changed = sorted(
+        set(k for k in got_t if got_t[k] != want_t.get(k))
+        | (set(want_t) - set(got_t)))
+    detail = ", ".join(changed[:4]) + ("..." if len(changed) > 4 else "")
+    return [Finding(
+        rule="shard-budget", severity="error", path=name, line=None,
+        message=(f"compiled placements drifted from the budget: "
+                 f"{len(changed)} tensor(s) changed ({detail}); "
+                 f"per-device bytes {want.get('bytes_per_device')} -> "
+                 f"{entry.get('bytes_per_device')}, resharding "
+                 f"{want.get('resharding')} -> "
+                 f"{entry.get('resharding')}"),
+        hint="if the placement change is intentional, re-record with "
+             "scripts/graph_lint.py --update-budgets and review the "
+             "scripts/shard_budget.json diff")]
+
+
+def load_shard_budgets(path: str) -> dict:
+    import json
+
+    with open(path) as f:
+        return json.load(f)["targets"]
+
+
+def save_shard_budgets(path: str, budgets: dict,
+                       device_count: int | None = None) -> None:
+    import json
+
+    import jax
+
+    doc = {
+        "comment": "per-tensor compiled placements + per-device byte "
+                   "ledger per lint target on the 8-device CPU mesh "
+                   "(NOTE: CPU-compiled placements — the AR+slice "
+                   "artifact; see the ROADMAP item-5 hardware ledger "
+                   "for which rows a TPU session must re-verify); "
+                   "re-record with scripts/graph_lint.py "
+                   "--update-budgets and review the diff",
+        "device_count": (device_count if device_count is not None
+                         else jax.device_count()),
+        "targets": budgets,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+__all__ = ["DEFAULT_GIANT_BYTES", "lint_plan", "plan_suite",
+           "lint_repo_plans", "DECLARED_SCOPES", "attributed",
+           "resharding_census", "reshard_findings", "placement_census",
+           "check_shard_budget", "load_shard_budgets",
+           "save_shard_budgets"]

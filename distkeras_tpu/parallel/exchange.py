@@ -360,7 +360,8 @@ def resolve_codecs(rules: Sequence, tree, names=None):
     def of(name):
         matched, codec = first_match(compiled, str(name))
         if not matched:
-            raise UnmatchedLeafError(str(name), "codec")
+            raise UnmatchedLeafError(str(name), "codec",
+                                     [p.pattern for p, _ in compiled])
         return codec
 
     return jax.tree.map(of, names)

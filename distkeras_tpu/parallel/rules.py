@@ -40,15 +40,55 @@ from distkeras_tpu.parallel.compat import keystr
 
 
 class UnmatchedLeafError(ValueError):
-    """No rule matched a leaf (carries the rendered leaf path)."""
+    """No rule matched a leaf (carries the rendered leaf path).
 
-    def __init__(self, name: str, what: str):
+    ``patterns`` (when the raiser has them) are the rule patterns that
+    were tried: the message names the 3 nearest misses by match-prefix
+    length — how far the pattern's literal spelling gets into the leaf
+    path before diverging — so a plan-authoring typo ("atn/wq" for
+    "attn/wq") is self-diagnosing instead of a silent fall-through.
+    """
+
+    def __init__(self, name: str, what: str, patterns: Sequence[str] = ()):
         self.leaf = name
+        near = nearest_patterns(name, patterns)
+        near_s = ("; nearest-miss patterns (by match-prefix length): "
+                  + ", ".join(repr(p) for p in near)) if near else ""
         super().__init__(
             f"no {what} rule matched leaf {name!r}; rules are ordered "
             "(pattern, value) pairs matched first-match-wins against "
             "the flattened key path — add a rule for this leaf or a "
-            "catch-all ('.*', <default>) at the end")
+            f"catch-all ('.*', <default>) at the end{near_s}")
+
+
+def _pattern_skeleton(pattern: str) -> str:
+    """The literal spine of a regex: metacharacters stripped, escapes
+    unwrapped — what the author *typed* minus the regex machinery."""
+    s = re.sub(r"\\([\w/])", r"\1", pattern)
+    return re.sub(r"[\^\$\.\*\+\?\(\)\[\]\{\}\|\\]", "", s)
+
+
+def _miss_score(pattern: str, name: str) -> int:
+    """Match-prefix length: the longest prefix of the pattern's literal
+    skeleton that still occurs in ``name``.  A typo'd rule scores just
+    below its intended target; an unrelated rule scores ~0."""
+    skel = _pattern_skeleton(pattern)
+    for k in range(len(skel), 0, -1):
+        if skel[:k] in name:
+            return k
+    return 0
+
+
+def nearest_patterns(name: str, patterns: Sequence[str], n: int = 3):
+    """The ``n`` patterns nearest to ``name`` by match-prefix length
+    (ties keep rule order) — the UnmatchedLeafError diagnosis.
+    Patterns sharing nothing with the leaf (score 0) are omitted:
+    listing unrelated rules as "nearest" would mislead, and an empty
+    result drops the diagnosis line entirely."""
+    pats = [p if isinstance(p, str) else p.pattern for p in patterns]
+    scored = sorted((-_miss_score(p, name), i)
+                    for i, p in enumerate(pats))
+    return [pats[i] for s, i in scored[:n] if s < 0]
 
 
 # Sentinel: "no default — unmatched leaves are an error".
@@ -61,10 +101,39 @@ def leaf_name(path) -> str:
     return keystr(path, simple=True, separator="/")
 
 
+def _is_concrete(val) -> bool:
+    """A value that always claims a pattern match (a PartitionSpec, a
+    codec name, a sharding) — as opposed to a callable rule, which may
+    decline and fall through.  The shard lint's duplicate-pattern rule
+    (analysis/shard_lint.py) shares this predicate so the build-time
+    rejection below and the static lint can never disagree."""
+    return not (callable(val) and not isinstance(val, type))
+
+
 def compile_rules(rules: Sequence[tuple[str, Any]]):
     """[(pattern, value)] -> [(compiled, value)], validating patterns
-    eagerly so a typo raises at plan construction, not mid-trace."""
-    return [(re.compile(pat), val) for pat, val in rules]
+    eagerly so a typo raises at plan construction, not mid-trace.
+
+    Rejects an identical pattern repeated after an earlier occurrence
+    with a *concrete* value: first-match-wins makes the later rule
+    unreachable, so the duplicate is a plan-authoring bug (the same
+    spelling as the shard lint's ``duplicate-pattern`` rule,
+    docs/graph_lint.md).  Repeats after a *callable* occurrence remain
+    legal — the decline-chain idiom ``zero_state_rules`` is built on.
+    """
+    claimed: dict[str, bool] = {}
+    out = []
+    for pat, val in rules:
+        if claimed.get(pat):
+            raise ValueError(
+                f"duplicate pattern {pat!r}: an identical earlier rule "
+                "with a concrete value already claims every match "
+                "(first-match-wins), so this rule can never fire — "
+                "remove one of the two (shard lint rule "
+                "`duplicate-pattern`)")
+        claimed[pat] = claimed.get(pat, False) or _is_concrete(val)
+        out.append((re.compile(pat), val))
+    return out
 
 
 def first_match(compiled, name: str, leaf=None):
@@ -100,7 +169,8 @@ def match_rules(rules: Sequence[tuple[str, Any]], tree, *,
         if matched:
             return val
         if default is _RAISE:
-            raise UnmatchedLeafError(name, what)
+            raise UnmatchedLeafError(name, what,
+                                     [p.pattern for p, _ in compiled])
         return default
 
     return jax.tree_util.tree_map_with_path(visit, tree)
@@ -303,7 +373,8 @@ def kv_slab_shardings(mesh: Mesh, tree, axis: str | None):
         lambda s: NamedSharding(mesh, s), kv_slab_specs(tree, axis))
 
 
-__all__ = ["UnmatchedLeafError", "leaf_name", "compile_rules",
+__all__ = ["UnmatchedLeafError", "nearest_patterns", "leaf_name",
+           "compile_rules",
            "first_match", "match_rules", "match_partition_rules",
            "tree_shardings", "shard_view_rule", "zero_state_rules",
            "zero_state_shardings", "zero3_param_shardings",

@@ -297,6 +297,20 @@ class ServingPlan(ShardingPlan):
     """
 
 
+def _override_rules(extra_rules, stock_rules) -> list:
+    """Compose user overrides ahead of stock rules, first-match-wins.
+
+    An extra rule that spells a stock pattern VERBATIM replaces it —
+    the stock copy is dropped rather than left as an unreachable
+    duplicate, which ``rules.compile_rules`` (round 17) rejects at
+    build time.  Overrides via broader/narrower patterns compose by
+    ordering alone, as before.
+    """
+    seen = {pat for pat, _ in extra_rules}
+    return list(extra_rules) + [(pat, val) for pat, val in stock_rules
+                                if pat not in seen]
+
+
 def serving_plan(extra_rules: Sequence[tuple[str, P]] = (),
                  fsdp_axis: str | None = None) -> ServingPlan:
     """The pod-sharded serving plan (ROADMAP item 1, arXiv
@@ -318,7 +332,7 @@ def serving_plan(extra_rules: Sequence[tuple[str, P]] = (),
     """
     from distkeras_tpu.models.transformer import tp_rules
 
-    return ServingPlan(rules=list(extra_rules) + tp_rules(),
+    return ServingPlan(rules=_override_rules(extra_rules, tp_rules()),
                        batch_spec=P(), fsdp_axis=fsdp_axis)
 
 
@@ -380,9 +394,9 @@ def tp_plan(extra_rules: Sequence[tuple[str, P]] = ()) -> ShardingPlan:
     XLA turns the resulting partial products into psum/reduce-scatter on
     the ICI.
     """
-    rules = list(extra_rules) + [
+    rules = _override_rules(extra_rules, [
         (r"(dense|mlp|fc)[^/]*/kernel$", P(None, "model")),
         (r"embedding[^/]*/embeddings$", P(None, "model")),
         (r"conv[^/]*/kernel$", P(None, None, None, "model")),
-    ]
+    ])
     return ShardingPlan(rules=rules, batch_spec=P("data"))

@@ -1,4 +1,4 @@
-"""Graph lint CLI: source lint + IR lint + comm budgets, for CI.
+"""Graph lint CLI: source + IR + shard lint + budgets, for CI.
 
 Runs, in order:
 
@@ -12,15 +12,22 @@ Runs, in order:
 3. the **collective census** of each compiled step against
    ``scripts/comm_budget.json``, plus the ZeRO-1 parity proof
    (RS+AG == the gradient all-reduce it replaces, bytes measured
-   from the declared exchange and the DP partner's compiled HLO).
+   from the declared exchange and the DP partner's compiled HLO);
+4. the **shard lint** (analysis/shard_lint.py): the plan lint over
+   every shipped partition-rule plan (dead/shadowed/duplicate rules,
+   axis divisibility, replicated giants) and the compiled-placement
+   census of every target — per-tensor shardings + per-device byte
+   ledger pinned in ``scripts/shard_budget.json``, resharding
+   collectives attributed to declared scopes.
 
 Exit 0 iff there are zero unsuppressed error/warn findings.  Usage::
 
     python scripts/graph_lint.py                  # full run (CI)
     python scripts/graph_lint.py --source-only    # AST rules only, fast
     python scripts/graph_lint.py --threads        # thread-safety rules only
-    python scripts/graph_lint.py --ir-only        # IR rules + budgets
-    python scripts/graph_lint.py --update-budgets # re-record the census
+    python scripts/graph_lint.py --ir-only        # IR + shard + budgets
+    python scripts/graph_lint.py --shardings      # shard lint only
+    python scripts/graph_lint.py --update-budgets # re-record BOTH censuses
     python scripts/graph_lint.py --update-baseline # re-record warn ledger
     python scripts/graph_lint.py -v               # also print censuses
 
@@ -50,6 +57,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 BUDGET_PATH = os.path.join(REPO, "scripts", "comm_budget.json")
+SHARD_BUDGET_PATH = os.path.join(REPO, "scripts", "shard_budget.json")
 BASELINE_PATH = os.path.join(REPO, "scripts", "lint_baseline.json")
 
 
@@ -66,40 +74,78 @@ def run_threads(findings):
     findings += lint_paths_threads([os.path.join(REPO, "distkeras_tpu")])
 
 
-def run_ir(findings, update: bool, verbose: bool):
-    from distkeras_tpu.analysis import ir_lint
+def run_plan_lint(findings):
+    """The shard lint's pure-host half: every shipped plan constructor
+    against the real trees it places (no trace, no compile)."""
+    from distkeras_tpu.analysis import shard_lint
+
+    findings += shard_lint.lint_repo_plans()
+
+
+def run_ir(findings, update: bool, verbose: bool,
+           shardings_only: bool = False):
+    """The compile-heavy layer: each standard target is traced and
+    compiled ONCE (ir_lint.trace_target) and the artifacts feed the IR
+    audits, the collective census, AND the shard lint's placement
+    census — the full run never pays a second backend compile.
+    ``shardings_only`` skips the IR audits/comm budgets (the
+    ``--shardings`` view)."""
+    from distkeras_tpu.analysis import ir_lint, shard_lint
     from distkeras_tpu.analysis.targets import default_targets
 
     specs = default_targets()
-    censuses, measured = {}, {}
+    censuses, measured, placements = {}, {}, {}
     for spec in specs:
-        fs, census = ir_lint.lint_trace(spec)
-        findings += fs
-        censuses[spec.name] = census
-        measured[spec.name] = ir_lint.census_to_budget(census)
+        art = ir_lint.trace_target(spec)
+        if not shardings_only:
+            fs, census = ir_lint.lint_trace(spec, artifacts=art)
+            findings += fs
+            censuses[spec.name] = census
+            measured[spec.name] = ir_lint.census_to_budget(census)
+        placements[spec.name] = shard_lint.placement_census(spec, art)
+        findings += shard_lint.reshard_findings(spec, art.hlo)
         if verbose:
-            print(f"-- {spec.name}: "
-                  f"{measured[spec.name]['wire_total']} wire B")
-            for c in census:
-                print(f"     {c.as_json()}")
+            p = placements[spec.name]
+            wire = (f"{measured[spec.name]['wire_total']} wire B, "
+                    if not shardings_only else "")
+            print(f"-- {spec.name}: {wire}"
+                  f"{p['bytes_per_device']} B/device "
+                  f"({len(p['tensors'])} tensors, resharding "
+                  f"{p['resharding']})")
+            if not shardings_only:
+                for c in censuses[spec.name]:
+                    print(f"     {c.as_json()}")
 
-    for spec in specs:
-        if spec.zero1_parity_with:
-            findings += ir_lint.check_zero1_parity(
-                spec, censuses[spec.zero1_parity_with])
+    if not shardings_only:
+        for spec in specs:
+            if spec.zero1_parity_with:
+                findings += ir_lint.check_zero1_parity(
+                    spec, censuses[spec.zero1_parity_with])
 
     if update:
         ir_lint.save_budgets(BUDGET_PATH, measured)
         print(f"wrote {BUDGET_PATH} ({len(measured)} targets)")
+        shard_lint.save_shard_budgets(SHARD_BUDGET_PATH, placements)
+        print(f"wrote {SHARD_BUDGET_PATH} ({len(placements)} targets)")
         return
+    if not shardings_only:
+        try:
+            budgets = ir_lint.load_budgets(BUDGET_PATH)
+        except (OSError, ValueError, KeyError):
+            print(f"no readable budget at {BUDGET_PATH}; run "
+                  "--update-budgets to record one", file=sys.stderr)
+            budgets = {}
+        for name, census in censuses.items():
+            findings += ir_lint.check_budget(name, census, budgets)
     try:
-        budgets = ir_lint.load_budgets(BUDGET_PATH)
+        shard_budgets = shard_lint.load_shard_budgets(SHARD_BUDGET_PATH)
     except (OSError, ValueError, KeyError):
-        print(f"no readable budget at {BUDGET_PATH}; run "
+        print(f"no readable budget at {SHARD_BUDGET_PATH}; run "
               "--update-budgets to record one", file=sys.stderr)
-        budgets = {}
-    for name, census in censuses.items():
-        findings += ir_lint.check_budget(name, census, budgets)
+        shard_budgets = {}
+    for name, entry in placements.items():
+        findings += shard_lint.check_shard_budget(name, entry,
+                                                  shard_budgets)
 
 
 def main(argv):
@@ -110,6 +156,11 @@ def main(argv):
                     help="thread-safety rules only (analysis/"
                          "thread_lint.py over the threaded core), "
                          "fastest of all")
+    ap.add_argument("--shardings", action="store_true",
+                    help="shard lint only (analysis/shard_lint.py): "
+                         "the plan lint over every shipped partition "
+                         "plan plus the compiled-placement census vs "
+                         "scripts/shard_budget.json")
     ap.add_argument("--update-budgets", action="store_true")
     ap.add_argument("--update-baseline", action="store_true",
                     help="re-record scripts/lint_baseline.json from "
@@ -118,20 +169,37 @@ def main(argv):
     args = ap.parse_args(argv)
 
     if args.update_baseline and (args.source_only or args.ir_only
-                                 or args.threads):
-        # The ledger covers BOTH lint layers; re-recording from a
-        # half-census would drop the other layer's keys and start
-        # failing its previously-baselined warns on the next full run.
+                                 or args.threads or args.shardings):
+        # The ledger covers EVERY lint layer; re-recording from a
+        # half-census would drop the other layers' keys and start
+        # failing their previously-baselined warns on the next full run.
         ap.error("--update-baseline needs the full run (drop "
-                 "--source-only/--ir-only/--threads)")
+                 "--source-only/--ir-only/--threads/--shardings)")
     if args.threads and (args.source_only or args.ir_only
-                         or args.update_budgets):
+                         or args.shardings or args.update_budgets):
         # --threads skips the IR layer entirely: silently accepting a
         # budget re-record (or a conflicting mode) would exit 0
         # having written nothing.
         ap.error("--threads runs the thread-safety rules alone; it "
                  "cannot combine with --source-only/--ir-only/"
-                 "--update-budgets")
+                 "--shardings/--update-budgets")
+    if args.shardings and (args.source_only or args.ir_only):
+        # Same parity as --threads: one mode flag at a time.
+        ap.error("--shardings runs the shard lint alone; it cannot "
+                 "combine with --source-only/--ir-only")
+    if args.shardings and args.update_budgets:
+        # --update-budgets re-records comm_budget.json AND
+        # shard_budget.json from one compile pass; a --shardings run
+        # computes only half and would leave the comm census stale.
+        ap.error("--update-budgets re-records both census files from "
+                 "the full IR pass; drop --shardings (use --ir-only "
+                 "--update-budgets for the compile-heavy layer alone)")
+    if args.source_only and args.update_budgets:
+        # Symmetric to the --threads/--shardings guards: a source-only
+        # run never reaches run_ir, so the re-record would exit 0
+        # having written nothing.
+        ap.error("--update-budgets needs the IR pass; drop "
+                 "--source-only (or use --ir-only --update-budgets)")
 
     from distkeras_tpu.analysis.findings import (apply_baseline,
                                                  format_findings,
@@ -141,10 +209,15 @@ def main(argv):
     findings = []
     if args.threads:
         run_threads(findings)
+    elif args.shardings:
+        run_plan_lint(findings)
+        run_ir(findings, update=False, verbose=args.verbose,
+               shardings_only=True)
     else:
         if not args.ir_only:
             run_source(findings)
         if not args.source_only:
+            run_plan_lint(findings)
             run_ir(findings, update=args.update_budgets,
                    verbose=args.verbose)
     if args.update_baseline:
