@@ -97,6 +97,7 @@ from distkeras_tpu.trainers import (
     Trainer,
     SingleTrainer,
     ADAG,
+    AsyncDP,
     DOWNPOUR,
     AEASGD,
     EAMSGD,
@@ -161,6 +162,7 @@ __all__ = [
     "Trainer",
     "SingleTrainer",
     "ADAG",
+    "AsyncDP",
     "DOWNPOUR",
     "AEASGD",
     "EAMSGD",

@@ -171,13 +171,44 @@ def _pair(specs: list[TraceSpec]) -> list[TraceSpec]:
     return out
 
 
+def async_targets() -> list[TraceSpec]:
+    """The async tier (docs/async.md): the intra-host accumulation
+    step AsyncDP trains with, plus the cross-host wire leg — one
+    compiled aggregation wave whose all-gather payload dtype the
+    census pins (``asyncdp_wire/adasum_int8`` must carry s8, the
+    proof that cross-host deltas ride the int8 codec; the ``sum``
+    variant documents the uncompressed f32 wire for comparison)."""
+    import keras
+
+    import distkeras_tpu as dk
+
+    def trainer(**kw):
+        model = keras.Sequential([keras.layers.Input((8,)),
+                                  keras.layers.Dense(16,
+                                                     activation="relu"),
+                                  keras.layers.Dense(8)])
+        return dk.AsyncDP(model, hosts=2, tau=2,
+                          loss="sparse_categorical_crossentropy",
+                          worker_optimizer="adam", learning_rate=0.05,
+                          batch_size=4, communication_window=2, **kw)
+
+    ds = _mlp_dataset()
+    return (trainer(async_merge="adasum",
+                    async_compress="int8").traced_for_analysis(ds)
+            + [s for s in trainer(async_merge="sum",
+                                  async_compress=None)
+               .traced_for_analysis(ds)
+               if s.name.startswith("asyncdp_wire/")])
+
+
 def default_targets() -> list[TraceSpec]:
     """Every standard target: both trainer families (DP / the ZeRO
-    stages / fsdp / the exchange variants) plus both serving engines'
-    decode steps."""
-    return adag_targets() + lm_targets() + serving_targets()
+    stages / fsdp / the exchange variants), the async tier, plus both
+    serving engines' decode steps."""
+    return (adag_targets() + lm_targets() + serving_targets()
+            + async_targets())
 
 
 __all__ = ["ZERO_PARITY_TARGETS", "ZERO1_PARITY_PAIRS",
            "adag_targets", "lm_targets", "serving_targets",
-           "default_targets"]
+           "async_targets", "default_targets"]

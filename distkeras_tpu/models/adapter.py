@@ -284,11 +284,10 @@ class ModelAdapter:
         layout: each microbatch's gradient is packed per bucket and
         reduce-scattered INTO the accumulation scan (the
         ``collectives.scatter`` constraint on the carry), so a replica
-        only ever persists its ``1/n`` gradient shard — the PR-2
-        follow-up ("interleave bucket reduce-scatters into the scan")
-        closed.  The update then runs on the shard views directly via
-        ``inner`` (the UNWRAPPED optax transform, whose state the
-        trainers init over shard views).
+        only ever persists its ``1/n`` gradient shard.  The update
+        then runs on the shard views directly via ``inner`` (the
+        UNWRAPPED optax transform, whose state the trainers init over
+        shard views).
 
         Stage 2 keeps parameters replicated and all-gathers the update
         (RS-per-microbatch + one AG — *less* wire than the per-

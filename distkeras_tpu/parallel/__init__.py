@@ -1,4 +1,6 @@
 from distkeras_tpu.parallel import collectives, rules
+from distkeras_tpu.parallel.async_tier import (AsyncConfig, AsyncPlane,
+                                                AsyncSchedule, VirtualClock)
 from distkeras_tpu.parallel.collectives import (Zero1Layout, all_gather,
                                                  gather_bucket,
                                                  reduce_scatter,
@@ -14,4 +16,5 @@ __all__ = ["MeshSpec", "make_mesh", "local_device_count", "ShardingPlan",
            "dp_plan", "fsdp_plan", "tp_plan", "zero1_plan", "zero3_plan",
            "Zero1Plan", "Zero3Plan", "collectives", "rules", "Zero1Layout",
            "reduce_scatter", "all_gather", "gather_bucket",
-           "zero1_optimizer", "match_partition_rules", "match_rules"]
+           "zero1_optimizer", "match_partition_rules", "match_rules",
+           "AsyncConfig", "AsyncPlane", "AsyncSchedule", "VirtualClock"]

@@ -50,6 +50,8 @@ SITES = (
     "serving.admit",    # lane admission (submit/pump)
     "serving.draft",    # SpeculativeBatcher's draft half of the step
     "cluster.heartbeat",  # HeartbeatWriter: before every beat publishes
+    "cluster.push",     # AsyncPlane.push: before a host's delta publishes
+    "cluster.merge",    # AsyncPlane aggregation wave: before center applies
 )
 
 

@@ -99,7 +99,7 @@ class SpeculativeBatcher(_LaneEngine):
         # ring-compatible too: the target-only fallback advances the
         # same unbounded per-lane positions over the same ring slabs,
         # so a draft fault mid-wrap preserves greedy solo parity past
-        # max_len (the PR-1 follow-up).  Mixed full/windowed model
+        # max_len.  Mixed full/windowed model
         # pairs stay rejected: their caches disagree on what a
         # position IS past the smaller ring.
         self._rolling = False

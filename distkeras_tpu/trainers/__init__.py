@@ -4,6 +4,7 @@ from distkeras_tpu.trainers.distributed import (
     ADAG,
     DynSGD,
 )
+from distkeras_tpu.trainers.async_dp import AsyncDP
 from distkeras_tpu.trainers.lm import LMTrainer, LoRATrainer
 from distkeras_tpu.trainers.elastic import (
     AEASGD,
@@ -18,6 +19,7 @@ __all__ = [
     "SingleTrainer",
     "DistributedTrainer",
     "ADAG",
+    "AsyncDP",
     "DynSGD",
     "AEASGD",
     "EAMSGD",

@@ -1,0 +1,339 @@
+"""AsyncDP: bounded-staleness asynchronous data parallelism over hosts.
+
+The last trainer family from the reference (distkeras/trainers.py
+DOWNPOUR/AEASGD: async workers committing deltas to a parameter
+server), rebuilt on this framework's terms (docs/async.md): each
+*host* is a full intra-host ADAG configuration — the same jitted
+accumulation step, the same mesh collectives, any zero=/exchange
+combination — and hosts exchange parameter deltas through the
+:class:`~distkeras_tpu.parallel.async_tier.AsyncPlane` under a
+staleness bound τ, an Adasum aggregation tree, and the int8
+error-feedback wire.
+
+Hosts here are *simulated* on one process under a seeded virtual-time
+clock: every host shares the single compiled step (one XLA program —
+the compile budget does not scale with fleet size) but owns its own
+``TrainState``, its own contiguous dataset shard, and its own position
+in virtual time.  The discrete-event loop is the deterministic
+replacement for wall-clock racing: round completions, stalls, barrier
+parks, watchdog evictions, joins and leaves are a pure function of
+``(seed, schedule)``, so a chaos interleaving replays bit-for-bit —
+the property the determinism harness (tests/test_async_tier.py) and
+the ``chaos_suite.py --cluster`` async legs assert.  On a real fleet
+the same plane logic runs per-host against wall time with
+``coord_dir`` heartbeats; nothing in the plane reads the simulation.
+
+Round protocol, per host:
+
+1. ``pull`` center params at version v (a copy — steps donate).
+2. run ONE jitted accumulation round on the host's next data window
+   (``communication_window`` microbatches, intra-host collectives).
+3. ``delta = tv_after - tv_pulled``; ``push`` through the
+   ``cluster.push`` chaos probe, int8-EF-encoded, into the tree.
+4. re-pull and start the next round — unless the SSP gate blocks it
+   (a peer is > τ behind): slow-but-alive laggard -> park under the
+   hard-sync barrier; wedged-heartbeat laggard -> the watchdog evicts
+   it after ``beat_window`` virtual seconds and the fleet proceeds.
+
+A killed-mid-push host (``fail`` rule on ``cluster.push``) publishes
+nothing — its delta is dropped cleanly and the host leaves the
+membership, exactly the preemption-immunity contract.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import jax
+import numpy as np
+
+from distkeras_tpu import obs
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.models.adapter import TrainState
+from distkeras_tpu.parallel.async_tier import (AsyncConfig, AsyncPlane,
+                                                AsyncSchedule, VirtualClock,
+                                                copy_tree, delta_of,
+                                                make_wire_merge)
+from distkeras_tpu.parallel.mesh import per_host_rows
+from distkeras_tpu.resilience import chaos
+from distkeras_tpu.trainers.distributed import ADAG
+
+
+class AsyncDP(ADAG):
+    """Bounded-staleness async DP: ``hosts`` simulated hosts, staleness
+    bound ``tau``, ``async_merge`` ("adasum"/"sum") up a ``fanout``-ary
+    aggregation tree, ``async_compress`` (None/"int8") on the wire.
+
+    ``schedule=`` takes an :class:`AsyncSchedule` (stalls, joins,
+    leaves); default is the plain seeded heterogeneous-duration
+    schedule.  ``coord_dir=`` optionally roots the plane's membership
+    epochs + heartbeat files on the cluster substrate.  All intra-host
+    ADAG knobs (``zero=``, ``merge_rule=``, ``communication_window=``,
+    ...) compose; ``device_data`` does not (the indexed plane has no
+    per-host streaming split).
+
+    After ``train()``, ``async_report`` holds the audit trail: virtual
+    makespan, per-host rounds, hard-sync/evict/join/leave events, wire
+    bytes and the center version history — what the chaos legs and the
+    bench rows assert against.
+    """
+
+    _supports_device_data = False
+
+    def __init__(self, keras_model, hosts: int = 2, tau: int = 4,
+                 async_merge: str = "adasum",
+                 async_compress: str | None = "int8",
+                 fanout: int = 2, beat_window: float = 3.0,
+                 schedule: AsyncSchedule | None = None,
+                 async_seed: int = 0, coord_dir: str | None = None,
+                 **kw):
+        super().__init__(keras_model, **kw)
+        if hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {hosts}")
+        if self.adapter.ntv_paths:
+            raise ValueError(
+                "AsyncDP needs a model without non-trainable training "
+                "state (BatchNorm running stats, seeded Dropout): "
+                "per-host local rounds would diverge it — train such "
+                "models with the synchronous trainers")
+        self.hosts = int(hosts)
+        self.async_config = AsyncConfig(
+            tau=tau, merge_rule=async_merge, compress=async_compress,
+            fanout=fanout, beat_window=beat_window)
+        self.schedule = schedule if schedule is not None \
+            else AsyncSchedule(seed=async_seed)
+        self.coord_dir = coord_dir
+        self.async_report: dict | None = None
+
+    # ------------------------------------------------------------ lint
+
+    def traced_for_analysis(self, dataset: Dataset):
+        """The intra-host accumulation step (inherited, the program
+        that trains) plus the cross-host wire leg: one compiled
+        aggregation wave whose all-gather payload the census audits —
+        with ``async_compress="int8"`` the wire dtype is s8."""
+        from distkeras_tpu.analysis.ir_lint import TraceSpec
+
+        specs = super().traced_for_analysis(dataset)
+        cfg = self.async_config
+        n = self.num_workers
+        state = jax.eval_shape(self.adapter.init_state)
+        stacked = jax.tree.map(
+            lambda v: jax.ShapeDtypeStruct((n,) + tuple(v.shape),
+                                           np.float32), state.tv)
+        pbytes = int(sum(np.prod(v.shape) * v.dtype.itemsize
+                         for v in jax.tree.leaves(state.tv)))
+        wire = jax.jit(make_wire_merge(self.mesh, cfg))
+        label = cfg.merge_rule + ("_int8" if cfg.compress == "int8"
+                                  else "")
+        specs.append(TraceSpec(
+            name=f"asyncdp_wire/{label}", fn=wire, args=(stacked,),
+            params_bytes=pbytes))
+        return specs
+
+    # ------------------------------------------------------------- fit
+
+    def _host_windows(self, dataset: Dataset):
+        """Contiguous per-host data shards, pre-shaped into rounds: the
+        epoch's window stream splits into ``hosts`` runs of equal
+        length; host ``h`` consumes run ``h`` in order, ``num_epoch``
+        times.  A joiner replays run ``host % hosts``."""
+        w = self.communication_window
+        H = self.exchange.sync_every
+        feed_bs = per_host_rows(self.batch_size * self.num_workers)
+        wins = []
+        for xs, ys in dataset.batches(
+                feed_bs, features_col=self.features_col,
+                label_col=self.label_col, window=w * H):
+            if H > 1:
+                xs = xs.reshape((H, w) + xs.shape[1:])
+                ys = ys.reshape((H, w) + ys.shape[1:])
+            wins.append((xs, ys))
+        per_host = len(wins) // self.hosts
+        if per_host < 1:
+            raise ValueError(
+                f"dataset yields {len(wins)} round windows but the "
+                f"fleet has {self.hosts} hosts; reduce hosts/batch_size/"
+                "communication_window or provide more data")
+        shards = [wins[h * per_host:(h + 1) * per_host]
+                  for h in range(self.hosts)]
+        return shards, feed_bs * w * H, per_host
+
+    def _fit(self, dataset: Dataset):
+        cfg = self.async_config
+        sched = self.schedule
+        state0 = self.adapter.init_state()
+        state0, state_sh = self._shard_state(state0)
+        batch_sh = self._batch_sharding(
+            leading_window=True, leading_sync=self.exchange.sync_every > 1)
+        step = self._jit_accum_step(state_sh, batch_sh)
+        shards, rows_per_round, per_host = self._host_windows(dataset)
+
+        clock = VirtualClock()
+        plane = AsyncPlane(state0.tv, cfg, clock,
+                           coord_dir=self.coord_dir)
+
+        # Per-host islands.  tv is pulled from the center; opt_state
+        # starts from the shared init (all-zero momenta) — each host's
+        # optimizer state stays host-local for the whole run, the
+        # DOWNPOUR split (center owns params, workers own momenta).
+        opt0, ntv0 = state0.opt_state, state0.ntv
+        states: dict[int, TrainState] = {}
+        pulled: dict[int, list] = {}
+        cursor: dict[int, int] = {}
+        quota: dict[int, int] = {}
+        shard_of: dict[int, int] = {}
+        parked: dict[int, list] = {}
+        dead: set[int] = set()
+        losses: list[float] = []
+        rounds_done: dict[int, int] = {}
+
+        # Discrete events: (time, seq, kind, host).  seq breaks ties
+        # deterministically (insertion order).
+        events: list[tuple] = []
+        seq = 0
+        t_work = 0.0  # last productive completion (makespan — an
+        #               evicted host's dead event never extends it)
+
+        def push_event(t, kind, host):
+            nonlocal seq
+            heapq.heappush(events, (t, seq, kind, host))
+            seq += 1
+
+        def admit(host, shard_idx, n_rounds):
+            tv, _ = plane.join(host)
+            states[host] = TrainState(tv=tv, ntv=copy_tree(ntv0),
+                                      opt_state=copy_tree(opt0),
+                                      step=copy_tree(state0.step))
+            pulled[host] = copy_tree(tv)
+            cursor[host] = 0
+            quota[host] = n_rounds
+            shard_of[host] = shard_idx
+            rounds_done[host] = 0
+
+        def start_round(host):
+            """Schedule the host's next round completion; a stalled
+            round wedges its heartbeat writer for the duration."""
+            rnd = plane.members[host].round + 1
+            if sched.stalled(host, rnd):
+                plane.freeze_beats(host)
+            push_event(clock.now() + sched.duration(host, rnd),
+                       "complete", host)
+
+        def gate(host):
+            """SSP gate: start the next round, park under the barrier,
+            or retire the host (quota done / scheduled leave)."""
+            rnd = plane.members[host].round
+            left = sched.leave_after(host)
+            if cursor[host] >= quota[host] or (left is not None
+                                               and rnd >= left):
+                plane.leave(host)
+                states.pop(host), pulled.pop(host)
+                return
+            ok, lag = plane.may_start(host, rnd + 1)
+            if ok:
+                start_round(host)
+            else:
+                parked[host] = lag
+                push_event(clock.now() + cfg.beat_window, "watchdog",
+                           host)
+
+        def unpark():
+            """Re-gate every parked host whose laggards caught up or
+            left; deterministic order."""
+            for host in sorted(parked):
+                rnd = plane.members[host].round
+                if not [h for h in plane.laggards(rnd + 1) if h != host]:
+                    parked.pop(host)
+                    gate(host)
+
+        for h in range(self.hosts):
+            admit(h, h, self.num_epoch * per_host)
+        for h in sorted(states):
+            start_round(h)
+        joins = list(sched.joins())
+
+        while events:
+            t, _, kind, host = heapq.heappop(events)
+            clock.advance_to(t)
+            while joins and joins[0][0] <= t:
+                _, jh = joins.pop(0)
+                if jh not in states and jh not in dead:
+                    admit(jh, jh % self.hosts, per_host)
+                    start_round(jh)
+            if kind == "watchdog":
+                if host not in parked:
+                    continue
+                for lag in list(parked[host]):
+                    if lag in plane.members and plane.stale(lag):
+                        plane.evict(lag, reason="heartbeat_stale")
+                        dead.add(lag)
+                        states.pop(lag, None), pulled.pop(lag, None)
+                        parked.pop(lag, None)
+                if host in parked and any(
+                        plane.members[l].frozen_at is not None
+                        for l in parked[host] if l in plane.members):
+                    # A laggard's writer is wedged but not yet past the
+                    # window: re-arm the watchdog instead of waiting on
+                    # a completion that may never come.
+                    push_event(t + cfg.beat_window, "watchdog", host)
+                unpark()
+                continue
+            if host not in states or host in dead:
+                continue  # completed after eviction: stale event
+            if host in parked:
+                continue
+            plane.thaw_beats(host)
+            shard = shards[shard_of[host]]
+            xs, ys = shard[cursor[host] % len(shard)]
+            with self.step_timer.phase("h2d"):
+                args = (self._global_batch(xs, batch_sh),
+                        self._global_batch(ys, batch_sh))
+            with self.step_timer.phase("step"):
+                state, loss = step(states[host], *args)
+            delta = delta_of(state.tv, pulled[host])
+            try:
+                plane.push(host, delta)
+            except chaos.FaultInjected:
+                # Host died mid-push: nothing published, delta dropped
+                # cleanly; the island disappears and the fleet rolls on.
+                plane.evict(host, reason="push_fault")
+                dead.add(host)
+                states.pop(host, None), pulled.pop(host, None)
+                obs.count("async.push_faults", 1, host=host)
+                unpark()
+                continue
+            cursor[host] += 1
+            rounds_done[host] = plane.complete(host)
+            t_work = t
+            losses.append(float(loss))
+            tv, _ = plane.pull(host)
+            states[host] = state.replace(tv=tv)
+            pulled[host] = copy_tree(tv)
+            gate(host)
+            unpark()
+
+        plane.flush()  # drain any wave a merge fault deferred
+        self._require_steps(losses, rows_per_round, len(dataset))
+        self._record(losses)
+        self.async_report = {
+            "makespan": t_work,
+            "rounds": dict(sorted(rounds_done.items())),
+            "hard_syncs": plane.hard_syncs,
+            "evicted": list(plane.evicted),
+            "dropped_deltas": plane.dropped_deltas,
+            "pushes": plane.pushes,
+            "merges": plane.merges,
+            "version": plane.version,
+            "wire_bytes": plane.wire_bytes,
+            "epoch": plane.epoch,
+            "members_final": sorted(plane.members),
+        }
+        obs.gauge("async.makespan", t_work)
+        final = TrainState(tv=plane.center, ntv=copy_tree(ntv0),
+                           opt_state=copy_tree(opt0),
+                           step=jax.numpy.asarray(sum(
+                               rounds_done.values(), 0),
+                               jax.numpy.int32))
+        self._checkpoint(final, plane.version, final=True)
+        return final

@@ -947,6 +947,67 @@ def bench_lowcomm_update(iters=10, d_model=512, n_layers=4,
     return 1.0 / walls["int8ef"], walls["int8ef"], 0.0, extras
 
 
+def bench_async_convergence(**opts):
+    """Convergence-vs-ADAG row for the bounded-staleness async tier
+    (docs/async.md): train the same seeded MLP on the same blob rows
+    twice — synchronous ADAG baseline, then ``AsyncDP`` under the
+    given staleness/merge config with a deterministic virtual-time
+    schedule — and report both final losses against the DECLARED
+    tolerance (the bound tests/test_async_tier.py::
+    test_converges_within_tol_of_adag enforces; the row makes the
+    margin visible, the test makes it binding).  The int8 cross-host
+    wire claim lives in the compiled census (asyncdp_wire/* in
+    scripts/comm_budget.json), not here — this row is the convergence
+    half of the async contract."""
+    def run(n_rows=256, epochs=2, tol=0.05):
+        import keras
+        import numpy as np
+
+        import distkeras_tpu as dk
+        from distkeras_tpu.parallel.async_tier import AsyncSchedule
+
+        rng = np.random.default_rng(0)
+        centers = rng.normal(0, 4.0, (4, 16))
+        labels = rng.integers(0, 4, n_rows)
+        feats = (centers[labels]
+                 + rng.normal(0, 0.5, (n_rows, 16))).astype(np.float32)
+        ds = dk.Dataset({"features": feats,
+                         "label": labels.astype(np.int64)})
+
+        def mlp():
+            keras.utils.set_random_seed(0)
+            return keras.Sequential([
+                keras.Input((16,)),
+                keras.layers.Dense(32, activation="relu"),
+                keras.layers.Dense(4)])
+
+        kw = dict(loss="sparse_categorical_crossentropy",
+                  worker_optimizer="sgd", learning_rate=0.05,
+                  batch_size=2, num_epoch=epochs,
+                  communication_window=2, seed=11)
+        base = dk.ADAG(mlp(), **kw)
+        base.train(ds)
+        t = dk.AsyncDP(mlp(), hosts=2, beat_window=1.5,
+                       schedule=AsyncSchedule(seed=3), **kw, **opts)
+        t0 = time.perf_counter()
+        t.train(ds)
+        wall = time.perf_counter() - t0
+        rounds = len(t.history)
+        delta = abs(t.history[-1] - base.history[-1])
+        rep = t.async_report
+        return n_rows * epochs / wall, wall / rounds, 0.0, {
+            **opts,
+            "final_loss": round(t.history[-1], 5),
+            "baseline_loss": round(base.history[-1], 5),
+            "loss_delta": round(delta, 5),
+            "tolerance": tol,
+            "within_tolerance": bool(delta <= tol),
+            "rounds": rounds, "baseline_rounds": len(base.history),
+            "hard_syncs": rep["hard_syncs"],
+            "wire_bytes": rep["wire_bytes"]}
+    return run
+
+
 def bench_lm_e2e(device_data):
     """End-to-end ``LMTrainer.train()`` throughput over real host rows,
     streaming vs ``device_data=True`` — the LM flagship's input-plane
@@ -1043,6 +1104,13 @@ BENCHES = {
         bench_lowcomm_convergence(zero1=True, compress="int8"),
         "tokens/sec/chip"),
     "lowcomm_update": (bench_lowcomm_update, "updates/sec"),
+    "async_tau1": (bench_async_convergence(tau=1, async_merge="sum"),
+                   "samples/sec"),
+    "async_tau4": (bench_async_convergence(tau=4, async_merge="sum"),
+                   "samples/sec"),
+    "async_adasum": (bench_async_convergence(tau=4, async_merge="adasum",
+                                             async_compress="int8"),
+                     "samples/sec"),
 }
 
 

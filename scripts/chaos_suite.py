@@ -45,6 +45,15 @@ request, the dead replica's series drop out of ``/metrics/cluster``
 and return after its restart, the merged timeline shows the re-route
 hop, and every lock report is clean.
 
+Round 16 adds the ASYNC-TIER legs (docs/async.md): ``async_stall``
+wedges a simulated host's heartbeat writer mid-training under the
+bounded-staleness plane and asserts the fleet slows by less than tau
+round-lengths (watchdog eviction, survivors at full quota — never a
+full stall), printing the EpochStore/heartbeat membership audit
+trail; ``async_kill_push`` kills a host at the ``cluster.push`` probe
+and asserts the in-flight delta dropped cleanly with no torn merge
+(pushes == merges == center version).
+
 Usage: python scripts/chaos_suite.py [--seed N] [--kill-rounds 3,7,12]
                                      [--trace chaos.jsonl]
        python scripts/chaos_suite.py --cluster [--scenarios kill,stall]
@@ -716,6 +725,138 @@ def run_cluster_scenario(scenario, seed, workdir, window=2.0,
         os.path.join(tracedir, "*.jsonl"))), samples
 
 
+def run_async_scenarios(scenarios, seed, workdir):
+    """The round-16 async-tier legs of ``--cluster`` (docs/async.md).
+    Like ``serve_kill`` these run in-process — the hosts are simulated
+    islands under a seeded virtual-time clock, so the legs are
+    deterministic and fast while still exercising the real
+    ``AsyncPlane`` membership/merge machinery and the real
+    ``cluster.push``/``cluster.merge`` probe sites:
+
+    * ``async_stall`` — a wedged-heartbeat straggler must slow the
+      fleet by < tau round-lengths (watchdog eviction), never a full
+      stall, with survivors completing their full quotas.
+    * ``async_kill_push`` — a host killed mid-push must leave no torn
+      merge: the in-flight delta is dropped cleanly
+      (pushes == merges == center version) and the fleet drains.
+
+    Returns the number of failed legs."""
+    import json
+    import shutil
+
+    import numpy as np
+
+    from distkeras_tpu.parallel.async_tier import AsyncSchedule
+    from distkeras_tpu.resilience import chaos
+
+    def blob_ds(n=256):
+        import distkeras_tpu as dk
+
+        rng = np.random.default_rng(seed)
+        centers = rng.normal(0, 4.0, (4, 16))
+        labels = rng.integers(0, 4, n)
+        feats = (centers[labels]
+                 + rng.normal(0, 0.5, (n, 16))).astype(np.float32)
+        return dk.Dataset({"features": feats,
+                           "label": labels.astype(np.int64)})
+
+    def trainer(schedule, coord=None, tau=2):
+        import keras
+
+        import distkeras_tpu as dk
+
+        keras.utils.set_random_seed(0)
+        model = keras.Sequential([
+            keras.Input((16,)),
+            keras.layers.Dense(32, activation="relu"),
+            keras.layers.Dense(4)])
+        return dk.AsyncDP(model, hosts=3, tau=tau, schedule=schedule,
+                          beat_window=1.5, coord_dir=coord,
+                          loss="sparse_categorical_crossentropy",
+                          worker_optimizer="sgd", learning_rate=0.05,
+                          batch_size=2, num_epoch=2,
+                          communication_window=2, seed=11)
+
+    def audit_trail(coord):
+        """The on-disk membership evidence the plane left behind:
+        EpochStore generations + per-host heartbeat files."""
+        epochs = sorted(os.listdir(os.path.join(coord, "epochs")))
+        beats = {}
+        for f in sorted(os.listdir(os.path.join(coord, "beats"))):
+            with open(os.path.join(coord, "beats", f)) as fh:
+                beats[f] = json.load(fh)
+        return epochs, beats
+
+    failures = 0
+    if "async_stall" in scenarios:
+        print("== cluster scenario: async_stall (bounded-staleness "
+              "straggler) ==", flush=True)
+        coord = os.path.join(workdir, "async_stall", "coord")
+        shutil.rmtree(coord, ignore_errors=True)
+        os.makedirs(coord)
+        try:
+            tau, ds = 2, blob_ds()
+            t0 = trainer(AsyncSchedule(seed=3), tau=tau)
+            t0.train(ds)
+            t1 = trainer(AsyncSchedule(seed=3).stall(1, 2, 50.0),
+                         coord=coord, tau=tau)
+            t1.train(ds)
+            m0 = t0.async_report["makespan"]
+            m1 = t1.async_report["makespan"]
+            assert m1 - m0 < tau * 1.0, (
+                f"fleet slowed by {m1 - m0:.2f} virtual seconds — more "
+                f"than tau={tau} round-lengths (full-stall behaviour)")
+            assert t1.async_report["evicted"] == [1], (
+                f"watchdog did not evict the wedged host: "
+                f"{t1.async_report['evicted']}")
+            for h in (0, 2):
+                assert (t1.async_report["rounds"][h]
+                        == t0.async_report["rounds"][h]), (
+                    f"survivor {h} lost rounds to the straggler")
+            epochs, beats = audit_trail(coord)
+            assert len(epochs) >= 2, (
+                f"eviction did not bump the membership epoch: {epochs}")
+            print(f"  PASS  cluster/async_stall: 50s wedge cost the "
+                  f"fleet {m1 - m0:.2f} virtual s (< tau={tau} "
+                  f"rounds), host 1 evicted, survivors at full quota")
+            print("--- membership audit trail (async_stall) ---")
+            print(f"  epochs: {epochs}")
+            for f, b in beats.items():
+                print(f"  beat {f}: " + json.dumps(b))
+        except Exception as e:  # noqa: BLE001 — report the ladder
+            failures += 1
+            print(f"  FAIL  cluster/async_stall: "
+                  f"{type(e).__name__}: {e}")
+    if "async_kill_push" in scenarios:
+        print("== cluster scenario: async_kill_push (host loss "
+              "mid-delta-publish) ==", flush=True)
+        try:
+            ds = blob_ds()
+            t = trainer(AsyncSchedule(seed=3))
+            with chaos.FaultPlan(seed=0).fail("cluster.push",
+                                              at=5) as plan:
+                t.train(ds)
+            r = t.async_report
+            assert plan.events == [("cluster.push", 5, "fail")], (
+                f"probe never fired: {plan.events}")
+            assert len(r["evicted"]) == 1, (
+                f"killed host not evicted: {r['evicted']}")
+            assert r["pushes"] == r["merges"] == r["version"], (
+                f"torn merge: pushes={r['pushes']} merges={r['merges']} "
+                f"version={r['version']}")
+            assert r["members_final"] == [], (
+                f"fleet did not drain: {r['members_final']}")
+            print(f"  PASS  cluster/async_kill_push: push 5 died "
+                  f"pre-publish, host {r['evicted'][0]} evicted, "
+                  f"{r['merges']} merges == {r['pushes']} pushes "
+                  f"(no torn merge), fleet drained")
+        except Exception as e:  # noqa: BLE001 — report the ladder
+            failures += 1
+            print(f"  FAIL  cluster/async_kill_push: "
+                  f"{type(e).__name__}: {e}")
+    return failures
+
+
 def run_cluster_ladder(scenarios, seed, workdir):
     """The --cluster entry: reference run + one chaos run per
     training scenario (bit-for-bit weight comparison, merged
@@ -729,6 +870,11 @@ def run_cluster_ladder(scenarios, seed, workdir):
 
     failures = 0
     scenarios = list(scenarios)
+    async_legs = [s for s in scenarios
+                  if s in ("async_stall", "async_kill_push")]
+    if async_legs:
+        scenarios = [s for s in scenarios if s not in async_legs]
+        failures += run_async_scenarios(async_legs, seed, workdir)
     if "serve_kill" in scenarios:
         scenarios.remove("serve_kill")
         failures += run_router_kill_scenario(seed, workdir)
@@ -848,12 +994,16 @@ def main():
     ap.add_argument("--cluster", action="store_true",
                     help="run the multi-host coordinated-restart "
                          "ladder instead of the single-host matrix")
-    ap.add_argument("--scenarios", default="kill,stall,drop,serve_kill",
+    ap.add_argument("--scenarios",
+                    default="kill,stall,drop,serve_kill,"
+                            "async_stall,async_kill_push",
                     help="--cluster fault kinds to run "
                          "(kill = host loss, stall = wedged heartbeat "
                          "writer, drop = partition, serve_kill = "
                          "kill-a-serving-replica-mid-stream under the "
-                         "router)")
+                         "router, async_stall = bounded-staleness "
+                         "straggler in the async tier, async_kill_push "
+                         "= host loss mid-delta-publish)")
     ap.add_argument("--workdir", default=None,
                     help="--cluster scratch dir (default: a temp dir, "
                          "kept on failure)")

@@ -644,6 +644,36 @@ def session_serving_sharded_paged():
 # NOTE: new sessions append at the END — inserting one mid-dict would
 # shift every later session's warm-cache delta budget (module
 # docstring).
+def session_async(hosts: int = 2, batch_size: int = 4, rounds: int = 4,
+                  **opts):
+    """AsyncDP rounds across ``hosts`` simulated hosts: every host
+    shares the ONE compiled intra-host accumulation step, and the
+    plane's encode/merge kernels (int8 EF, adasum tree) compile once
+    each — fleet size must never scale the program count (the
+    ``async_tree`` session's warm-cache delta over ``adag_async``
+    pins exactly that)."""
+    import numpy as np
+
+    import distkeras_tpu as dk
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 8)).astype(np.float32)
+    y = rng.integers(0, 4, 128).astype(np.int32)
+    ds = dk.Dataset({"features": x, "label": y})
+    import keras
+
+    model = keras.Sequential([keras.layers.Input((8,)),
+                              keras.layers.Dense(16, activation="relu"),
+                              keras.layers.Dense(4)])
+    t = dk.AsyncDP(model, loss="sparse_categorical_crossentropy",
+                   worker_optimizer="adam", learning_rate=0.05,
+                   batch_size=batch_size, num_epoch=2,
+                   communication_window=2, hosts=hosts, **opts)
+    t.train(ds)
+    assert len(t.history) == rounds, t.history
+    assert t.async_report["version"] == rounds, t.async_report
+
+
 SESSIONS = {
     "adag": lambda: session_adag(),
     "adag_zero1": lambda: session_adag(zero1=True),
@@ -689,6 +719,16 @@ SESSIONS = {
     # for "one router replica is a whole mesh".
     "serving_sharded": session_serving_sharded,
     "serving_sharded_paged": session_serving_sharded_paged,
+    # Async tier (docs/async.md): 2 hosts on the int8 wire, then a
+    # 4-host adasum aggregation tree — the tree session rides the
+    # 2-host session's cache, so its delta is the marginal cost of
+    # growing the fleet (must be ~zero new programs, or the plane
+    # started recompiling per host).
+    "adag_async": lambda: session_async(
+        hosts=2, tau=2, async_merge="adasum", async_compress="int8"),
+    "async_tree": lambda: session_async(
+        hosts=4, batch_size=2, rounds=8, tau=2, fanout=2,
+        async_merge="adasum", async_compress="int8"),
 }
 
 

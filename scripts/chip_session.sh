@@ -1,51 +1,73 @@
 #!/bin/bash
-# One green-tunnel measurement session, in priority order (round-5
-# plan; round-4 backlog front-loaded — see VERDICT.md round-4 item 1).
-# Run from the repo root the moment the axon tunnel is up; every stage
-# appends JSON lines to chip_session_r5.log so a mid-session tunnel
-# drop loses nothing.
+# One green-tunnel measurement session, in priority order (round-6
+# loop; round-5 set carried forward).  Run from the repo root the
+# moment the axon tunnel is up; every stage appends JSON lines to
+# chip_session_r6.log so a mid-session tunnel drop loses nothing.
+#
+# Hang-proofing (round 6): every stage runs under a hard timeout cap —
+# a wedged backend init or flapping tunnel records a TIMEOUT line and
+# the session moves on, it can never hang the box.  Stages 0-2 form
+# the MINIMUM-EVIDENCE set (~10 min): probe + headline + the
+# pre-registered decision rows, so even a session cut short right
+# after them leaves a decidable round record.  At close the probe/
+# availability record is committed as the round artifact
+# (PROBELOG_r6.txt — VERDICT round-5 item 9).
 set -u
 cd "$(dirname "$0")/.."
-LOG=chip_session_r5.log
+LOG=chip_session_r6.log
 say() { echo "### $(date -u +%H:%M:%S) $*" | tee -a "$LOG"; }
+run() {  # run <minutes> <cmd...> — hard-capped stage; a timeout is a
+         # recorded fact, never a hang
+  local mins=$1; shift
+  timeout -k 30 "$((mins * 60))" "$@" 2>>"$LOG" | tee -a "$LOG"
+  local rc=${PIPESTATUS[0]}
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    say "TIMEOUT (${mins}m cap): $*"
+  fi
+  return "$rc"
+}
 
+say "=== minimum-evidence set (~10 min) ==="
 say "stage 0: probe + headline (writes BENCH_LAST_GREEN.json)"
-python bench.py 2>>"$LOG" | tee -a "$LOG" || exit 1
+run 5 python bench.py || exit 1
 
 say "stage 1: staged round-3 serving configs (TTFT + engine)"
-python scripts/bench_serving.py prefix_cache_ttft engine_throughput \
-    engine_throughput_kvint8 \
-    2>>"$LOG" | tee -a "$LOG"
+run 3 python scripts/bench_serving.py prefix_cache_ttft engine_throughput \
+    engine_throughput_kvint8
 
-say "stage 2: MoE + LoRA serving"
-python scripts/bench_serving.py decode_moe_b8 decode_moe_b64 \
-    decode_moe_top2_b8 lora_merged_serve 2>>"$LOG" | tee -a "$LOG"
+say "stage 2: pre-registered engine_speculative decision row"
+run 3 python scripts/bench_serving.py engine_speculative
+say "=== minimum-evidence set complete; below is extended coverage ==="
 
-say "stage 3: MoE + LoRA training (with the dense baseline row)"
-python scripts/bench_suite.py transformer_d1024 transformer_moe_top1 \
-    transformer_moe_top2 lora_finetune 2>>"$LOG" | tee -a "$LOG"
+say "stage 3: MoE + LoRA serving"
+run 8 python scripts/bench_serving.py decode_moe_b8 decode_moe_b64 \
+    decode_moe_top2_b8 lora_merged_serve
 
-say "stage 4: engine under load (TTFT/TPOT p50/p99 grid)"
-python scripts/bench_serving.py engine_load_8l_low engine_load_8l_mid \
-    engine_load_8l_high engine_load_4l_mid engine_load_16l_mid \
-    2>>"$LOG" | tee -a "$LOG"
+say "stage 4: MoE + LoRA training (with the dense baseline row)"
+run 12 python scripts/bench_suite.py transformer_d1024 transformer_moe_top1 \
+    transformer_moe_top2 lora_finetune
 
-say "stage 5: flagship MFU ablation"
-python scripts/ablate_flagship.py 2>>"$LOG" | tee -a "$LOG"
+say "stage 5: engine under load (TTFT/TPOT p50/p99 grid)"
+run 12 python scripts/bench_serving.py engine_load_8l_low engine_load_8l_mid \
+    engine_load_8l_high engine_load_4l_mid engine_load_16l_mid
 
-say "stage 6: variance protocol (headline set, n=5)"
-python scripts/variance.py -n 5 2>>"$LOG" | tee -a "$LOG"
+say "stage 6: flagship MFU ablation"
+run 15 python scripts/ablate_flagship.py
 
-say "stage 7: windowed beam (ancestry vs physical on chip)"
-python scripts/bench_serving.py beam4 beam4_windowed \
-    beam4_windowed_physical decode_rolling_window \
-    2>>"$LOG" | tee -a "$LOG"
+say "stage 7: variance protocol (headline set, n=5)"
+run 15 python scripts/variance.py -n 5
 
-say "stage 8 (round-5 additions): LM e2e input plane + int8 ring"
-python scripts/bench_suite.py lm_e2e_stream lm_e2e_device_data \
-    2>>"$LOG" | tee -a "$LOG"
-python scripts/bench_serving.py decode_rolling_window_kvint8 \
-    engine_speculative \
-    2>>"$LOG" | tee -a "$LOG"
+say "stage 8: windowed beam (ancestry vs physical on chip)"
+run 8 python scripts/bench_serving.py beam4 beam4_windowed \
+    beam4_windowed_physical decode_rolling_window
+
+say "stage 9: LM e2e input plane + int8 ring + async-tier convergence"
+run 10 python scripts/bench_suite.py lm_e2e_stream lm_e2e_device_data \
+    async_tau1 async_tau4 async_adasum
+run 6 python scripts/bench_serving.py decode_rolling_window_kvint8
+
+say "session close: commit probe/availability record as round artifact"
+grep -E '^### |"status"' "$LOG" > PROBELOG_r6.txt
+git add PROBELOG_r6.txt && git commit -q -m "round 6 chip session: tunnel-availability probe log" -- PROBELOG_r6.txt || say "probe-log commit skipped"
 
 say "session complete — transcribe: python scripts/format_session.py $LOG"

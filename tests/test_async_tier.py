@@ -1,0 +1,369 @@
+"""Bounded-staleness async tier (parallel/async_tier.py +
+trainers/async_dp.py, docs/async.md) on the 8-CPU mesh.
+
+Acceptance contract: seeded virtual-time schedules are replayable —
+two runs of the same schedule, INCLUDING a straggler stall and a
+mid-training join, produce bit-identical final params; the SSP gate
+parks fast hosts only for slow-but-alive laggards and the watchdog
+evicts wedged-heartbeat ones, so a stall degrades the fleet by less
+than τ rounds instead of stalling it; a host killed mid-push
+(``cluster.push`` fault) publishes nothing — its delta drops cleanly
+and the merge is atomic under ``cluster.merge`` faults; and AsyncDP
+converges within TOL_LOSS of the synchronous ADAG baseline on the
+same seeded blobs.  The s8 wire-dtype claim is proved from the
+compiled census in tests/test_budget_guards.py (asyncdp_wire target).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.parallel.async_tier import (AsyncConfig, AsyncPlane,
+                                                AsyncSchedule, VirtualClock,
+                                                tree_reduce)
+from distkeras_tpu.resilience import chaos
+
+from helpers import make_blobs, make_mlp
+
+TOL_LOSS = 0.05  # same declared bound as tests/test_exchange.py
+
+
+def _tree(*leaves):
+    return [jnp.asarray(l, jnp.float32) for l in leaves]
+
+
+def _plane(tau=2, merge="adasum", compress=None, fanout=2, window=3.0,
+           coord_dir=None, t0=0.0):
+    clock = VirtualClock(t0)
+    cfg = AsyncConfig(tau=tau, merge_rule=merge, compress=compress,
+                      fanout=fanout, beat_window=window)
+    center = _tree([0.0, 0.0, 0.0, 0.0], [[0.0, 0.0], [0.0, 0.0]])
+    return AsyncPlane(center, cfg, clock, coord_dir=coord_dir), clock
+
+
+# --------------------------------------------------------- primitives
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="tau"):
+        AsyncConfig(tau=0)
+    with pytest.raises(ValueError, match="merge_rule"):
+        AsyncConfig(merge_rule="median")
+    with pytest.raises(ValueError, match="compress"):
+        AsyncConfig(compress="fp8")
+    with pytest.raises(ValueError, match="fanout"):
+        AsyncConfig(fanout=1)
+    with pytest.raises(ValueError, match="beat_window"):
+        AsyncConfig(beat_window=0.0)
+
+
+def test_virtual_clock_monotone():
+    c = VirtualClock()
+    c.advance_to(2.5)
+    assert c.now() == c() == 2.5
+    with pytest.raises(ValueError, match="backwards"):
+        c.advance_to(1.0)
+
+
+def test_schedule_deterministic_and_stallable():
+    a, b = AsyncSchedule(seed=5), AsyncSchedule(seed=5)
+    durs = [(h, r) for h in range(3) for r in range(1, 5)]
+    assert [a.duration(h, r) for h, r in durs] == \
+           [b.duration(h, r) for h, r in durs]
+    assert a.duration(0, 1) != AsyncSchedule(seed=6).duration(0, 1)
+    base = a.duration(1, 2)
+    a.stall(1, 2, 10.0)
+    assert a.duration(1, 2) == pytest.approx(base + 10.0)
+    assert a.stalled(1, 2) and not a.stalled(1, 3)
+
+
+def test_tree_reduce_adasum_and_sum():
+    x = [_tree([1.0, 2.0]), _tree([1.0, 2.0])]
+    # Agreeing deltas: adasum == the value (mean of parallel inputs).
+    out = tree_reduce([t for t in x], 2, "adasum")
+    np.testing.assert_allclose(np.asarray(out[0]), [1.0, 2.0],
+                               rtol=1e-6)
+    # Orthogonal deltas: the plain sum, under both rules.
+    y = [_tree([1.0, 0.0]), _tree([0.0, 2.0])]
+    for rule in ("adasum", "sum"):
+        out = tree_reduce([t for t in y], 2, rule)
+        np.testing.assert_allclose(np.asarray(out[0]), [1.0, 2.0],
+                                   rtol=1e-6)
+    # Fanout-ary tree over 5 hosts reduces to one delta.
+    five = [_tree([float(i), 0.0]) for i in range(5)]
+    out = tree_reduce(five, 2, "sum")
+    np.testing.assert_allclose(np.asarray(out[0]), [10.0, 0.0],
+                               rtol=1e-6)
+
+
+# ---------------------------------------------------------- the plane
+
+
+def test_push_merge_updates_center():
+    plane, _ = _plane(merge="sum")
+    plane.join(0), plane.join(1)
+    plane.push(0, _tree([1.0, 0.0, 0.0, 0.0], [[1.0, 0.0], [0.0, 0.0]]))
+    plane.push(1, _tree([0.0, 2.0, 0.0, 0.0], [[0.0, 0.0], [0.0, 2.0]]))
+    assert plane.version == 2 and plane.merges == 2
+    np.testing.assert_allclose(np.asarray(plane.center[0]),
+                               [1.0, 2.0, 0.0, 0.0], rtol=1e-6)
+    tv, version = plane.pull(0)
+    assert version == 2
+    # pull returns a copy, never an alias of the center.
+    assert tv[0] is not plane.center[0]
+
+
+def test_int8_wire_error_feedback_converges():
+    plane, _ = _plane(merge="sum", compress="int8")
+    plane.join(0)
+    target = _tree([0.3, -1.7, 0.01, 4.0], [[0.5, 0.0], [0.0, 0.0]])
+    # Repeatedly pushing the same delta: the EF residual carries each
+    # push's quantization error into the next, so the center tracks
+    # n * delta far better than n independent lossy pushes would.
+    for _ in range(8):
+        plane.push(0, target)
+    np.testing.assert_allclose(np.asarray(plane.center[0]),
+                               8 * np.asarray(target[0]),
+                               rtol=0.02, atol=0.02)
+    assert plane.wire_bytes > 0
+
+
+def test_staleness_gate_and_hard_sync():
+    plane, _ = _plane(tau=2)
+    plane.join(0), plane.join(1)
+    for _ in range(3):
+        plane.complete(0)
+    ok, lag = plane.may_start(0, 4)       # host 1 at round 0: lag 4 > 2
+    assert not ok and lag == [1]
+    assert plane.hard_syncs == 1
+    ok, lag = plane.may_start(1, 1)       # the laggard itself may run
+    assert ok and lag == []
+
+
+def test_watchdog_evicts_stale_heartbeat_only():
+    plane, clock = _plane(tau=1, window=2.0)
+    plane.join(0), plane.join(1)
+    # Healthy-but-slow member: never stale, no matter the clock.
+    clock.advance_to(10.0)
+    assert not plane.stale(1)
+    plane.freeze_beats(1)
+    clock.advance_to(11.0)
+    assert not plane.stale(1)            # frozen, but inside window
+    clock.advance_to(13.0)
+    assert plane.stale(1)                # past the window: evictable
+    plane.evict(1, reason="heartbeat_stale")
+    assert plane.evicted == [1] and 1 not in plane.members
+
+
+def test_evict_drops_in_flight_deltas():
+    plane, _ = _plane(merge="sum")
+    plane.join(0), plane.join(1)
+    with chaos.FaultPlan(seed=0).fail("cluster.merge", at=1):
+        plane.push(1, _tree([9.0, 9.0, 9.0, 9.0],
+                            [[9.0, 9.0], [9.0, 9.0]]))
+    assert plane.pending and plane.version == 0
+    plane.evict(1, reason="heartbeat_stale")
+    assert plane.dropped_deltas == 1 and not plane.pending
+    # The dropped delta never reaches the center.
+    plane.push(0, _tree([1.0, 0.0, 0.0, 0.0], [[0.0] * 2] * 2))
+    np.testing.assert_allclose(np.asarray(plane.center[0]),
+                               [1.0, 0.0, 0.0, 0.0], rtol=1e-6)
+
+
+def test_graceful_leave_refcounts_final_delta():
+    plane, _ = _plane(merge="sum")
+    plane.join(0), plane.join(1)
+    plane.leave(1, final_delta=_tree([0.0, 0.0, 0.0, 5.0],
+                                     [[0.0] * 2] * 2))
+    assert 1 not in plane.members and plane.version == 1
+    np.testing.assert_allclose(np.asarray(plane.center[0]),
+                               [0.0, 0.0, 0.0, 5.0], rtol=1e-6)
+
+
+def test_join_bootstraps_at_fleet_round():
+    plane, _ = _plane()
+    plane.join(0)
+    for _ in range(5):
+        plane.complete(0)
+    tv, version = plane.join(7)
+    assert plane.members[7].round == 5     # cannot trip the bound
+    assert version == plane.version
+
+
+def test_push_fault_is_pre_publish():
+    plane, _ = _plane(merge="sum")
+    plane.join(0)
+    with chaos.FaultPlan(seed=0).fail("cluster.push", at=1):
+        with pytest.raises(chaos.FaultInjected):
+            plane.push(0, _tree([1.0] * 4, [[1.0] * 2] * 2))
+    # Nothing enqueued, nothing counted, center untouched.
+    assert plane.pushes == 0 and not plane.pending
+    assert plane.version == 0
+    np.testing.assert_allclose(np.asarray(plane.center[0]), [0.0] * 4)
+
+
+def test_merge_fault_is_atomic_and_retries():
+    plane, _ = _plane(merge="sum")
+    plane.join(0), plane.join(1)
+    with chaos.FaultPlan(seed=0).fail("cluster.merge", at=1):
+        plane.push(0, _tree([1.0, 0.0, 0.0, 0.0], [[0.0] * 2] * 2))
+    assert plane.version == 0 and len(plane.pending) == 1
+    # The next push merges BOTH deltas in one wave — nothing torn,
+    # nothing lost.
+    plane.push(1, _tree([0.0, 1.0, 0.0, 0.0], [[0.0] * 2] * 2))
+    assert plane.version == 1 and not plane.pending
+    np.testing.assert_allclose(np.asarray(plane.center[0]),
+                               [1.0, 1.0, 0.0, 0.0], rtol=1e-6)
+
+
+def test_membership_rides_cluster_substrate(tmp_path):
+    from distkeras_tpu.resilience.cluster import EpochStore
+    from distkeras_tpu.resilience.health import read_beat
+
+    d = str(tmp_path / "coord")
+    plane, clock = _plane(coord_dir=d)
+    plane.join(0)
+    plane.complete(0)
+    clock.advance_to(1.0)
+    plane.join(1)
+    # Membership transitions are EpochStore generations ...
+    assert EpochStore(d).current() == plane.epoch == 2
+    # ... and heartbeats are real beat files stamped with VIRTUAL time.
+    beat = read_beat(str(tmp_path / "coord" / "beats"), 1)
+    assert beat is not None and beat["t"] == 1.0
+    plane.leave(0)
+    assert EpochStore(d).current() == 3
+    done = read_beat(str(tmp_path / "coord" / "beats"), 0)
+    assert done["done"] is True
+
+
+# ----------------------------------------------------------- AsyncDP
+
+
+def _blob_ds(n=256, seed=0):
+    feats, labels = make_blobs(n=n, seed=seed)
+    return dk.Dataset({"features": feats, "label": labels})
+
+
+def _async_trainer(schedule=None, hosts=2, tau=2, **kw):
+    opts = dict(loss="sparse_categorical_crossentropy",
+                worker_optimizer="sgd", learning_rate=0.05,
+                batch_size=2, num_epoch=2, communication_window=2,
+                seed=11)
+    opts.update(kw)
+    return dk.AsyncDP(make_mlp(), hosts=hosts, tau=tau,
+                      schedule=schedule, beat_window=1.5, **opts)
+
+
+def _weights(trainer, ds):
+    return [np.asarray(w) for w in trainer.train(ds).get_weights()]
+
+
+def test_construction_rejects():
+    with pytest.raises(ValueError, match="hosts"):
+        dk.AsyncDP(make_mlp(), hosts=0)
+    with pytest.raises(ValueError, match="device_data"):
+        dk.AsyncDP(make_mlp(), device_data=True)
+    with pytest.raises(ValueError, match="merge_rule"):
+        dk.AsyncDP(make_mlp(), async_merge="median")
+    import keras
+
+    bn = keras.Sequential([keras.Input((16,)),
+                           keras.layers.Dense(8),
+                           keras.layers.BatchNormalization(),
+                           keras.layers.Dense(4)])
+    with pytest.raises(ValueError, match="non-trainable"):
+        dk.AsyncDP(bn)
+
+
+def test_determinism_with_straggler_and_join():
+    """The flagship harness: the SAME seeded virtual-time schedule —
+    heterogeneous durations, one host wedged for 50 virtual seconds
+    (watchdog-evicted), one host joining mid-training — replayed
+    twice, is bit-identical down to every weight."""
+    ds = _blob_ds()
+
+    def sched():
+        return (AsyncSchedule(seed=3).stall(1, 2, 50.0)
+                .join(5, at_time=2.0))
+
+    t1, t2 = _async_trainer(sched(), hosts=3), _async_trainer(
+        sched(), hosts=3)
+    w1, w2 = _weights(t1, ds), _weights(t2, ds)
+    assert all(np.array_equal(a, b) for a, b in zip(w1, w2))
+    assert t1.async_report == t2.async_report
+    r = t1.async_report
+    assert r["evicted"] == [1]            # the wedged straggler left
+    assert 5 in r["rounds"]               # the joiner did real rounds
+    assert r["hard_syncs"] >= 1           # the barrier fired en route
+
+
+def test_straggler_degrades_fleet_less_than_tau():
+    """A heartbeat-stalled host slows the fleet by < τ round-lengths
+    (detection window + re-gate), never a full stall: the 50-virtual-
+    second wedge must NOT show up in the makespan."""
+    ds = _blob_ds()
+    tau = 2
+    t0 = _async_trainer(AsyncSchedule(seed=3), hosts=3, tau=tau)
+    _weights(t0, ds)
+    t1 = _async_trainer(AsyncSchedule(seed=3).stall(1, 2, 50.0),
+                        hosts=3, tau=tau)
+    _weights(t1, ds)
+    m0 = t0.async_report["makespan"]
+    m1 = t1.async_report["makespan"]
+    assert t1.async_report["evicted"] == [1]
+    assert m1 - m0 < tau * 1.0            # base round length is 1.0
+    # Survivors completed their full quotas.
+    for h in (0, 2):
+        assert t1.async_report["rounds"][h] == t0.async_report["rounds"][h]
+
+
+def test_host_kill_mid_push_drops_delta_cleanly():
+    ds = _blob_ds()
+    t = _async_trainer(AsyncSchedule(seed=3), hosts=3)
+    with chaos.FaultPlan(seed=0).fail("cluster.push", at=5) as plan:
+        _weights(t, ds)
+    r = t.async_report
+    assert plan.events == [("cluster.push", 5, "fail")]
+    assert len(r["evicted"]) == 1         # the killed host left ...
+    assert r["pushes"] == r["merges"] == r["version"]  # ... torn-free
+    assert r["members_final"] == []       # survivors drained cleanly
+
+
+def test_merge_fault_mid_training_retries():
+    ds = _blob_ds()
+    t = _async_trainer(AsyncSchedule(seed=3), hosts=2)
+    with chaos.FaultPlan(seed=0).fail("cluster.merge", at=3):
+        _weights(t, ds)
+    r = t.async_report
+    assert r["evicted"] == []
+    # One wave deferred and folded into the next push's merge.
+    assert r["merges"] == r["pushes"] - 1
+
+
+def test_converges_within_tol_of_adag():
+    """The robustness tier costs < TOL_LOSS of final loss vs the
+    synchronous baseline — same model, same seeded blobs, same total
+    data."""
+    ds = _blob_ds()
+    kw = dict(loss="sparse_categorical_crossentropy",
+              worker_optimizer="sgd", learning_rate=0.05, batch_size=2,
+              num_epoch=2, communication_window=2, seed=11)
+    base = dk.ADAG(make_mlp(), **kw)
+    base.train(ds)
+    for merge in ("adasum", "sum"):
+        t = _async_trainer(AsyncSchedule(seed=3), hosts=2,
+                           async_merge=merge)
+        _weights(t, ds)
+        assert abs(t.history[-1] - base.history[-1]) < TOL_LOSS, (
+            merge, t.history[-1], base.history[-1])
+
+
+def test_traced_for_analysis_has_wire_leg():
+    t = _async_trainer(AsyncSchedule(seed=3), hosts=2,
+                       async_compress="int8")
+    specs = t.traced_for_analysis(_blob_ds())
+    names = [s.name for s in specs]
+    assert any(n.startswith("asyncdp_dp/") for n in names)
+    assert "asyncdp_wire/adasum_int8" in names
