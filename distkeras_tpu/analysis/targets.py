@@ -114,7 +114,10 @@ def serving_targets() -> list[TraceSpec]:
     decode/draft-verify steps AND the admission chunk programs (the
     round-10 engine builds — chunked-prefill continuations and
     prefix-pool gathers share the admission program shape, so the
-    pooled ContinuousBatcher variant below covers the gather path)."""
+    pooled ContinuousBatcher variant below covers the gather path).
+    The paged engine's targets include the round-17 disaggregated
+    block-transfer pair (export read + import splice — the
+    prefill/decode hop's device programs)."""
     import jax
 
     import distkeras_tpu as dk

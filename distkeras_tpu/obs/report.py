@@ -183,10 +183,12 @@ def request_waterfall(records: list[dict], request_id: int) -> dict:
         stages.append(tag({"t": e["t"] - t0, "name": "serving.emit",
                            "n": e["fields"].get("n"),
                            "first": e["fields"].get("first")}, e))
-    # Router hops: the routing decision(s) and any re-route render as
-    # first-class stages (round 13).
+    # Router hops: the routing decision(s), any re-route, and the
+    # disaggregated block-transfer hop render as first-class stages
+    # (rounds 13 and 17).
     hops = [e for e in mine_events
             if e["name"] in ("router.route", "router.reroute",
+                             "router.block_transfer",
                              "router.finish")]
     for e in hops:
         stages.append({"t": e["t"] - t0, "name": e["name"],

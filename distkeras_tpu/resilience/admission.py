@@ -44,6 +44,13 @@ class RequestResult:
     by shutdown before completing), or ``"error"`` (a queued request
     failed engine-specific admission validation when its lane freed;
     ``error`` carries the message).
+
+    The live-transcript snapshot (``partial()``, the round-17
+    streaming read) reuses this record with two NON-terminal
+    statuses: ``"queued"`` (``tokens`` is just the prompt) and
+    ``"decoding"`` (``tokens`` is the prompt plus everything emitted
+    so far) — same prompt-inclusive transcript shape, so cursor
+    arithmetic never branches on terminality.
     """
 
     request_id: int

@@ -35,6 +35,11 @@ did against the old ``serving.py``.  Layout:
   :class:`EngineEndpoint` HTTP admission server.
 - :mod:`~distkeras_tpu.serving.residency` — the jax-free chain-hash
   digest language the paged engine and the router share.
+- :mod:`~distkeras_tpu.serving.disagg` — :class:`BlockShipment` and
+  the jax-free block-transfer wire codec for disaggregated
+  prefill/decode fleets (round 17): a prefill replica exports a
+  prompt's KV blocks, the router ships them, a decode replica adopts
+  them by page-table splice.
 
 The reference has no serving story at all (its ModelPredictor runs the
 training forward over a static batch — reference:
@@ -51,6 +56,9 @@ by tests/test_serving.py and tests/test_speculative.py.
 
 from distkeras_tpu.serving.admission import (EngineClosed, QueueFull,
                                              RequestResult)
+from distkeras_tpu.serving.disagg import (BlockShipment,
+                                          decode_shipment,
+                                          encode_shipment)
 from distkeras_tpu.serving.lanes import (KV_INT8_LANE_ADVISORY,
                                          ContinuousBatcher)
 from distkeras_tpu.serving.paged import BlockAllocator, PagedBatcher
@@ -74,6 +82,9 @@ __all__ = [
     "EngineEndpoint",
     "ReplicaUnreachable",
     "discover_replicas",
+    "BlockShipment",
+    "encode_shipment",
+    "decode_shipment",
     "RequestResult",
     "QueueFull",
     "EngineClosed",

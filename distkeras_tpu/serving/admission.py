@@ -436,6 +436,41 @@ class _AdmissionMixin:
         has not finished."""
         return self._completed.pop(request_id)
 
+    def partial(self, request_id: int):
+        """Live transcript snapshot — the streaming read (round 17).
+
+        A terminal request returns its completed
+        :class:`RequestResult` (exactly what :meth:`poll` returns); a
+        request still decoding returns a ``RequestResult`` with
+        status ``"decoding"`` and the transcript SO FAR (prompt +
+        every token emitted to date — the same prompt-inclusive shape
+        terminal transcripts carry, so a caller's cursor arithmetic
+        never branches); a request still queued returns ``"queued"``
+        with just the prompt.  ``None`` for unknown ids.  Taken under
+        the admission lock so the snapshot never tears against a
+        concurrent step's emit — the one rule the streaming relay
+        (``/stream``, :meth:`Router.stream`) leans on.
+        """
+        with self._admission_lock:
+            res = self._completed.get(request_id)
+            if res is not None:
+                return res
+            for st in self._lane_state:
+                if st is not None and st.request_id == request_id:
+                    return RequestResult(
+                        request_id=request_id,
+                        tokens=np.asarray(st.tokens, np.int32),
+                        status="decoding", prompt_len=st.prompt_len,
+                        error=None)
+            for pend in self._pending:
+                if pend.request_id == request_id:
+                    return RequestResult(
+                        request_id=request_id,
+                        tokens=np.asarray(pend.prompt, np.int32),
+                        status="queued", prompt_len=pend.prompt.size,
+                        error=None)
+            return None
+
     def results(self) -> dict:
         """Pop every completed result: ``{request_id: RequestResult}``."""
         out = self._completed

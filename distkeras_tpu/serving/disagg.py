@@ -1,0 +1,171 @@
+"""Disaggregated prefill/decode: the block-shipping wire format.
+
+Round 17 splits the fleet by phase: a prefill-specialized replica
+builds a prompt's KV as paged BLOCKS on the round-12 slab and SHIPS
+them to a decode-specialized replica, which adopts them by page-table
+splice (:meth:`~distkeras_tpu.serving.paged.PagedBatcher.import_blocks`
+pins the run through the ordinary :class:`PinnedStems` refcount path).
+The content-hashed block run is already the transferable unit — a
+shipped block carries the same chain digest the residency telemetry
+advertises, so the decode side hash-hits blocks it already holds and
+the router skips transfers for warm stems entirely.
+
+This module is the WIRE half and is deliberately jax-free (the router
+imports it, and the router runs on hosts that never import jax —
+source lint ``jax-free`` rule): a :class:`BlockShipment` is plain
+numpy + metadata, and :func:`encode_shipment` / :func:`decode_shipment`
+are the stdlib byte codec the ``/blocks`` and ``/prefill`` endpoint
+routes speak.
+
+Wire format (version 1)::
+
+    [4-byte LE header length][JSON header][raw leaf payload]
+
+The JSON header carries the block size, the chain digests (hex — the
+same spelling ``/residency`` serves), and one (dtype, shape) spec per
+slab leaf; the payload is the blocks' leaf buffers concatenated
+blocks-major, leaves-minor, in ``jax.tree.leaves`` order of the
+exporter's slab.  Both ends run the same model config, so leaf order
+and avals agree by construction — the importer still validates every
+buffer against ITS slab before any device write.  int8 (``kv_int8``)
+blocks ride as-is: quantized values and their scale leaves are just
+more leaves, never dequantized in transit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import struct
+
+import numpy as np
+
+_MAGIC = "dkt-blocks"
+_VERSION = 1
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """dtype by NAME (``.str`` spells bfloat16 as raw ``V2``, losing
+    its identity).  Extension dtypes resolve once ml_dtypes has
+    registered them — import it lazily so plain-float shipments stay
+    dependency-free."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registers bfloat16/float8 names with numpy
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockShipment:
+    """A host-side run of exported KV blocks, ready to ship.
+
+    ``block``: positions per block (must match the importer's slab).
+    ``hashes``: the chain digest of each block — position-dependent
+    content identity, in stem order (block k's digest covers tokens
+    ``[0, (k+1)*block)``).  ``blocks[k]`` is block k's slab content:
+    one numpy array per slab leaf (``jax.tree.leaves`` order), each
+    shaped like the leaf with the block axis sliced to 1.
+    """
+
+    block: int
+    hashes: tuple
+    blocks: tuple
+
+    def __post_init__(self):
+        if len(self.hashes) != len(self.blocks):
+            raise ValueError(
+                f"shipment carries {len(self.hashes)} digests but "
+                f"{len(self.blocks)} block payloads")
+
+    def __len__(self) -> int:
+        return len(self.blocks)
+
+    @property
+    def span(self) -> int:
+        """Token positions the shipment covers (always full blocks)."""
+        return len(self.blocks) * self.block
+
+    @property
+    def nbytes(self) -> int:
+        """Payload bytes (the transfer-budget number the obs counters
+        report — header overhead excluded on purpose: it is O(leaves),
+        not O(tokens))."""
+        return sum(a.nbytes for leaves in self.blocks for a in leaves)
+
+    def hexes(self) -> list:
+        """Digests in the JSON-safe hex spelling the router's affinity
+        table stores."""
+        return [h.hex() for h in self.hashes]
+
+
+def encode_shipment(shipment: BlockShipment) -> bytes:
+    """Serialize a shipment for the ``/blocks`` POST body."""
+    if not shipment.blocks:
+        raise ValueError("refusing to encode an empty shipment")
+    leaves0 = shipment.blocks[0]
+    header = {
+        "magic": _MAGIC,
+        "version": _VERSION,
+        "block": int(shipment.block),
+        "hashes": shipment.hexes(),
+        "leaves": [{"dtype": a.dtype.name, "shape": list(a.shape)}
+                   for a in leaves0],
+    }
+    payload = []
+    for leaves in shipment.blocks:
+        if len(leaves) != len(leaves0):
+            raise ValueError("ragged shipment: blocks disagree on "
+                             "leaf count")
+        for a, spec in zip(leaves, leaves0):
+            if a.shape != spec.shape or a.dtype != spec.dtype:
+                raise ValueError("ragged shipment: blocks disagree "
+                                 "on leaf avals")
+            payload.append(np.ascontiguousarray(a).tobytes())
+    hb = json.dumps(header).encode()
+    return struct.pack("<I", len(hb)) + hb + b"".join(payload)
+
+
+def decode_shipment(data: bytes) -> BlockShipment:
+    """Parse :func:`encode_shipment` output back into a
+    :class:`BlockShipment`.  Raises ``ValueError`` on anything
+    malformed — truncation, bad magic, payload/spec size mismatch —
+    so a torn transfer can never half-adopt."""
+    if len(data) < 4:
+        raise ValueError("shipment truncated before header length")
+    (hlen,) = struct.unpack_from("<I", data)
+    if len(data) < 4 + hlen:
+        raise ValueError("shipment truncated inside header")
+    try:
+        header = json.loads(data[4:4 + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"shipment header is not JSON: {e}") from e
+    if header.get("magic") != _MAGIC:
+        raise ValueError("not a block shipment (bad magic)")
+    if header.get("version") != _VERSION:
+        raise ValueError(
+            f"unsupported shipment version {header.get('version')!r}")
+    specs = [(_np_dtype(s["dtype"]), tuple(s["shape"]))
+             for s in header["leaves"]]
+    hashes = tuple(bytes.fromhex(h) for h in header["hashes"])
+    per_block = sum(dt.itemsize * int(np.prod(shape, dtype=np.int64))
+                    for dt, shape in specs)
+    off = 4 + hlen
+    if len(data) - off != per_block * len(hashes):
+        raise ValueError(
+            f"shipment payload is {len(data) - off} bytes; header "
+            f"promises {per_block * len(hashes)}")
+    blocks = []
+    for _ in hashes:
+        leaves = []
+        for dt, shape in specs:
+            n = dt.itemsize * int(np.prod(shape, dtype=np.int64))
+            leaves.append(np.frombuffer(data[off:off + n], dtype=dt)
+                          .reshape(shape))
+            off += n
+        blocks.append(tuple(leaves))
+    return BlockShipment(block=int(header["block"]), hashes=hashes,
+                         blocks=tuple(blocks))
+
+
+__all__ = ["BlockShipment", "encode_shipment", "decode_shipment"]

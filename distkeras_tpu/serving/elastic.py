@@ -117,6 +117,34 @@ class _ElasticLanesMixin:
     """ContinuousBatcher's device half of elasticity: per-tier dummy
     states, the construction-time warmup, and the resize gather."""
 
+    def _make_resize(self):
+        """Build the jitted inter-tier resize program.  The default
+        gathers lanes ``idx[j] -> j`` across the WHOLE device state —
+        cache (lane axis 1) plus row metadata (axis 0); jit
+        specializes one program per (from, to) tier pair, all warmed
+        by :meth:`_compile_tiers`.  Sharded engines re-pin the
+        gathered cache with the plan's KV constraint so the output
+        placement matches the live slab exactly (placement is part of
+        the jit cache key — a drifting layout would surface as a
+        serve-phase recompile, which the elastic compile sessions
+        assert never happens).  The paged engine overrides this with a
+        rows-only gather: its slab is lane-independent."""
+        constrain = self._kv_constraint
+
+        def resize(cache, cur, pos, keys, temps, tps, mps, idx):
+            cache = jax.tree.map(
+                lambda a: jnp.take(a, idx, axis=1), cache)
+            if constrain is not None:
+                cache = constrain(cache)
+            g = lambda a: jnp.take(a, idx, axis=0)
+            return (cache, g(cur), g(pos), g(keys), g(temps),
+                    g(tps), g(mps))
+
+        # No donation: the gathered output has a different lane
+        # count, so nothing could be reused in place anyway (and
+        # XLA would warn on every tier pair).
+        return jax.jit(resize)
+
     def _tier_state(self, tier: int):
         """A dummy device state at ``tier`` lanes with EXACTLY the live
         state's avals — the warmup vehicle that populates the jit
@@ -213,10 +241,16 @@ class _ElasticLanesMixin:
                 self._warm_tier(tier)
             for a, b in zip(self.lane_tiers, self.lane_tiers[1:]):
                 for frm, to in ((a, b), (b, a)):
-                    cache, cur, pos, keys, temps, tps, mps = \
-                        self._tier_state(frm)
-                    self._resize(cache, cur, pos, keys, temps, tps, mps,
-                                 jnp.zeros((to,), jnp.int32))
+                    self._warm_resize(frm, to)
+
+    def _warm_resize(self, frm: int, to: int) -> None:
+        """Trace+compile the ``frm -> to`` resize gather against dummy
+        state (one jit specialization per tier pair).  Split out of
+        :meth:`_compile_tiers` so the paged engine can warm its
+        rows-only variant with the same loop."""
+        cache, cur, pos, keys, temps, tps, mps = self._tier_state(frm)
+        self._resize(cache, cur, pos, keys, temps, tps, mps,
+                     jnp.zeros((to,), jnp.int32))
 
     def _resize_state(self, idx) -> None:
         (self.cache, self.cur, self.pos, self.keys, self.temps,

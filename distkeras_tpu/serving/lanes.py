@@ -153,9 +153,11 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
     serve phase never compiles.  Emitted tokens are bit-exact vs the
     solo engine, greedy and sampled; per-device param+KV bytes drop
     ~axis-size× (see :meth:`memory_footprint`).  Composes with paged
-    KV, chunked prefill, and mesh-matched prefix pools; rejects
-    ``lane_tiers``/``prompt_cache``/rolling configs (the composition
-    table lives in docs/serving_guide.md "Pod-sharded serving").
+    KV, chunked prefill, mesh-matched prefix pools, and (round 17)
+    ``lane_tiers`` — every tier and resize gather compiles at
+    construction under the plan's constraints; rejects
+    ``prompt_cache``/rolling configs (the composition table lives in
+    docs/serving_guide.md "Pod-sharded serving").
     """
 
     def __init__(self, params, cfg: TransformerConfig, lanes: int = 8,
@@ -198,13 +200,11 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                     "pod-sharded serving needs a full-cache config "
                     "(no attention_window): the ring slab's rolling "
                     "scatter has no stable sharded layout to pin")
-            if lane_tiers is not None:
-                raise ValueError(
-                    "plan= does not compose with lane_tiers= yet: a "
-                    "tier resize would recompile every tier's sharded "
-                    "programs — raise lanes= instead (the sharded "
-                    "slab already decouples per-device bytes from "
-                    "lane count)")
+            # lane_tiers composes (round 17): every tier's programs —
+            # and the inter-tier resize gathers — compile at
+            # construction under the same sharding constraints, so a
+            # tier move on a sharded engine is still zero serve-phase
+            # compiles (the serving_disagg compile session pins it).
             if prompt_cache is not None:
                 raise ValueError(
                     "plan= does not compose with prompt_cache= (one "
@@ -465,20 +465,7 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
         self._build_admission_programs()
 
         if self.lane_tiers is not None:
-            def resize(cache, cur, pos, keys, temps, tps, mps, idx):
-                # Gather lanes idx[j] -> j across the WHOLE device
-                # state; jit specializes one program per (from, to)
-                # tier pair, all warmed below.
-                cache = jax.tree.map(
-                    lambda a: jnp.take(a, idx, axis=1), cache)
-                g = lambda a: jnp.take(a, idx, axis=0)
-                return (cache, g(cur), g(pos), g(keys), g(temps),
-                        g(tps), g(mps))
-
-            # No donation: the gathered output has a different lane
-            # count, so nothing could be reused in place anyway (and
-            # XLA would warn on every tier pair).
-            self._resize = jax.jit(resize)
+            self._resize = self._make_resize()
             self._compile_tiers()
         elif (prefill_chunk is not None or self._prefix_pool is not None
                 or self._always_warm):

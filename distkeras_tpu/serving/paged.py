@@ -78,7 +78,9 @@ from distkeras_tpu.models.transformer import TransformerConfig
 from distkeras_tpu.serving.engine import _Lane
 from distkeras_tpu.serving.lanes import ContinuousBatcher
 from distkeras_tpu.serving.prefix import PinnedStems
+from distkeras_tpu.serving.disagg import BlockShipment
 from distkeras_tpu.serving.residency import chain_hash as _chain_hash
+from distkeras_tpu.serving.residency import stem_hashes as _stem_hashes
 from distkeras_tpu.utils.locks import TracedRLock
 
 # Physical block 0 is never handed out: unallocated page-table entries
@@ -274,10 +276,18 @@ class PagedBatcher(ContinuousBatcher):
       sharded ContinuousBatcher (docs/serving_guide.md "Pod-sharded
       serving").
 
+    - ``lane_tiers=`` (round 17): elastic paging — the slab and the
+      block allocator are lane-count-independent, so a tier move is a
+      rows-only gather plus a host-side page-table remap: zero KV
+      bytes move and zero serve-phase compiles (every tier's programs
+      and the inter-tier row gathers warm at construction, sharded
+      engines included).  ``n_blocks`` defaults to covering the TOP
+      tier.  :meth:`fork` is rejected (lane ids are not stable across
+      a resize).
+
     Not supported (structurally): ``attention_window`` (ring slots
     have no stable block identity), ``prompt_cache=`` / ``prefix_pool=``
-    (subsumed by pinned stems), ``lane_tiers`` (the slab already
-    decouples memory from lane count — raise ``lanes`` instead).
+    (subsumed by pinned stems).
 
     Every program — the step windows, one admission program per
     bucket, the CoW block copy and row fork — compiles at
@@ -293,7 +303,9 @@ class PagedBatcher(ContinuousBatcher):
                  min_p=None, eos_token=None, exact_top_k: bool = False,
                  prompt_buckets=(8, 32, 128, 512), kv_int8=False,
                  per_request_sampling: bool = False,
-                 max_queue: int = 0, clock=None, step_windows=(1,),
+                 max_queue: int = 0, clock=None,
+                 lane_tiers=None, scale_up_after: int = 2,
+                 scale_down_after: int = 8, step_windows=(1,),
                  prefill_chunk: int | None = None, plan=None,
                  mesh=None):
         if cfg.attention_window is not None:
@@ -327,11 +339,18 @@ class PagedBatcher(ContinuousBatcher):
         if n_blocks is None:
             # Monolithic-equivalent default: every lane can hold
             # max_len tokens.  The paged WIN comes from shrinking it.
-            n_blocks = lanes * self._mb + 1
+            # Elastic engines size for the TOP tier — the slab never
+            # resizes (rows and tables do), so the default must cover
+            # the widest lane count a scale-up can reach.
+            cap = max(int(t) for t in lane_tiers) if lane_tiers \
+                else lanes
+            n_blocks = cap * self._mb + 1
         self.n_blocks = int(n_blocks)
         self._alloc = BlockAllocator(self.n_blocks, block)
-        self._lane_blocks: list[list[int]] = [[] for _ in range(lanes)]
-        # Admission bookkeeping keyed by lane: the warm frontier the
+        # Per-lane block lists are built in _init_device_state (sized
+        # to the STARTING lane count — elastic engines start at the
+        # smallest tier and remap them on every resize).  Admission
+        # bookkeeping keyed by lane: the warm frontier the
         # pad-redirect uses, and hashes awaiting their block's content
         # to be dispatched before they may be shared.
         self._lane_limit: dict[int, int] = {}
@@ -350,6 +369,9 @@ class PagedBatcher(ContinuousBatcher):
                          kv_int8=bool(kv_int8),
                          per_request_sampling=per_request_sampling,
                          max_queue=max_queue, clock=clock,
+                         lane_tiers=lane_tiers,
+                         scale_up_after=scale_up_after,
+                         scale_down_after=scale_down_after,
                          step_windows=step_windows,
                          prefill_chunk=prefill_chunk, plan=plan,
                          mesh=mesh)
@@ -373,6 +395,7 @@ class PagedBatcher(ContinuousBatcher):
 
     def _init_device_state(self, lanes: int) -> None:
         super()._init_device_state(lanes)
+        self._lane_blocks: list[list[int]] = [[] for _ in range(lanes)]
         self._tables_np = np.zeros((lanes, self._mb), np.int32)
         self.tables = self._put_host(self._tables_np.copy())
 
@@ -382,6 +405,58 @@ class PagedBatcher(ContinuousBatcher):
         # sharded engines).  An explicit copy: device_put may
         # alias host memory on CPU, and the host copy keeps mutating.
         self.tables = self._put_host(self._tables_np.copy())
+
+    # ------------------------------------------------- elastic tiers
+
+    def _make_resize(self):
+        # Rows-only: the slab is lane-count-independent (that
+        # decoupling IS the feature), so a tier move gathers just the
+        # per-lane row metadata — no KV byte moves, and the page
+        # tables remap host-side in _resize_state.
+        def resize(cur, pos, keys, temps, tps, mps, idx):
+            g = lambda a: jnp.take(a, idx, axis=0)
+            return (g(cur), g(pos), g(keys), g(temps), g(tps), g(mps))
+
+        return jax.jit(resize)
+
+    def _warm_resize(self, frm: int, to: int) -> None:
+        # The post-resize table push reuses _warm_steps' per-tier
+        # [tier, _mb] device_put — nothing extra to warm here.
+        _, cur, pos, keys, temps, tps, mps = self._tier_state(frm)
+        self._resize(cur, pos, keys, temps, tps, mps,
+                     jnp.zeros((to,), jnp.int32))
+
+    def _resize_state(self, idx) -> None:
+        idx = np.asarray(idx, np.int32)
+        tier = int(idx.shape[0])
+        (self.cur, self.pos, self.keys, self.temps, self.tps,
+         self.mps) = self._resize(self.cur, self.pos, self.keys,
+                                  self.temps, self.tps, self.mps, idx)
+        # Host bookkeeping follows the same compaction _resize_to is
+        # about to apply to _lane_state: occupied lanes move to the
+        # low slots in index order; fresh lanes arrive with empty
+        # block lists and all-TRASH page tables (their stale rows are
+        # masked until admission overwrites them, the lane-reuse
+        # contract).  Block refcounts are untouched — lanes keep their
+        # blocks, only the lane ids naming them change.
+        keep = [i for i, s in enumerate(self._lane_state)
+                if s is not None]
+        blocks: list[list[int]] = [[] for _ in range(tier)]
+        tables = np.full((tier, self._mb), TRASH_BLOCK, np.int32)
+        limits: dict[int, int] = {}
+        pending: dict[int, list] = {}
+        for j, i in enumerate(keep):
+            blocks[j] = self._lane_blocks[i]
+            tables[j] = self._tables_np[i]
+            if i in self._lane_limit:
+                limits[j] = self._lane_limit[i]
+            if i in self._pending_hashes:
+                pending[j] = self._pending_hashes[i]
+        self._lane_blocks = blocks
+        self._tables_np = tables
+        self._lane_limit = limits
+        self._pending_hashes = pending
+        self._push_tables()
 
     # ---------------------------------------------- compiled programs
 
@@ -503,6 +578,27 @@ class PagedBatcher(ContinuousBatcher):
             return constrain(out) if constrain is not None else out
         self._copy_block = jax.jit(copy_block, donate_argnums=0)
 
+        def extract_block(slab, src):
+            # Disagg export (round 17): read ONE block off the slab —
+            # all layers, scale leaves included.  No donation: the
+            # slab keeps serving.
+            return jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, src, 1,
+                                                       axis=1),
+                slab)
+        self._extract_block = jax.jit(extract_block)
+
+        def adopt_block(slab, blk, dst):
+            # Disagg import: splice a shipped block's content into the
+            # slab at ``dst`` — the write half of _copy_block with the
+            # source coming off the wire instead of the slab.
+            out = jax.tree.map(
+                lambda a, b: jax.lax.dynamic_update_slice_in_dim(
+                    a, b.astype(a.dtype), dst, axis=1),
+                slab, blk)
+            return constrain(out) if constrain is not None else out
+        self._adopt_block = jax.jit(adopt_block, donate_argnums=0)
+
         def fork_rows(cur, pos, keys, temps, tps, mps, src, dst,
                       token):
             g = lambda x: x.at[dst].set(x[src])
@@ -542,6 +638,14 @@ class PagedBatcher(ContinuousBatcher):
         # CoW programs (block copy + row fork, keyed variant too).
         self._copy_block(self._fresh_cache(tier), jnp.int32(0),
                          jnp.int32(0))
+        # Disagg block-transfer programs (export read + import
+        # splice): warm with a template block placed exactly like a
+        # live import places wire payloads, so adoption never
+        # compiles (the ``serving_disagg`` session pins it).
+        self._extract_block(self._fresh_cache(tier), jnp.int32(0))
+        self._adopt_block(self._fresh_cache(tier),
+                          self._place_kv(self._block_template()),
+                          jnp.int32(0))
         cache, cur, pos, keys, temps, tps, mps = self._tier_state(tier)
         z = jnp.int32(0)
         self._fork_rows(cur, pos, keys, temps, tps, mps, z, z, z)
@@ -765,9 +869,16 @@ class PagedBatcher(ContinuousBatcher):
         and positions would replay its draws).
 
         The forked lane is a bare-submit-style occupant: poll it with
-        ``running()`` and collect with ``drain()``.  Elastic-tier
-        engines don't exist in paged form, so lane ids are stable.
+        ``running()`` and collect with ``drain()``.  Rejected on
+        elastic (``lane_tiers=``) engines: a tier resize compacts
+        lane ids, so the id this returns could silently dangle.
         """
+        if self.lane_tiers is not None:
+            raise ValueError(
+                "fork() is not available on elastic (lane_tiers=) "
+                "paged engines: a tier resize compacts lane ids, so "
+                "the lane id fork returns could silently dangle — "
+                "use a fixed lanes= engine to fork")
         with self._admission_lock:
             self._check_open()
             st = self._lane_state[lane]
@@ -843,6 +954,141 @@ class PagedBatcher(ContinuousBatcher):
                       copied_blocks=len(new_blocks) - len(shared))
             self._obs_blocks()
             return dst
+
+    # ------------------------------------- disaggregated block transfer
+
+    def _block_template(self):
+        """Zero tree shaped like ONE slab block (``[L, 1, block, ...]``
+        per leaf) — the adopt program's wire-side operand aval."""
+        slab_cfg = dataclasses.replace(self.cfg, max_len=self.block)
+        return init_cache(slab_cfg, 1, kv_int8=self.kv_int8)
+
+    def export_blocks(self, tokens) -> BlockShipment:
+        """Prefill ``tokens``' full blocks and read them off the slab
+        into a host-side :class:`BlockShipment` — the prefill half of
+        disaggregated serving (round 17).
+
+        Staging goes through :meth:`pin_prefix` (the ONE share+alloc
+        path): resident stems are reused, only the cold remainder
+        prefills.  The pin is released before returning — the
+        shipment owns host copies, and the blocks stay hash-resident
+        locally until the free list recycles them, so back-to-back
+        exports of a common stem prefill once.  Raises ``ValueError``
+        for spans below one block and ``RuntimeError`` when the
+        allocator cannot hold the run (the router's fallback
+        signals).
+        """
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        pid = self.pin_prefix(tokens)
+        try:
+            with self._admission_lock:
+                blocks = self._stems.blocks_of(pid)
+                span = self._stems.length_of(pid)
+                hashes = _stem_hashes(tokens[:span], self.block)
+                runs = []
+                for bid in blocks:
+                    blk = self._extract_block(self.cache,
+                                              jnp.int32(bid))
+                    runs.append(tuple(np.asarray(a) for a in
+                                      jax.tree.leaves(blk)))
+        finally:
+            self.unpin_prefix(pid)
+        ship = BlockShipment(block=self.block, hashes=tuple(hashes),
+                             blocks=tuple(runs))
+        obs.count("serving.disagg.blocks_out", len(ship))
+        obs.count("serving.disagg.bytes_out", ship.nbytes)
+        obs.event("serving.block_export", blocks=len(ship),
+                  bytes=ship.nbytes, span=span)
+        return ship
+
+    def import_blocks(self, shipment: BlockShipment) -> dict | None:
+        """Adopt a shipped block run by page-table splice and PIN it
+        (refcount held through :class:`PinnedStems`, exactly like
+        :meth:`pin_prefix`) — the decode half of disaggregated
+        serving.
+
+        Blocks whose chain digest is already resident are refcounted
+        in place — zero device writes for warm stems (the
+        adoption-hit counter the router's transfer-skip leans on);
+        cold blocks are allocated, spliced in by the pre-compiled
+        adopt program, and hash-registered so later prompts (and
+        re-imports) hit them.
+
+        Returns ``{"prefix_id", "blocks", "hits", "bytes"}`` — the
+        caller owns the pin and MUST :meth:`unpin_prefix` it when the
+        consuming request goes terminal — or ``None`` when the
+        allocator cannot hold the run (backpressure, never an
+        exception: the router falls back to routing the raw prompt).
+        Any failure mid-adopt hands back every reference this import
+        took — a torn transfer leaks nothing (the chaos contract).
+        """
+        with self._admission_lock:
+            self._check_open()
+            if shipment.block != self.block:
+                raise ValueError(
+                    f"shipment carries {shipment.block}-token blocks; "
+                    f"this slab is paged at {self.block}")
+            if not len(shipment):
+                raise ValueError("refusing to adopt an empty shipment")
+            if shipment.span > self.cfg.max_len - 2:
+                raise ValueError(
+                    f"shipment spans {shipment.span} tokens; pinned "
+                    f"runs must leave room for a tail token and one "
+                    f"generated token under max_len={self.cfg.max_len}")
+            slab_leaves = jax.tree.leaves(self.cache)
+            treedef = jax.tree.structure(self.cache)
+            taken: list[int] = []
+            hits = 0
+            try:
+                for h, leaves in zip(shipment.hashes,
+                                     shipment.blocks):
+                    bid = self._alloc.share_by_hash(h)
+                    if bid is not None:
+                        # Content already resident: refcount, no
+                        # device write.
+                        taken.append(bid)
+                        hits += 1
+                        continue
+                    if len(leaves) != len(slab_leaves):
+                        raise ValueError(
+                            f"shipment blocks carry {len(leaves)} "
+                            f"leaves; this slab has "
+                            f"{len(slab_leaves)}")
+                    for a, s in zip(leaves, slab_leaves):
+                        want = (s.shape[0], 1) + tuple(s.shape[2:])
+                        if (tuple(a.shape) != want
+                                or a.dtype != s.dtype):
+                            raise ValueError(
+                                f"shipment leaf {a.shape}/{a.dtype} "
+                                f"does not match slab block "
+                                f"{want}/{s.dtype} (model config or "
+                                "kv_int8 mode mismatch)")
+                    bid = self._alloc.alloc()
+                    if bid is None:
+                        for b in taken:
+                            self._alloc.free(b)
+                        obs.count("serving.disagg.import_declines")
+                        return None
+                    taken.append(bid)
+                    blk = self._place_kv(
+                        jax.tree.unflatten(treedef, list(leaves)))
+                    self.cache = self._adopt_block(self.cache, blk,
+                                                   jnp.int32(bid))
+                    self._alloc.register(bid, h)
+                pid = self._stems.add(taken, shipment.span)
+            except Exception:
+                for b in taken:
+                    self._alloc.free(b)
+                raise
+            obs.count("serving.disagg.blocks_in", len(taken))
+            obs.count("serving.disagg.adopt_hits", hits)
+            obs.count("serving.disagg.bytes_in", shipment.nbytes)
+            obs.event("serving.block_import", prefix_id=pid,
+                      blocks=len(taken), hits=hits,
+                      bytes=shipment.nbytes)
+            self._obs_blocks()
+            return {"prefix_id": pid, "blocks": len(taken),
+                    "hits": hits, "bytes": shipment.nbytes}
 
     # ------------------------------------------------ pinned prefixes
 
@@ -966,8 +1212,12 @@ class PagedBatcher(ContinuousBatcher):
 
     def traced_for_analysis(self):
         """Trace targets for the IR lint: the paged decode step (page-
-        table gather + the shared window body + slab scatter) and the
-        paged admission program at the smallest bucket."""
+        table gather + the shared window body + slab scatter), the
+        paged admission program at the smallest bucket, and the
+        round-17 disaggregated block-transfer pair — the export read
+        (one block off the slab, no donation: the slab keeps serving)
+        and the import splice (the decode-side adoption write, shaped
+        exactly like a wire payload placement)."""
         from distkeras_tpu.analysis.ir_lint import TraceSpec
 
         if 1 not in self._steps:
@@ -989,6 +1239,17 @@ class PagedBatcher(ContinuousBatcher):
                 name=f"pagedbatcher_{mode}/admit_b{self._buckets[0]}",
                 fn=self._admit,
                 args=(self.cache, row, rows, jnp.int32(0),
+                      jnp.int32(0)),
+                donate_argnums=(0,)),
+            TraceSpec(
+                name=f"pagedbatcher_{mode}/disagg_extract",
+                fn=self._extract_block,
+                args=(self.cache, jnp.int32(0))),
+            TraceSpec(
+                name=f"pagedbatcher_{mode}/disagg_adopt",
+                fn=self._adopt_block,
+                args=(self.cache,
+                      self._place_kv(self._block_template()),
                       jnp.int32(0)),
                 donate_argnums=(0,)),
         ]

@@ -41,6 +41,26 @@ pillars:
   ``scripts/obs_report.py --request`` stitches the full cross-process
   waterfall — routing decision, re-route hop, and the engine-side
   admit/emit/finish stages — from the merged traces.
+- **Disaggregated prefill/decode** (round 17).  Replica handles
+  carry a ``role=`` label: a ``"prefill"``-specialized replica takes
+  no decode routes; instead, a long-prompt request becomes a 2-stage
+  hop — the router asks a prefill replica to build the warm prompt's
+  KV blocks (``export_blocks`` / ``POST /prefill``), ships them to
+  the chosen decode replica (``import_blocks`` / ``POST /blocks`` —
+  the :mod:`~distkeras_tpu.serving.disagg` wire format), and admits
+  the request there, where the admission hash-hits the adopted pinned
+  run (zero re-prefill).  Residency digests gate the transfer: a
+  decode replica already holding the stems skips the hop entirely.
+  The shipped pin is released when the request goes terminal (the
+  refcount story chaos leans on); ANY prefill-hop failure — death
+  mid-transfer, allocator backpressure, geometry mismatch — falls
+  back to plain routing, never a caller-visible error.
+- **Token streaming.**  :meth:`Router.stream` relays the serving
+  replica's live transcript (``partial()`` / ``GET /stream``)
+  incrementally — first token long before the terminal result, the
+  thing that makes a 2-stage request usable — and is reroute-safe
+  because decode is deterministic: a rerouted request's regenerated
+  transcript extends the already-streamed prefix bit-exactly.
 
 Guaranteed jax-free (source lint ``jax-free`` ledger): routing is
 host bookkeeping and HTTP; a router process never compiles a program
@@ -68,6 +88,8 @@ import numpy as np
 from distkeras_tpu import obs
 from distkeras_tpu.resilience.admission import (EngineClosed, QueueFull,
                                                  RequestResult)
+from distkeras_tpu.serving.disagg import (BlockShipment, decode_shipment,
+                                          encode_shipment)
 from distkeras_tpu.serving.residency import stem_hexes
 from distkeras_tpu.utils.locks import TracedRLock
 
@@ -83,6 +105,18 @@ class ReplicaUnreachable(RuntimeError):
     """A remote replica stopped answering (connection refused/reset or
     timeout) — the router treats it as a death signal, not an error
     surfaced to callers."""
+
+
+def _check_role(role):
+    """Replica role labels (round 17): ``None`` = generalist (serves
+    everything), ``"decode"`` = decode-specialized (a generalist to
+    the routing rules, named for topology clarity), ``"prefill"`` =
+    prefill-specialized (takes NO decode routes; serves the
+    block-build half of disaggregated requests only)."""
+    if role is not None and role not in ("prefill", "decode"):
+        raise ValueError(
+            f'role must be None, "prefill", or "decode", got {role!r}')
+    return role
 
 
 # ----------------------------------------------------------- replicas
@@ -113,9 +147,10 @@ class InProcessReplica:
     remote = False
 
     def __init__(self, name: str, engine, health=None,
-                 rid_base: int | None = None):
+                 rid_base: int | None = None, role: str | None = None):
         self.name = str(name)
         self.engine = engine
+        self.role = _check_role(role)
         self._health = health
         self._failed = None
         if rid_base is not None:
@@ -135,8 +170,29 @@ class InProcessReplica:
     def poll(self, request_id: int):
         return self.engine.poll(request_id)
 
+    def partial(self, request_id: int):
+        """Live transcript snapshot (the engines' ``partial()``) — the
+        streaming relay's read."""
+        return self.engine.partial(request_id)
+
     def step(self) -> None:
         self.engine.step()
+
+    # ------------------------------------------------- block transfer
+
+    def prefill_blocks(self, prompt) -> BlockShipment:
+        """Build + export ``prompt``'s full-block KV run (paged
+        engines only — the prefill half of a disaggregated hop)."""
+        return self.engine.export_blocks(prompt)
+
+    def import_blocks(self, shipment: BlockShipment):
+        """Adopt a shipped run; the engine's
+        ``{"prefix_id", ...}`` dict, or None under allocator
+        backpressure."""
+        return self.engine.import_blocks(shipment)
+
+    def unpin(self, prefix_id: int) -> None:
+        self.engine.unpin_prefix(prefix_id)
 
     # ------------------------------------------------- routing signals
 
@@ -210,10 +266,17 @@ class HttpReplica:
 
     remote = True
 
-    def __init__(self, name: str, addr: str, timeout: float = 2.0):
+    def __init__(self, name: str, addr: str, timeout: float = 2.0,
+                 role: str | None = None,
+                 transfer_timeout: float = 30.0):
         self.name = str(name)
         self.addr = addr
         self.timeout = timeout
+        self.role = _check_role(role)
+        # Block transfers move O(prompt) cache bytes and the prefill
+        # hop runs real compute — give them their own, longer budget
+        # than the control-plane timeout.
+        self.transfer_timeout = transfer_timeout
         self._cached: dict = {}
 
     def _url(self, path: str) -> str:
@@ -271,8 +334,76 @@ class HttpReplica:
             status=rec["status"], prompt_len=int(rec["prompt_len"]),
             error=rec.get("error"))
 
+    def partial(self, request_id: int):
+        """Live transcript snapshot off ``GET /stream`` — terminal
+        results included (same doc shape as ``/poll``), None for
+        unknown ids."""
+        code, body = self._get(f"/stream?id={int(request_id)}")
+        if code == 404:
+            return None
+        if code != 200:
+            raise ReplicaUnreachable(
+                f"replica {self.name} at {self.addr}: stream returned "
+                f"HTTP {code}: {body[:200]!r}")
+        rec = json.loads(body)
+        return RequestResult(
+            request_id=int(rec["request_id"]),
+            tokens=np.asarray(rec["tokens"], np.int32),
+            status=rec["status"], prompt_len=int(rec["prompt_len"]),
+            error=rec.get("error"))
+
     def step(self) -> None:
         """No-op: a remote replica's endpoint steps its own engine."""
+
+    # ------------------------------------------------- block transfer
+
+    def _post(self, path: str, data: bytes, content_type: str,
+              timeout: float) -> bytes:
+        req = urllib.request.Request(
+            self._url(path), data=data,
+            headers={"Content-Type": content_type}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode("utf-8", "replace")
+            if e.code == 429:
+                raise QueueFull(detail) from e
+            if e.code == 503:
+                raise EngineClosed(detail) from e
+            raise ValueError(detail) from e
+        except (QueueFull, EngineClosed):
+            raise
+        except Exception as e:  # noqa: BLE001 — refused/reset/timeout
+            raise ReplicaUnreachable(
+                f"replica {self.name} at {self.addr}: {e}") from e
+
+    def prefill_blocks(self, prompt) -> BlockShipment:
+        """``POST /prefill``: build + export the prompt's full-block
+        KV run on the remote replica; returns the decoded shipment.
+        429 -> QueueFull (allocator backpressure), connection death ->
+        ReplicaUnreachable — both fall back to plain routing."""
+        body = {"prompt": np.asarray(prompt, np.int32).tolist()}
+        data = self._post("/prefill", json.dumps(body).encode(),
+                          "application/json", self.transfer_timeout)
+        return decode_shipment(data)
+
+    def import_blocks(self, shipment: BlockShipment):
+        """``POST /blocks``: ship the run to the remote replica for
+        adoption.  Mirrors the engine contract: the import dict on
+        success, None under allocator backpressure (HTTP 429)."""
+        try:
+            body = self._post("/blocks", encode_shipment(shipment),
+                              "application/octet-stream",
+                              self.transfer_timeout)
+        except QueueFull:
+            return None
+        return json.loads(body)
+
+    def unpin(self, prefix_id: int) -> None:
+        self._post("/unpin",
+                   json.dumps({"prefix_id": int(prefix_id)}).encode(),
+                   "application/json", self.timeout)
 
     def healthy(self) -> bool:
         try:
@@ -311,7 +442,8 @@ def discover_replicas(coord_dir: str, timeout: float = 2.0
             with open(os.path.join(d, name), encoding="utf-8") as f:
                 rec = json.load(f)
             out.append(HttpReplica(f"host{int(rec['host'])}",
-                                   rec["addr"], timeout=timeout))
+                                   rec["addr"], timeout=timeout,
+                                   role=rec.get("role")))
         except (OSError, ValueError, KeyError):
             continue  # torn publish mid-replace: skip this pass
     return out
@@ -343,6 +475,10 @@ class _Routed:
     replica_rid: int | None = None
     epoch: int = 0
     hops: int = 0
+    # Disagg import pin held on the decode side: (replica_name,
+    # prefix_id), released (queued to the pump's unpin drain) when the
+    # request goes terminal or its holder dies — the refcount story.
+    pin: tuple | None = None
     # Warm-prompt stem digests per block size, computed lazily (one
     # request may be scored against replicas with different blocks).
     stems: dict = dataclasses.field(default_factory=dict)
@@ -400,6 +536,9 @@ class Router:
         self._requests: dict[int, _Routed] = {}
         self._completed: dict[int, RequestResult] = {}
         self._pending: list[int] = []   # accepted but currently unrouted
+        # Import pins awaiting release (network I/O — drained OUTSIDE
+        # the router lock at the end of each pump round).
+        self._unpins: list[tuple] = []
         self._next_id = 0
         # Router-assigned in-process bases start HIGH so they can
         # never collide with EngineEndpoint's host-id-derived bases
@@ -560,15 +699,29 @@ class Router:
                                     prompt.size)
                 return rid
             self._requests[rid] = req
-            try:
-                self._route_locked(req)
-            except BaseException:
-                # Not accepted (QueueFull everywhere / no live
-                # replica / validation): the id must not linger as an
-                # accepted request for shutdown to "cancel".
-                self._requests.pop(rid, None)
-                raise
-            return rid
+            plan = self._disagg_plan_locked(req)
+        try:
+            # The 2-stage hop (prefill + block transfer) is network/
+            # compute I/O and runs OUTSIDE the router lock; any
+            # failure inside it falls back to plain routing.
+            routed = (plan is not None
+                      and self._disagg_enqueue(req, plan))
+            if not routed:
+                with self._lock:
+                    self._route_locked(req)
+        except BaseException:
+            # Not accepted (QueueFull everywhere / no live
+            # replica / validation): the id must not linger as an
+            # accepted request for shutdown to "cancel" — and an
+            # import pin taken for it must be handed back.
+            with self._lock:
+                dropped = self._requests.pop(rid, None)
+                if dropped is not None and dropped.pin is not None:
+                    self._unpins.append(dropped.pin)
+                    dropped.pin = None
+            self._drain_unpins()
+            raise
+        return rid
 
     # submit() is enqueue() here on purpose: a fleet has no stable
     # lane ids to hand back, so the id-keyed surface IS the surface
@@ -612,8 +765,11 @@ class Router:
 
     def _candidates_locked(self, req: _Routed, exclude):
         now = self._clock()
+        # Prefill-specialized replicas take no decode routes: they
+        # serve the block-build half of disaggregated requests only.
         cands = [m for n, m in self._members.items()
-                 if m.up and not m.draining and n not in exclude]
+                 if m.up and not m.draining and n not in exclude
+                 and getattr(m.replica, "role", None) != "prefill"]
         if req.prefix_id is not None:
             have = [m for m in cands
                     if req.prefix_id in self._affinity.get(
@@ -645,12 +801,18 @@ class Router:
         return score
 
     def _route_locked(self, req: _Routed, exclude=frozenset(),
-                      rerouting: bool = False) -> bool:
+                      rerouting: bool = False,
+                      prefer: str | None = None) -> bool:
         """Pick a replica and admit ``req`` on it.  Returns True on
         acceptance; parks the request in the router backlog (False)
         when every candidate is saturated AND the request was already
         accepted (a reroute must never surface QueueFull to a caller
-        who holds an id); raises QueueFull for a fresh enqueue."""
+        who holds an id); raises QueueFull for a fresh enqueue.
+        ``prefer`` front-runs one replica in the candidate order (the
+        disagg hop's decode target, which now holds the shipped
+        blocks) without bypassing spillover."""
+        if req.request_id in self._completed:
+            return True  # finished while its enqueue ran unlocked
         try:
             cands, now = self._candidates_locked(req, exclude)
         except ValueError:
@@ -684,6 +846,10 @@ class Router:
                 scored, key=lambda t: (-t[1], t[2],
                                        self._load_key(t[0]),
                                        t[0].replica.name))
+        if prefer is not None:
+            # Stable re-sort: the preferred replica front-runs, the
+            # rest keep their relative order (spillover path intact).
+            order.sort(key=lambda t: t[0].replica.name != prefer)
         saw_full = False
         for i, (m, score, _deg) in enumerate(order):
             name = m.replica.name
@@ -750,6 +916,12 @@ class Router:
                     in self._completed:
                 continue
             req.hops += 1
+            if req.pin is not None:
+                # The new replica re-prefills from scratch; the old
+                # pin buys nothing there — queue its release (a dead
+                # holder's pin is simply dropped by the drain).
+                self._unpins.append(req.pin)
+                req.pin = None
             obs.count("router.reroutes")
             obs.event("router.reroute", request_id=req.request_id,
                       src=name, why=why, hop=req.hops)
@@ -757,6 +929,174 @@ class Router:
         m = self._members.get(name)
         if m is not None:
             m.inflight = 0
+
+    # ------------------------------------------- disaggregated 2-stage
+
+    def _disagg_plan_locked(self, req: _Routed) -> str | None:
+        """Decide whether ``req`` takes the 2-stage prefill/decode hop;
+        returns the chosen prefill replica's name, or None for plain
+        routing.  Plain routing wins when: no up prefill replica; the
+        request rides a prefix-pool pin (warm by construction); the
+        warm prompt spans less than one full block (nothing to ship);
+        or some decode candidate's affinity table already covers every
+        stem — the residency gate: shipping blocks the fleet already
+        holds is pure waste, route to the warm replica instead."""
+        if req.prefix_id is not None:
+            return None
+        pre = [(n, m) for n, m in self._members.items()
+               if m.up and not m.draining
+               and getattr(m.replica, "role", None) == "prefill"]
+        if not pre:
+            return None
+        # Prefill + decode replicas run the same slab geometry; read
+        # the block size off any affinity entry that advertises one.
+        block = None
+        for tab in self._affinity.values():
+            if tab.get("block"):
+                block = tab["block"]
+                break
+        if block is None:
+            return None
+        stems = req.stems_at(block)
+        if not stems:
+            return None  # warm prompt under one block
+        for n, m in self._members.items():
+            if not m.up or m.draining \
+                    or getattr(m.replica, "role", None) == "prefill":
+                continue
+            resident = self._affinity.get(n, {}).get("stem_hashes", ())
+            if all(h in resident for h in stems):
+                obs.count("router.disagg_warm_skips")
+                return None
+        name, _m = min(pre, key=lambda t: (self._load_key(t[1]), t[0]))
+        return name
+
+    def _disagg_enqueue(self, req: _Routed, prefill_name: str) -> bool:
+        """The 2-stage hop: build ``req``'s KV blocks on the prefill
+        replica, ship them to the best decode candidate, and admit the
+        request there — where admission hash-hits the adopted pinned
+        run (zero re-prefill).  Runs OUTSIDE the router lock (the hop
+        is prefill compute plus block-transfer network I/O).  Returns
+        True once the request is admitted; returns False on ANY hop
+        failure — prefill death mid-transfer, allocator backpressure,
+        geometry mismatch — so ``enqueue`` falls back to plain
+        routing, never a caller-visible error."""
+        rid = req.request_id
+        with self._lock:
+            m = self._members.get(prefill_name)
+            if m is None or not m.up or m.draining:
+                return False
+            prefill = m.replica
+        try:
+            with obs.span("router.prefill", request_id=rid,
+                          replica=prefill_name):
+                ship = prefill.prefill_blocks(req.prompt)
+        except Exception as e:  # noqa: BLE001 — any failure: fall back
+            obs.count("router.disagg_fallbacks", stage="prefill")
+            obs.event("router.disagg_fallback", request_id=rid,
+                      stage="prefill", replica=prefill_name,
+                      error=f"{type(e).__name__}: {e}")
+            return False
+        # Pick the decode target exactly the way _route_locked would
+        # (affinity first, degraded demoted, least-loaded tiebreak) so
+        # the blocks ship to where admission will land.
+        with self._lock:
+            try:
+                cands, now = self._candidates_locked(req, frozenset())
+            except ValueError:
+                return False
+            if not cands:
+                return False
+            scored = [(m2,
+                       self._affinity_score(req, m2.replica.name)
+                       if self.policy == "affinity" else 0,
+                       1 if m2.degraded_until > now else 0)
+                      for m2 in cands]
+            order = sorted(scored,
+                           key=lambda t: (-t[1], t[2],
+                                          self._load_key(t[0]),
+                                          t[0].replica.name))
+            target = order[0][0].replica
+            tname = target.name
+            resident = set(self._affinity.get(tname, {})
+                           .get("stem_hashes", ()))
+        hexes = ship.hexes()
+        imported = None
+        if not all(h in resident for h in hexes):
+            try:
+                with obs.span("router.transfer", request_id=rid,
+                              src=prefill_name, dst=tname):
+                    imported = target.import_blocks(ship)
+            except Exception as e:  # noqa: BLE001 — fall back
+                obs.count("router.disagg_fallbacks", stage="transfer")
+                obs.event("router.disagg_fallback", request_id=rid,
+                          stage="transfer", replica=tname,
+                          error=f"{type(e).__name__}: {e}")
+                return False
+            if imported is None:  # allocator backpressure on target
+                obs.count("router.disagg_fallbacks", stage="adopt")
+                obs.event("router.disagg_fallback", request_id=rid,
+                          stage="adopt", replica=tname,
+                          error="no free block on decode target")
+                return False
+            obs.count("router.transfer_bytes", float(ship.nbytes))
+            obs.event("router.block_transfer", request_id=rid,
+                      src=prefill_name, dst=tname,
+                      bytes=int(ship.nbytes), blocks=len(ship),
+                      hits=int(imported.get("hits", 0)))
+        else:
+            # The target grew the stems while the prefill ran (another
+            # request's optimistic insert): skip the transfer.
+            obs.count("router.disagg_warm_skips")
+        with self._lock:
+            if rid not in self._requests:
+                # Finished/cancelled while the hop ran (shutdown or
+                # deadline race): nothing left to route, but an
+                # imported pin must still be handed back.
+                if imported is not None:
+                    self._unpins.append(
+                        (tname, int(imported["prefix_id"])))
+                return True
+            if imported is not None:
+                req.pin = (tname, int(imported["prefix_id"]))
+                # Ground truth, not optimism: the shipment IS resident
+                # on the target now — score it so admission routes
+                # there as an affinity hit.
+                tab = self._affinity.setdefault(
+                    tname, {"stem_hashes": set(), "prefix_ids": set(),
+                            "block": None})
+                if not tab.get("block"):
+                    tab["block"] = ship.block
+                tab["stem_hashes"].update(hexes)
+            self._route_locked(req, prefer=tname)
+            if req.pin is not None and req.replica != tname:
+                # Spilled past the warm target (its queue filled
+                # during the hop): the pin buys nothing — hand it
+                # back rather than hold blocks hostage.
+                self._unpins.append(req.pin)
+                req.pin = None
+        obs.count("router.disagg_requests")
+        self._drain_unpins()
+        return True
+
+    def _drain_unpins(self) -> None:
+        """Release queued import pins (best effort — network I/O, runs
+        OUTSIDE the router lock at the end of each pump round).  A pin
+        whose holder died or left membership is dropped: its blocks
+        died with that cache, there is nothing to release."""
+        with self._lock:
+            if not self._unpins:
+                return
+            pins, self._unpins = self._unpins, []
+            handles = {n: m.replica for n, m in self._members.items()}
+        for name, pid in pins:
+            r = handles.get(name)
+            if r is None:
+                continue
+            try:
+                r.unpin(pid)
+            except Exception:  # noqa: BLE001 — dead/racing replica:
+                pass           # the pin died with its cache
 
     # ---------------------------------------------------- result pump
 
@@ -767,6 +1107,11 @@ class Router:
             tokens=np.asarray(tokens, np.int32), status=status,
             prompt_len=prompt_len, error=error)
         self._requests.pop(req.request_id, None)
+        if req.pin is not None:
+            # Terminal: hand the shipped blocks back (refcount story —
+            # the drain runs outside the lock at the next pump round).
+            self._unpins.append(req.pin)
+            req.pin = None
         obs.count("router.finished", status=status)
         obs.event("router.finish", request_id=req.request_id,
                   status=status, replica=req.replica,
@@ -914,6 +1259,9 @@ class Router:
                     still.append(rid)
             self._pending = still
             obs.gauge("router.pending", len(self._pending))
+        # Release import pins freed by the finishes/reroutes above —
+        # outside the lock (remote unpins are network I/O).
+        self._drain_unpins()
         if residency_due:
             self.refresh_residency()
         return completed
@@ -953,6 +1301,60 @@ class Router:
             f"request {request_id} did not finish in {max_steps} "
             "steps")
 
+    def stream(self, request_id: int, max_steps: int = 100_000):
+        """Incremental token relay for one request: a generator that
+        yields each newly generated token (ints; prompt excluded) as
+        the serving replica emits it, ending when the request goes
+        terminal — the caller holds the first token long before the
+        terminal result, which is what makes a 2-stage disaggregated
+        request USABLE.  Reads the replica's live transcript
+        (``partial()`` in-process, ``GET /stream`` remote) and drives
+        :meth:`step` between reads (same loop shape as :meth:`drain`).
+        Reroute-safe because decode is deterministic: a rerouted
+        request's regenerated transcript extends the already-streamed
+        prefix bit-exactly, so the cursor never rewinds and nothing is
+        double-yielded.  Raises ``KeyError`` for unknown ids and
+        ``TimeoutError`` past ``max_steps``."""
+        emitted = 0
+        for _ in range(max_steps):
+            with self._lock:
+                res = self._completed.get(request_id)
+                req = self._requests.get(request_id)
+                replica = rrid = None
+                if res is None and req is not None \
+                        and req.replica is not None:
+                    m = self._members.get(req.replica)
+                    if m is not None:
+                        replica, rrid = m.replica, req.replica_rid
+            if res is None and req is None:
+                raise KeyError(f"unknown request {request_id}")
+            snap = res
+            if snap is None and replica is not None \
+                    and rrid is not None:
+                part = getattr(replica, "partial", None)
+                if part is not None:
+                    try:
+                        snap = part(rrid)
+                    except ReplicaUnreachable:
+                        snap = None  # pump's reroute will re-home it
+            if snap is not None:
+                toks = np.asarray(snap.tokens)
+                cut = int(snap.prompt_len) + emitted
+                if toks.size > cut:
+                    for t in toks[cut:]:
+                        emitted += 1
+                        yield int(t)
+                    obs.event("router.stream", request_id=request_id,
+                              tokens=emitted)
+            if res is not None:
+                return
+            self.step()
+            if self._all_remote():
+                time.sleep(self.poll_s)
+        raise TimeoutError(
+            f"request {request_id} did not finish in {max_steps} "
+            "steps of streaming")
+
     def _all_remote(self) -> bool:
         with self._lock:
             return all(getattr(m.replica, "remote", False)
@@ -990,6 +1392,7 @@ class Router:
                 self._finish_locked(req, req.prompt, "cancelled",
                                     req.prompt.size)
             self._pending = []
+        self._drain_unpins()
         return self.results()
 
 
@@ -1012,9 +1415,25 @@ class EngineEndpoint:
                        validation error
     ``GET /poll?id=``  the terminal ``RequestResult`` as JSON, or 404
                        while the request decodes
+    ``GET /stream?id=`` the LIVE transcript snapshot (``partial()`` —
+                       non-terminal ``queued``/``decoding`` statuses
+                       included), 404 for unknown ids — the streaming
+                       relay's read
+    ``POST /prefill``  ``{"prompt": [...]}`` -> the prompt's full-block
+                       KV run as a binary block shipment
+                       (:func:`~distkeras_tpu.serving.disagg.encode_shipment`);
+                       429 = allocator backpressure, 400 = not a paged
+                       engine / bad prompt
+    ``POST /blocks``   a binary block shipment -> the adoption dict
+                       (``{"prefix_id", "blocks", "hits", "bytes"}``);
+                       429 = no free block (caller falls back), 400 =
+                       malformed/geometry mismatch
+    ``POST /unpin``    ``{"prefix_id": id}`` releases a shipped pin;
+                       404 = unknown pin
     ``GET /residency`` the engine's residency digest (stem hashes,
-                       prefix ids, block, live load) — the router's
-                       affinity/ load source
+                       prefix ids, block, live load — plus the
+                       endpoint's ``role`` label) — the router's
+                       affinity/load source
     ``GET /healthz``   200 while the engine admits, 503 once closed
     ================  ====================================================
 
@@ -1023,15 +1442,19 @@ class EngineEndpoint:
     env contract is present (or ``coord_dir=`` is given), the bound
     address publishes to ``<coord_dir>/serve/host<N>.addr`` for
     :func:`discover_replicas` — the same ledger pattern as telemetry
-    federation.
+    federation.  ``role=`` labels the replica for the router's
+    disaggregated topology (published in the address record, so
+    discovery builds role-labeled handles).
     """
 
     def __init__(self, engine, *, port: int = 0,
                  bind: str = "127.0.0.1", coord_dir: str | None = None,
-                 host_id: int | None = None, rid_base: int | None = None):
+                 host_id: int | None = None, rid_base: int | None = None,
+                 role: str | None = None):
         import os
 
         self.engine = engine
+        self.role = _check_role(role)
         self._want_port = port
         self._bind = bind
         env = os.environ
@@ -1066,13 +1489,17 @@ class EngineEndpoint:
             def log_message(self, *a):  # pragma: no cover — quiet
                 pass
 
-            def _send(self, code, obj):
-                data = json.dumps(obj, default=_jsonable).encode()
+            def _send_raw(self, code, data, ctype):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _send(self, code, obj):
+                self._send_raw(
+                    code, json.dumps(obj, default=_jsonable).encode(),
+                    "application/json")
 
             def do_GET(self):  # noqa: N802 — http.server API
                 url = urlparse(self.path)
@@ -1085,8 +1512,19 @@ class EngineEndpoint:
                             self._send(404, {"pending": rid})
                         else:
                             self._send(200, _result_doc(res))
+                    elif url.path == "/stream":
+                        q = parse_qs(url.query)
+                        rid = int(q.get("id", ["-1"])[0])
+                        res = endpoint.engine.partial(rid)
+                        if res is None:
+                            self._send(404, {"unknown": rid})
+                        else:
+                            self._send(200, _result_doc(res))
                     elif url.path == "/residency":
-                        self._send(200, endpoint.engine.residency())
+                        doc = dict(endpoint.engine.residency())
+                        if endpoint.role is not None:
+                            doc["role"] = endpoint.role
+                        self._send(200, doc)
                     elif url.path == "/healthz":
                         ok = not endpoint.engine.closed
                         self._send(200 if ok else 503, {"ok": ok})
@@ -1103,30 +1541,84 @@ class EngineEndpoint:
                     except Exception:  # pragma: no cover
                         pass
 
+            def _post_enqueue(self, raw):
+                body = json.loads(raw or b"{}")
+                prompt = np.asarray(body.pop("prompt"), np.int32)
+                max_new = int(body.pop("max_new_tokens"))
+                try:
+                    rid = endpoint.engine.enqueue(prompt, max_new,
+                                                  **body)
+                except QueueFull as e:
+                    self._send(429, {"error": str(e)})
+                    return
+                except EngineClosed as e:
+                    self._send(503, {"error": str(e)})
+                    return
+                except (ValueError, KeyError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                self._send(200, {"request_id": rid})
+
+            def _post_prefill(self, raw):
+                body = json.loads(raw or b"{}")
+                prompt = np.asarray(body["prompt"], np.int32)
+                try:
+                    ship = endpoint.engine.export_blocks(prompt)
+                except EngineClosed as e:
+                    self._send(503, {"error": str(e)})
+                    return
+                except RuntimeError as e:  # allocator full
+                    self._send(429, {"error": str(e)})
+                    return
+                except (ValueError, KeyError, AttributeError) as e:
+                    # Not a paged engine / bad prompt geometry.
+                    self._send(400, {"error": str(e)})
+                    return
+                self._send_raw(200, encode_shipment(ship),
+                               "application/octet-stream")
+
+            def _post_blocks(self, raw):
+                try:
+                    out = endpoint.engine.import_blocks(
+                        decode_shipment(raw))
+                except EngineClosed as e:
+                    self._send(503, {"error": str(e)})
+                    return
+                except (ValueError, AttributeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                if out is None:
+                    self._send(429, {"error": "allocator "
+                                     "backpressure: no free block "
+                                     "for adoption"})
+                    return
+                self._send(200, out)
+
+            def _post_unpin(self, raw):
+                body = json.loads(raw or b"{}")
+                try:
+                    endpoint.engine.unpin_prefix(
+                        int(body["prefix_id"]))
+                except KeyError as e:
+                    self._send(404, {"error": str(e)})
+                    return
+                self._send(200, {"ok": True})
+
             def do_POST(self):  # noqa: N802 — http.server API
                 url = urlparse(self.path)
+                routes = {"/enqueue": self._post_enqueue,
+                          "/prefill": self._post_prefill,
+                          "/blocks": self._post_blocks,
+                          "/unpin": self._post_unpin}
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
-                    body = json.loads(self.rfile.read(n) or b"{}")
-                    if url.path != "/enqueue":
+                    raw = self.rfile.read(n)
+                    handler = routes.get(url.path)
+                    if handler is None:
                         self._send(404, {"error": f"unknown "
                                          f"{url.path}"})
                         return
-                    prompt = np.asarray(body.pop("prompt"), np.int32)
-                    max_new = int(body.pop("max_new_tokens"))
-                    try:
-                        rid = endpoint.engine.enqueue(prompt, max_new,
-                                                      **body)
-                    except QueueFull as e:
-                        self._send(429, {"error": str(e)})
-                        return
-                    except EngineClosed as e:
-                        self._send(503, {"error": str(e)})
-                        return
-                    except (ValueError, KeyError) as e:
-                        self._send(400, {"error": str(e)})
-                        return
-                    self._send(200, {"request_id": rid})
+                    handler(raw)
                 except BrokenPipeError:  # pragma: no cover
                     pass
                 except Exception as e:  # noqa: BLE001 — keep serving
@@ -1182,7 +1674,7 @@ class EngineEndpoint:
         tmp = os.path.join(d, f".addr.{self.host_id}.{os.getpid()}.tmp")
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump({"host": self.host_id, "addr": self.addr,
-                       "pid": os.getpid()}, f)
+                       "pid": os.getpid(), "role": self.role}, f)
         os.replace(tmp, os.path.join(d, f"host{self.host_id}.addr"))
 
     def _unpublish_addr(self) -> None:

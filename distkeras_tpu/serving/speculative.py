@@ -82,13 +82,26 @@ class SpeculativeBatcher(_LaneEngine):
     is ring-compatible — it inherits the lanes' unbounded positions
     and ring slabs mid-wrap, so greedy parity with solo rolling
     ``generate`` holds past ``max_len`` through a degradation.
+
+    **Pod-sharded** (round 17, ``plan=``/``mesh=``): the TARGET model
+    shards per the plan's rules exactly like the dense engine (params
+    TP-placed, ``tcache``'s kv-heads dim over the derived axis,
+    GSPMD's per-token collectives compiled in) while the DRAFT model
+    replicates whole — a draft is small by design, so replication
+    costs little and keeps the draft chunks collective-free.  Every
+    serve-phase program warms at construction
+    (:meth:`_warm_sharded`); emitted tokens stay bit-exact vs the
+    solo engine.  Full-cache configs only; rejects ``prefix_pool=``
+    (one slab placement cannot serve a sharded target and a
+    replicated draft).
     """
 
     def __init__(self, params, draft_params, cfg: TransformerConfig,
                  draft_cfg: TransformerConfig, lanes: int = 8,
                  n_draft: int = 4, temperature: float = 0.0,
                  eos_token=None, prompt_buckets=(8, 32, 128, 512),
-                 max_queue: int = 0, clock=None, prefix_pool=None):
+                 max_queue: int = 0, clock=None, prefix_pool=None,
+                 plan=None, mesh=None):
         # Windowed configs run ROLLING speculative lanes (round-7): the
         # verify chunk writes through _decode_chunk's modular ring
         # scatter under the same bound as solo speculative_generate —
@@ -173,9 +186,50 @@ class SpeculativeBatcher(_LaneEngine):
                 raise ValueError(
                     f"prefix_pool was built for different configs "
                     f"(pool segments {got}, engine caches {want})")
+        # Pod-sharded speculative serving (round 17): the TARGET model
+        # shards per the plan's rules exactly like the dense engine —
+        # params TP-placed, tcache's kv-heads dim over the derived
+        # axis — while the DRAFT model replicates whole (a draft is
+        # small by design; replicating it sidesteps any
+        # head-divisibility question on its config and keeps the
+        # draft chunks collective-free).  Full-cache configs only;
+        # every serve-phase program warms at construction
+        # (_warm_sharded), same zero-compile contract as the dense
+        # engine.
+        if (plan is None) != (mesh is None):
+            raise ValueError(
+                "pass plan= and mesh= together: the plan's rules only "
+                "mean something against a concrete mesh (use "
+                "parallel.sharding.serving_plan() for the standard TP "
+                "layout)")
+        if plan is not None:
+            if cfg.attention_window is not None:
+                raise ValueError(
+                    "pod-sharded speculative serving needs full-cache "
+                    "configs (no attention_window): the ring slab's "
+                    "rolling scatter has no stable sharded layout to "
+                    "pin")
+            if prefix_pool is not None:
+                raise ValueError(
+                    "plan= does not compose with prefix_pool= on the "
+                    "speculative engine: pooled segments are (target, "
+                    "draft) cache pairs and the draft half replicates "
+                    "while the target shards — one slab placement "
+                    "cannot satisfy both; use the dense engine for "
+                    "pooled sharded serving")
+        self.plan, self.mesh = plan, mesh
+        if plan is not None:
+            from distkeras_tpu.parallel.rules import serving_kv_axis
+
+            self._kv_axis = serving_kv_axis(plan, mesh, cfg)
         self._prefix_pool = prefix_pool
-        self.params = _device_tree(params)
-        self.draft_params = _device_tree(draft_params)
+        if plan is not None:
+            self.params = jax.device_put(
+                params, plan.tree_shardings(mesh, params))
+            self.draft_params = self._place_replicated(draft_params)
+        else:
+            self.params = _device_tree(params)
+            self.draft_params = _device_tree(draft_params)
         self.cfg, self.draft_cfg = cfg, draft_cfg
         self.lanes, self.n_draft = lanes, n_draft
         self.temperature = temperature
@@ -210,8 +264,14 @@ class SpeculativeBatcher(_LaneEngine):
         self.degraded_error = None
         self._fallback = None
 
-        self.tcache = init_cache(cfg, lanes)
-        self.dcache = init_cache(draft_cfg, lanes)
+        # Sharded engines commit the target cache under the plan's KV
+        # sharding, the draft cache and row state replicated —
+        # placement is part of the jit cache key for committed arrays,
+        # so live state and warm-up dummies must agree (identity
+        # placements unsharded).
+        self.tcache = self._place_kv(init_cache(cfg, lanes))
+        self.dcache = self._place_replicated(init_cache(draft_cfg,
+                                                        lanes))
         self.pos = jnp.zeros((lanes,), jnp.int32)   # last FINAL position
         self.cur = jnp.zeros((lanes,), jnp.int32)   # token at pos
         self.prev = jnp.zeros((lanes,), jnp.int32)  # token at pos - 1
@@ -222,14 +282,25 @@ class SpeculativeBatcher(_LaneEngine):
         # shape-row invariant: (V,) and (1, V) draws agree).
         self.keys = jnp.stack([jax.random.key(0)] * lanes)
         self.iters = jnp.zeros((lanes,), jnp.int32)
+        if mesh is not None:
+            (self.pos, self.cur, self.prev, self.keys, self.iters) = (
+                self._place_replicated(x)
+                for x in (self.pos, self.cur, self.prev, self.keys,
+                          self.iters))
 
         k = n_draft
         idx = jnp.arange(k + 1)
         rolling = self._rolling
         cap = None if rolling else jnp.int32(self._cap)
         sampled = temperature > 0
+        constrain = self._kv_constraint
 
         def step_fn(tcache, dcache, prev, cur, pos, keys, iters):
+            if constrain is not None:
+                # Pin the target cache's sharded layout inside the
+                # compiled program (the draft cache is replicated —
+                # replicated in, replicated out, nothing to pin).
+                tcache = constrain(tcache)
             # ---- draft: first chunk T=2 rewrites [pos-1, pos] (the
             # full-acceptance gap closure, exactly the solo body's).
             pos0 = jnp.maximum(pos - 1, 0)
@@ -322,12 +393,48 @@ class SpeculativeBatcher(_LaneEngine):
         # per-model prefix segment inside the same program.
         pooled = prefix_pool is not None
         self._admit_t = _make_lane_admit(self.params, cfg,
-                                         pooled=pooled)
+                                         pooled=pooled,
+                                         constrain=self._kv_constraint)
         self._admit_d = _make_lane_admit(self.draft_params, draft_cfg,
                                          pooled=pooled)
         if pooled:
             self._reseed_t = _make_lane_reseed(pooled=True)
             self._reseed_d = _make_lane_reseed(pooled=True)
+        if plan is not None:
+            self._warm_sharded()
+
+    # ---------------------------------------------- sharded warm-up
+
+    def _warm_sharded(self) -> None:
+        """Compile every serve-phase program at construction (the
+        sharded zero-compile contract): the speculative step and both
+        per-bucket admission programs run once against dummy state
+        with EXACTLY the live arrays' avals and placements, plus the
+        tiny host-scatter programs ``submit`` touches.  After this the
+        serve phase never compiles (the ``spec_sharded`` compile
+        session asserts it); only the degraded fallback still
+        compiles lazily — a draft fault is not a steady state."""
+        with obs.span("serving.compile_warm", lanes=self.lanes):
+            fresh = lambda: (
+                self._place_kv(init_cache(self.cfg, self.lanes)),
+                self._place_replicated(init_cache(self.draft_cfg,
+                                                  self.lanes)))
+            ints = lambda: self._place_replicated(
+                jnp.zeros((self.lanes,), jnp.int32))
+            keys = self._place_replicated(
+                jnp.stack([jax.random.key(0)] * self.lanes))
+            tc, dc = fresh()           # the step donates both caches
+            self._step(tc, dc, ints(), ints(), ints(), keys, ints())
+            for width in self._buckets:
+                rows = jnp.zeros((1, width), jnp.int32)
+                tc, dc = fresh()       # admission donates its cache
+                self._admit_t(tc, rows, jnp.int32(0), jnp.int32(0))
+                self._admit_d(dc, rows, jnp.int32(0), jnp.int32(0))
+            # submit()'s host lane-slot writes specialize per shape
+            # and placement too — tiny scatters, but a compile is a
+            # compile.
+            ints().at[0].set(0)
+            keys.at[0].set(jax.random.key(0))
 
     # -------------------------------------------------------------- API
 
@@ -570,11 +677,14 @@ class SpeculativeBatcher(_LaneEngine):
         temperature = self.temperature
         rolling = self._rolling
         cap = None if rolling else jnp.int32(self._cap)
+        constrain = self._kv_constraint
 
         def pick(k, row, q):
             return jax.random.categorical(jax.random.fold_in(k, q), row)
 
         def one(tcache, cur, pos, keys):
+            if constrain is not None:
+                tcache = constrain(tcache)
             logits, tcache = _decode_chunk(self.params, tcache,
                                            cur[:, None], pos, cfg)
             logits = logits[:, 0]

@@ -1482,6 +1482,205 @@ def bench_router_affinity():
     return run
 
 
+def bench_router_disagg():
+    """Disaggregated prefill/decode fleet vs the co-resident baseline
+    (round 17): the SAME 2-replica paged fleet serves the SAME trace —
+    a storm of multi-block-prompt, short-decode requests pounding the
+    fleet while a handful of long-decode "victim" requests stream
+    tokens through ``Router.stream()`` — once with role labels
+    (``prefill``-specialized replica builds each storm prompt's KV
+    blocks, ships them, the ``decode`` replica adopts by page-table
+    splice) and once role-less (every replica pays its own prefills
+    between its own decode steps).  The claim under test: moving
+    prefill compute OFF the decode replica keeps the victims' decode
+    TPOT flat through the storm.  TPOT here is the ROUTER-LEVEL
+    inter-token gap observed by the streaming caller (the user-visible
+    latency), p50/p99 pooled across every victim gap; value = the
+    baseline-over-disagg p99 ratio (the immunity).  Storm prompts
+    share a first block across ``n_stems`` stems with a unique second
+    block, so every request takes the ship->adopt hop (the unique
+    block defeats the warm-skip residency gate) while repeated stems
+    hash-hit on adoption — extras carry the transfer bytes and the
+    adoption-hit rate read from the obs counters (needs the active
+    obs session main() provides)."""
+    def run(n_storm=1000, n_victims=8, storm_new=2, victim_new=96,
+            lanes=4, n_stems=None, window=8):
+        import threading
+
+        import numpy as np
+
+        from distkeras_tpu import obs
+        from distkeras_tpu.serving import (InProcessReplica,
+                                           PagedBatcher, QueueFull,
+                                           Router)
+
+        cfg = _cfg()
+        params = _params()
+        block = _paged_block(cfg.max_len)
+        mb = cfg.max_len // block
+        rng = np.random.default_rng(0)
+        if n_stems is None:
+            n_stems = max(1, n_storm // 8)
+        # stem + unique block + a ONE-TOKEN tail: the disagg planner
+        # gates on the full-block stems of prompt[:-1], so the tail
+        # makes the unique block count as a stem — every request
+        # takes the hop (never warm-skipped), while the shared first
+        # block hash-hits on adoption once its stem shipped before.
+        stems = rng.integers(0, cfg.vocab_size,
+                             (n_stems, block)).astype(np.int32)
+        uniq = rng.integers(0, cfg.vocab_size,
+                            (n_storm, block + 1)).astype(np.int32)
+        storm = [np.concatenate([stems[i % n_stems], uniq[i]])
+                 for i in range(n_storm)]
+        v_len = block - 1        # sub-block: victims never take the hop
+        vics = rng.integers(0, cfg.vocab_size,
+                            (n_victims, v_len)).astype(np.int32)
+        warm_storm = rng.integers(0, cfg.vocab_size,
+                                  (2 * block + 1,)).astype(np.int32)
+
+        def counters():
+            sess = obs.active()
+            if sess is None:
+                return None
+            snap = sess.registry.snapshot()
+
+            def val(name):
+                return sum(s.get("value", 0) or 0
+                           for s in snap.get(name, {}).get("series", []))
+            return {n: val(n) for n in (
+                "router.transfer_bytes", "router.disagg_requests",
+                "router.disagg_warm_skips", "router.disagg_fallbacks",
+                "serving.disagg.blocks_in", "serving.disagg.adopt_hits")}
+
+        def serve(disagg):
+            roles = ("prefill", "decode") if disagg else (None, None)
+            engines = [PagedBatcher(
+                params, cfg, lanes=lanes, block=block,
+                n_blocks=4 * lanes * mb + 2 * n_stems + 4,
+                max_queue=n_storm + n_victims,
+                prompt_buckets=(v_len, 2 * block + 1)) for _ in roles]
+            # Warm every engine's admission/decode programs and the
+            # export/import hop OUTSIDE the timed region (non-elastic
+            # paged engines compile lazily).
+            for e in engines:
+                for p, new in ((warm_storm, storm_new),
+                               (vics[0], victim_new)):
+                    rid = e.enqueue(p, new)
+                    while e.poll(rid) is None:
+                        e.step()
+                    e.take(rid)
+            if disagg:
+                ship = engines[0].export_blocks(warm_storm)
+                imported = engines[1].import_blocks(ship)
+                rid = engines[1].enqueue(warm_storm, storm_new)
+                while engines[1].poll(rid) is None:
+                    engines[1].step()
+                engines[1].take(rid)
+                engines[1].unpin_prefix(imported["prefix_id"])
+            replicas = [InProcessReplica(f"{r or 'gen'}{i}", e, role=r)
+                        for i, (r, e) in enumerate(zip(roles, engines))]
+            router = Router(replicas, policy="affinity",
+                            residency_interval=0.05)
+            for r in replicas:
+                r.start()
+            try:
+                router.pump()   # residency refresh: tables learn the
+                # block geometry the disagg planner keys on.
+                gaps: list[float] = []
+                firsts: list[float] = []
+
+                def stream_victim(i):
+                    t0 = time.perf_counter()
+                    rid = router.enqueue(vics[i], victim_new)
+                    last = None
+                    mine = []
+                    for _tok in router.stream(rid):
+                        now = time.perf_counter()
+                        if last is None:
+                            firsts.append(now - t0)
+                        else:
+                            mine.append(now - last)
+                        last = now
+                    gaps.extend(mine)
+
+                threads = [threading.Thread(target=stream_victim,
+                                            args=(i,), daemon=True)
+                           for i in range(n_victims)]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                # The storm: open loop with a bounded in-flight window
+                # (shipped blocks stay pinned until their request
+                # decodes — an unbounded burst would just trade hop
+                # fallbacks for allocator backpressure).
+                rids: dict[int, int] = {}
+                inflight: set[int] = set()
+                nxt = done = 0
+                while done < n_storm:
+                    while nxt < n_storm and len(inflight) < window:
+                        try:
+                            rids[nxt] = router.enqueue(storm[nxt],
+                                                       storm_new)
+                        except QueueFull:
+                            break
+                        inflight.add(nxt)
+                        nxt += 1
+                    router.pump()
+                    for i in list(inflight):
+                        if router.poll(rids[i]) is not None:
+                            inflight.discard(i)
+                            done += 1
+                    time.sleep(0.0005)
+                dt = time.perf_counter() - t0
+                for t in threads:
+                    t.join()
+                ok = sum(router.take(r).ok for r in rids.values())
+            finally:
+                for r in replicas:
+                    r.stop()
+            return gaps, firsts, dt, ok
+
+        c0 = counters()
+        gaps_d, firsts_d, dt_d, ok_d = serve(True)
+        c1 = counters()
+        gaps_b, firsts_b, dt_b, ok_b = serve(False)
+
+        pct = lambda a, q: round(
+            float(np.percentile(a or [0.0], q)) * 1e3, 2)
+        extras = {
+            "n_storm": n_storm, "n_victims": n_victims,
+            "storm_new": storm_new, "victim_new": victim_new,
+            "lanes": lanes, "block": block, "n_stems": n_stems,
+            "storm_ok": ok_d, "baseline_storm_ok": ok_b,
+            "storm_rps": round(n_storm / dt_d, 1),
+            "baseline_storm_rps": round(n_storm / dt_b, 1),
+            "tpot_p50_ms": pct(gaps_d, 50),
+            "tpot_p99_ms": pct(gaps_d, 99),
+            "baseline_tpot_p50_ms": pct(gaps_b, 50),
+            "baseline_tpot_p99_ms": pct(gaps_b, 99),
+            "ttft_p50_ms": pct(firsts_d, 50),
+            "baseline_ttft_p50_ms": pct(firsts_b, 50),
+        }
+        if c0 is not None:
+            d = {k: c1[k] - c0[k] for k in c0}
+            blocks_in = d["serving.disagg.blocks_in"]
+            extras.update({
+                "disagg_requests": int(d["router.disagg_requests"]),
+                "warm_skips": int(d["router.disagg_warm_skips"]),
+                "fallbacks": int(d["router.disagg_fallbacks"]),
+                "transfer_mb": round(
+                    d["router.transfer_bytes"] / 1e6, 3),
+                "blocks_shipped": int(blocks_in),
+                "adoption_hit_rate": round(
+                    d["serving.disagg.adopt_hits"]
+                    / max(blocks_in, 1), 3),
+            })
+        p99_d = float(np.percentile(gaps_d or [1e-9], 99))
+        p99_b = float(np.percentile(gaps_b or [1e-9], 99))
+        return p99_b / max(p99_d, 1e-9), p99_d, 0.0, extras
+    return run
+
+
 BENCHES = {
     "decode_greedy_b1": (bench_greedy(1), "tokens/sec/chip"),
     "decode_greedy_b8": (bench_greedy(8), "tokens/sec/chip"),
@@ -1568,6 +1767,10 @@ BENCHES = {
     # per-device param+KV bytes and TTFT/TPOT vs the solo engine.
     "engine_sharded_tp2": (bench_engine_sharded(2), "tokens/sec"),
     "engine_sharded_tp4": (bench_engine_sharded(4), "tokens/sec"),
+    # Round-17 disaggregated fleet: prefill/decode role split with
+    # block shipping vs the co-resident baseline on the same trace —
+    # value is the victims' streaming-TPOT p99 immunity ratio.
+    "router_disagg": (bench_router_disagg(), "x speedup"),
 }
 
 

@@ -45,6 +45,16 @@ request, the dead replica's series drop out of ``/metrics/cluster``
 and return after its restart, the merged timeline shows the re-route
 hop, and every lock report is clean.
 
+Round 17 adds ``serve_kill_prefill``, the DISAGGREGATED serving leg:
+a role-labeled fleet (a ``prefill``-specialized and a ``decode``-
+specialized replica process) serves 2-block prompts through the
+prefill->ship->adopt hop, and chaos SIGKILLs the PREFILL replica
+mid-transfer.  The router must fall back to plain routing (zero lost
+requests), the decode replica's refcounted slab must drain to empty
+once the unpins relay (shipped blocks leak nothing), the hop must
+resume after the coordinated restart, and every lock ledger must be
+clean.
+
 Round 16 adds the ASYNC-TIER legs (docs/async.md): ``async_stall``
 wedges a simulated host's heartbeat writer mid-training under the
 bounded-staleness plane and asserts the fleet slows by less than tau
@@ -383,14 +393,27 @@ eng = PagedBatcher(params, cfg, lanes=2, block=8, n_blocks=33,
                    max_queue=16, prompt_buckets=(16,))
 # Fixed port (parent-chosen): a restarted replica binds the SAME
 # address, so the router's handle revives on the next health probe.
-ep = EngineEndpoint(eng, port=int(os.environ["DKT_SERVE_PORT"]))
+# DKT_SERVE_ROLE labels the endpoint for the round-17 disaggregated
+# leg (prefill/decode split); unset = generalist (serve_kill).
+role = os.environ.get("DKT_SERVE_ROLE") or None
+ep = EngineEndpoint(eng, port=int(os.environ["DKT_SERVE_PORT"]),
+                    role=role)
 ep.start(step=True)
-obs.event("router_child", host=host, phase="serving", port=ep.port)
+obs.event("router_child", host=host, phase="serving", port=ep.port,
+          role=role or "generalist")
 print("REPLICA", host, "UP", ep.port, flush=True)
 stop = os.path.join(os.environ["DKT_CLUSTER_DIR"], "stop%d" % host)
 while not os.path.exists(stop):
     time.sleep(0.1)
 ep.stop()
+# Refcounted-block leak ledger: with every request taken and every
+# unpin relayed, an idle paged engine holds ZERO blocks (resident
+# stem hashes are content-addressed bookkeeping, not held blocks).
+_st = eng.allocator.stats()
+obs.event("serving.allocator", host=host, role=role or "generalist",
+          **_st)
+if os.environ.get("DKT_ASSERT_IDLE_ALLOC"):
+    assert _st["used"] == 0, "leaked KV blocks at exit: %r" % (_st,)
 from distkeras_tpu.utils import locks as _locks
 _rep = _locks.lock_report()
 obs.event("locks.report", host=host, **_rep)
@@ -609,6 +632,260 @@ def run_router_kill_scenario(seed, workdir, n_req=12, kill_after=4):
         failures += 1
         print("  FAIL  cluster/serve_kill: router-process lock "
               "sanitizer violations:")
+        for v in locks.violations():
+            print("  VIOLATION " + v.format())
+    return failures
+
+
+def run_router_prefill_kill_scenario(seed, workdir, n_wave1=4,
+                                     n_wave2=6):
+    """The round-17 disaggregated leg: a role-labeled fleet (host0 =
+    ``decode``-specialized, host1 = ``prefill``-specialized) serving
+    2-block prompts through the prefill->ship->adopt hop, and a
+    SIGKILL of the PREFILL replica mid-transfer.  The router must fall
+    back to plain routing (every accepted request completes on the
+    decode replica — zero lost), the refcounted shipped blocks must
+    leak NOTHING on the decode side (allocator drains to empty once
+    the unpins relay), the hop must resume after the coordinated
+    restart, and every lock ledger must be clean.  Returns the number
+    of failed assertions (0 = green)."""
+    import glob
+    import json
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from distkeras_tpu import obs
+    from distkeras_tpu.obs.report import merge_traces
+    from distkeras_tpu.serving.router import HttpReplica, Router
+    from distkeras_tpu.utils import locks
+
+    print("== cluster scenario: serve_kill_prefill (disaggregated "
+          "hop under prefill death) ==", flush=True)
+    base = os.path.join(workdir, "serve_kill_prefill")
+    coord = os.path.join(base, "coord")
+    tracedir = os.path.join(base, "traces")
+    os.makedirs(tracedir, exist_ok=True)
+    os.makedirs(coord, exist_ok=True)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(base, "replica.py")
+    with open(script, "w", encoding="utf-8") as f:
+        f.write(ROUTER_CHILD.format(repo=repo, tracedir=tracedir,
+                                    seed=seed))
+    ports = [_free_port(), _free_port()]
+    roles = ["decode", "prefill"]
+
+    def launch(h):
+        import subprocess
+
+        env = {**os.environ,
+               "DKT_CLUSTER_DIR": coord,
+               "DKT_CLUSTER_HOST": str(h),
+               "DKT_CLUSTER_NHOSTS": "2",
+               "DKT_CLUSTER_WINDOW": "2.0",
+               "DKT_SERVE_PORT": str(ports[h]),
+               "DKT_SERVE_ROLE": roles[h],
+               "DKT_ASSERT_IDLE_ALLOC": "1"}
+        return subprocess.Popen([sys.executable, script], env=env)
+
+    def wait_port(h, deadline):
+        import time as _time
+
+        while True:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{ports[h]}/healthz",
+                    timeout=1.0).read()
+                return
+            except Exception:  # noqa: BLE001 — still starting
+                assert _time.time() < deadline, \
+                    f"replica {h} never came up on port {ports[h]}"
+                _time.sleep(0.2)
+
+    import time as _time
+
+    locks.enable_sanitizer()
+    children = [launch(0), launch(1)]
+    rng = np.random.default_rng(seed)
+    router_trace = os.path.join(tracedir, "router.jsonl")
+    failures = 0
+    sess = None
+    try:
+        wait_port(0, _time.time() + 180)
+        wait_port(1, _time.time() + 180)
+        sess = obs.enable(trace_path=router_trace)
+        dec = HttpReplica("host0", f"127.0.0.1:{ports[0]}",
+                          role="decode")
+        router = Router(
+            [dec, HttpReplica("host1", f"127.0.0.1:{ports[1]}",
+                              role="prefill")],
+            policy="affinity", health_interval=0.3,
+            residency_interval=0.2)
+        router.pump()  # first residency refresh: the disagg planner
+        # keys on the block geometry the tables now advertise.
+        # 2-block prompts (the child engines run block=8, bucket 16):
+        # a UNIQUE first block + a shared 1-block tail.  The planner
+        # gates on the full-block stems of ``prompt[:-1]`` — one
+        # block here, always fresh — so EVERY request takes the
+        # ship->adopt hop (a shared first block would warm-skip all
+        # but the first request per stem).
+        stem = rng.integers(0, 64, (8,)).astype(np.int32)
+        n_req = n_wave1 + n_wave2
+        prompts = [np.concatenate(
+            [rng.integers(0, 64, (8,)).astype(np.int32), stem])
+            for _ in range(n_req)]
+
+        def counter(name):
+            snap = sess.registry.snapshot()
+            return sum(s.get("value", 0) for s in
+                       snap.get(name, {}).get("series", []))
+
+        def serve_wave(wave_rids, deadline):
+            done = set()
+            while len(done) < len(wave_rids):
+                assert _time.time() < deadline, (
+                    f"serve_kill_prefill stalled: {len(done)}/"
+                    f"{len(wave_rids)} done, "
+                    f"up={router.replicas_up()}")
+                router.pump()
+                for r in wave_rids:
+                    if r not in done and router.poll(r) is not None:
+                        done.add(r)
+                _time.sleep(0.05)
+
+        # Wave 1: the healthy hop — prefill builds, ships, decode
+        # adopts (also warms every program outside the kill window).
+        first = [router.enqueue(p, 8) for p in prompts[:n_wave1]]
+        serve_wave(first, _time.time() + 180)
+        hops = counter("router.disagg_requests")
+        assert hops >= 1, (
+            "no request took the prefill->decode hop before the "
+            "kill — the scenario exercised nothing")
+        # Wave 2: LONG decodes with the SIGKILL racing the hop.  The
+        # killer thread fires mid-enqueue (the hop runs synchronously
+        # in the enqueue caller), and the enqueues after the kill land
+        # before any health probe marks the victim down — those hops
+        # fail at the prefill/transfer stage and MUST fall back to
+        # plain routing, never surface to the caller.
+        killer = threading.Thread(
+            target=lambda: (_time.sleep(0.05), children[1].kill()),
+            daemon=True)
+        killer.start()
+        rest = [router.enqueue(p, 100) for p in prompts[n_wave1:]]
+        killer.join()
+        children[1].wait(timeout=30)
+        print("  killed prefill replica mid-transfer "
+              f"({int(counter('router.disagg_fallbacks'))} hop "
+              "fallback(s) at kill time)", flush=True)
+        serve_wave(rest, _time.time() + 300)
+        rids = first + rest
+        results = {r: router.take(r) for r in rids}
+        lost = [r for r, v in results.items() if not v.ok]
+        assert not lost, (
+            f"accepted requests lost across the prefill kill: "
+            f"{[(r, results[r].status) for r in lost]}")
+        fallbacks = counter("router.disagg_fallbacks")
+        assert fallbacks >= 1, (
+            "the prefill kill produced no hop fallback — nothing "
+            "was mid-transfer")
+        # Coordinated restart: the prefill replica returns on the
+        # SAME address and the hop must RESUME (fresh stem, so the
+        # warm-skip gate cannot hide a dead hop).
+        children[1] = launch(1)
+        wait_port(1, _time.time() + 180)
+        deadline = _time.time() + 60
+        while "host1" not in router.replicas_up():
+            assert _time.time() < deadline, \
+                "restarted prefill replica never rejoined the router"
+            router.pump()
+            _time.sleep(0.1)
+        stem2 = rng.integers(0, 64, (8,)).astype(np.int32)
+        extra = router.enqueue(np.concatenate(
+            [stem2, rng.integers(0, 64, (8,)).astype(np.int32)]), 8)
+        serve_wave([extra], _time.time() + 120)
+        assert router.take(extra).ok
+        assert counter("router.disagg_requests") > hops, (
+            "the hop never resumed after the prefill restart")
+        # Leak check: once every unpin has relayed, the decode
+        # replica's refcounted slab must drain to empty — shipped
+        # blocks pinned for adoption leak NOTHING across the chaos.
+        capacity = 32          # the child's n_blocks=33 minus trash
+        deadline = _time.time() + 60
+        while True:
+            free = dec.residency().get("kv_blocks_free")
+            if free == capacity:
+                break
+            assert _time.time() < deadline, (
+                f"decode replica still holds blocks after drain: "
+                f"free={free}, expected {capacity}")
+            router.pump()
+            _time.sleep(0.1)
+        print(f"  PASS  cluster/serve_kill_prefill: {n_req} + 1 "
+              f"post-restart ok, {int(hops)} hop(s) pre-kill, "
+              f"{int(fallbacks)} fallback(s), decode slab drained "
+              f"to {capacity}/{capacity} free", flush=True)
+    except Exception as e:  # noqa: BLE001 — report the ladder
+        failures += 1
+        print(f"  FAIL  cluster/serve_kill_prefill: "
+              f"{type(e).__name__}: {e}")
+    finally:
+        if sess is not None:
+            obs.disable()
+        for h in (0, 1):
+            with open(os.path.join(coord, f"stop{h}"), "w"):
+                pass
+        for c in children:
+            try:
+                c.wait(timeout=60)
+            except Exception:  # noqa: BLE001 — force it down
+                c.kill()
+
+    # Merged cross-process timeline: the block-transfer hop and the
+    # fallback must both be visible, the allocator ledgers empty, and
+    # every lock report clean.
+    traces = sorted(glob.glob(os.path.join(tracedir, "*.jsonl")))
+    merged = merge_traces(traces)
+    print("--- cross-process serve timeline (serve_kill_prefill, "
+          "JSONL) ---")
+    for e in merged["timeline"]:
+        if e["name"].startswith(("router", "locks",
+                                 "serving.allocator")):
+            print(json.dumps({"t": round(e["t"], 4),
+                              "host": e["host"], "event": e["name"],
+                              **e["fields"]}))
+    for name, what in (("router.block_transfer",
+                        "no block-transfer hop"),
+                       ("router.disagg_fallback",
+                        "no hop fallback")):
+        if not any(e["name"] == name for e in merged["timeline"]):
+            failures += 1
+            print(f"  FAIL  cluster/serve_kill_prefill: {what} in "
+                  "the merged timeline")
+    leaks = [e for e in merged["timeline"]
+             if e["name"] == "serving.allocator"
+             and e["fields"].get("used")]
+    if leaks:
+        failures += 1
+        print("  FAIL  cluster/serve_kill_prefill: block leak in "
+              f"exit ledger(s): {[e['fields'] for e in leaks]}")
+    reports = [e for e in merged["timeline"]
+               if e["name"] == "locks.report"]
+    hosts_reported = {e["fields"].get("host") for e in reports}
+    if not hosts_reported >= {0, 1}:
+        failures += 1
+        print(f"  FAIL  cluster/serve_kill_prefill: lock report "
+              f"missing for replica(s) "
+              f"{sorted({0, 1} - hosts_reported)}")
+    bad = [e for e in reports if e["fields"].get("violations")]
+    if bad:
+        failures += 1
+        print("  FAIL  cluster/serve_kill_prefill: lock sanitizer "
+              "violation(s) in replica report(s)")
+    if locks.violation_count():
+        failures += 1
+        print("  FAIL  cluster/serve_kill_prefill: router-process "
+              "lock sanitizer violations:")
         for v in locks.violations():
             print("  VIOLATION " + v.format())
     return failures
@@ -878,6 +1155,9 @@ def run_cluster_ladder(scenarios, seed, workdir):
     if "serve_kill" in scenarios:
         scenarios.remove("serve_kill")
         failures += run_router_kill_scenario(seed, workdir)
+    if "serve_kill_prefill" in scenarios:
+        scenarios.remove("serve_kill_prefill")
+        failures += run_router_prefill_kill_scenario(seed, workdir)
     if not scenarios:
         return failures
 
@@ -996,6 +1276,7 @@ def main():
                          "ladder instead of the single-host matrix")
     ap.add_argument("--scenarios",
                     default="kill,stall,drop,serve_kill,"
+                            "serve_kill_prefill,"
                             "async_stall,async_kill_push",
                     help="--cluster fault kinds to run "
                          "(kill = host loss, stall = wedged heartbeat "

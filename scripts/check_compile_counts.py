@@ -674,6 +674,140 @@ def session_async(hosts: int = 2, batch_size: int = 4, rounds: int = 4,
     assert t.async_report["version"] == rounds, t.async_report
 
 
+def session_serving_sharded_elastic():
+    """lane_tiers x plan (round 17): a POD-SHARDED elastic engine —
+    monolithic and paged — compiles every tier's sharded programs and
+    the inter-tier resize gathers at construction; the serve phase
+    INCLUDING a tier move up and back down is ASSERTED compile-free.
+    A compile here means a tier's sharded program (or the paged
+    engine's rows-only resize) was missed at warm-up and a live
+    resize paid it."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+    from distkeras_tpu.parallel.sharding import serving_plan
+    from distkeras_tpu.serving import ContinuousBatcher, PagedBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    rng = np.random.default_rng(0)
+    for kind in ("cb", "paged"):
+        kw = dict(lane_tiers=(1, 2), max_queue=1, scale_up_after=1,
+                  scale_down_after=2, prompt_buckets=(8,),
+                  plan=serving_plan(), mesh=mesh)
+        if kind == "cb":
+            eng = ContinuousBatcher(params, cfg, **kw)
+        else:
+            eng = PagedBatcher(params, cfg, block=8, **kw)
+        built = _COMPILES["n"]
+        rids = [eng.enqueue(rng.integers(0, 64, (5,)).astype(np.int32),
+                            6) for _ in range(3)]
+        assert eng.lanes == 2, eng.lanes      # stepped up under load
+        while any(eng.poll(r) is None for r in rids):
+            eng.step()
+        for _ in range(3):
+            eng.step()                        # drained: back down
+        assert eng.lanes == 1, eng.lanes
+        assert all(eng.take(r).ok for r in rids)
+        serve = _COMPILES["n"] - built
+        assert serve == 0, (
+            f"sharded elastic ({kind}) serve phase compiled {serve} "
+            "program(s); every tier's sharded programs and the resize "
+            "gathers must compile at construction")
+
+
+def session_serving_disagg():
+    """Disaggregated prefill/decode (round 17): a prefill engine
+    exports a prompt's KV blocks through the wire codec, a decode
+    engine adopts them by page-table splice, and decode runs on the
+    adopted stem.  The whole export -> ship -> import -> decode path
+    is ASSERTED compile-free after construction — the extract/adopt
+    block programs warm at construction with template blocks placed
+    exactly like live wire payloads, so adoption never compiles."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.serving import (PagedBatcher, decode_shipment,
+                                       encode_shipment)
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    pre = PagedBatcher(params, cfg, lanes=2, block=8,
+                       prompt_buckets=(8, 16))
+    dec = PagedBatcher(params, cfg, lanes=2, block=8,
+                       prompt_buckets=(8, 16))
+    built = _COMPILES["n"]
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 64, (19,)).astype(np.int32)
+    ship = pre.export_blocks(prompt)
+    assert ship is not None and len(ship.hashes) == 2
+    imported = dec.import_blocks(decode_shipment(encode_shipment(ship)))
+    assert imported is not None and imported["blocks"] == 2
+    lane = dec.submit(prompt, 6)
+    while lane in dec.running():
+        dec.step()
+    dec.drain(lane)
+    dec.unpin_prefix(imported["prefix_id"])
+    serve = _COMPILES["n"] - built
+    assert serve == 0, (
+        f"disagg export/import/decode compiled {serve} program(s); "
+        "block extract/adopt must warm at construction and the "
+        "adopted stem must decode on the existing admission programs")
+
+
+def session_spec_sharded():
+    """Pod-sharded SpeculativeBatcher (round 17): the target model
+    shards per the plan, the draft replicates, and _warm_sharded
+    compiles every serve-phase program — the step, both per-bucket
+    admissions, the host lane-slot scatters — at construction; the
+    admit/decode/drain/re-admit phase is ASSERTED compile-free."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+    from distkeras_tpu.parallel.sharding import serving_plan
+    from distkeras_tpu.serving import SpeculativeBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    draft_cfg = tfm.TransformerConfig(vocab_size=64, d_model=16,
+                                      n_heads=2, n_layers=1, d_ff=32,
+                                      max_len=32, rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    draft = tfm.init_params(jax.random.key(8), draft_cfg)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    eng = SpeculativeBatcher(params, draft, cfg, draft_cfg, lanes=2,
+                             n_draft=3, prompt_buckets=(8, 16),
+                             plan=serving_plan(), mesh=mesh)
+    built = _COMPILES["n"]
+    rng = np.random.default_rng(0)
+    lanes = [eng.submit(rng.integers(1, 64, (5,)).astype(np.int32), 6),
+             eng.submit(rng.integers(1, 64, (12,)).astype(np.int32), 6)]
+    for lane in lanes:
+        while lane in eng.running():
+            eng.step()
+        eng.drain(lane)
+    again = eng.submit(rng.integers(1, 64, (7,)).astype(np.int32), 4)
+    while again in eng.running():
+        eng.step()
+    eng.drain(again)
+    serve = _COMPILES["n"] - built
+    assert serve == 0, (
+        f"sharded speculative serve phase compiled {serve} "
+        "program(s); every program must warm at construction "
+        "(_warm_sharded) with live-matching placements")
+
+
 SESSIONS = {
     "adag": lambda: session_adag(),
     "adag_zero1": lambda: session_adag(zero1=True),
@@ -729,6 +863,14 @@ SESSIONS = {
     "async_tree": lambda: session_async(
         hosts=4, batch_size=2, rounds=8, tau=2, fanout=2,
         async_merge="adasum", async_compress="int8"),
+    # Round 17: elastic tiers compose with plan= (both engine
+    # families — serve phase incl. a live tier move asserted
+    # zero-compile), and the disaggregated block-transfer path
+    # (export -> wire -> adopt -> decode) is likewise asserted
+    # compile-free after construction.
+    "serving_sharded_elastic": session_serving_sharded_elastic,
+    "serving_disagg": session_serving_disagg,
+    "spec_sharded": session_spec_sharded,
 }
 
 

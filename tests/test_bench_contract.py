@@ -232,6 +232,41 @@ def test_bench_router_affinity_row(monkeypatch):
     assert _tiny_serving_cfg().max_len % extras["block"] == 0
 
 
+def test_bench_router_disagg_row(monkeypatch):
+    """Round-17 disaggregated-fleet row: role-split fleet vs the
+    co-resident baseline on one trace — victims stream through
+    Router.stream() under a storm, and the row must surface both
+    fleets' streaming-TPOT percentiles plus the transfer-bytes /
+    adoption-hit counters (obs session required, as main() provides),
+    with the storm actually taking the ship->adopt hop."""
+    import bench_serving as bs
+    from distkeras_tpu import obs
+
+    monkeypatch.setattr(bs, "_cfg", lambda window=None:
+                        _tiny_serving_cfg())
+    obs.enable()
+    try:
+        ratio, p99_s, _, extras = bs.bench_router_disagg()(
+            n_storm=6, n_victims=2, storm_new=2, victim_new=6,
+            lanes=2, n_stems=2, window=3)
+    finally:
+        obs.disable()
+    assert ratio > 0 and p99_s > 0
+    assert extras["storm_ok"] == 6 and extras["baseline_storm_ok"] == 6
+    for key in ("tpot_p50_ms", "tpot_p99_ms", "baseline_tpot_p50_ms",
+                "baseline_tpot_p99_ms", "ttft_p50_ms",
+                "baseline_ttft_p50_ms", "storm_rps",
+                "adoption_hit_rate", "transfer_mb", "warm_skips",
+                "fallbacks"):
+        assert key in extras
+    # The storm must ride the disaggregated hop, not fall back: the
+    # unique second block defeats the warm-skip gate on every request.
+    assert extras["disagg_requests"] > 0
+    assert extras["blocks_shipped"] > 0
+    assert extras["transfer_mb"] > 0
+    assert 0.0 <= extras["adoption_hit_rate"] <= 1.0
+
+
 def test_bench_serving_probe_failure_skips_all_rows(monkeypatch,
                                                     capsys):
     """Round-14 small fix: bench_serving.py under a dead accelerator

@@ -21,7 +21,8 @@ from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
 from distkeras_tpu.parallel.rules import kv_slab_specs, serving_kv_axis
 from distkeras_tpu.parallel.sharding import fsdp_plan, serving_plan
 from distkeras_tpu.serving import (ContinuousBatcher, InProcessReplica,
-                                   PagedBatcher, PrefixPool, Router)
+                                   PagedBatcher, PrefixPool, Router,
+                                   SpeculativeBatcher)
 from jax.sharding import PartitionSpec as P
 
 CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
@@ -224,6 +225,100 @@ def test_router_over_one_sharded_replica(params, tp2, rng):
     assert router.replicas_up() == ["pod0"]
 
 
+# ------------------------------------- elastic x plan (round 17)
+
+
+def test_sharded_elastic_cb_scales_with_parity(params, tp2, rng):
+    """lane_tiers= composes with plan= (round 17): sustained overflow
+    steps a pod-sharded engine's tier up through the pre-compiled
+    sharded resize gather, every request keeps exact solo parity, and
+    the drained engine steps back down."""
+    mesh, plan = tp2
+    eng = ContinuousBatcher(params, CFG, lane_tiers=(1, 2), max_queue=1,
+                            scale_up_after=1, scale_down_after=2,
+                            prompt_buckets=(8,), plan=plan, mesh=mesh)
+    assert eng.lanes == 1
+    prompts = _prompts(rng, lens=(5, 9, 7))
+    rids = [eng.enqueue(p, 5) for p in prompts]
+    assert eng.lanes == 2, "sharded elastic engine did not scale up"
+    while any(eng.poll(r) is None for r in rids):
+        eng.step()
+    for _ in range(4):
+        eng.step()
+    assert eng.lanes == 1, "idle sharded engine did not scale down"
+    res = eng.shutdown()
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(
+            res[rid].tokens,
+            np.asarray(generate(params, p[None], CFG, 5))[0])
+
+
+def test_sharded_elastic_paged_rows_only_resize(params, tp2, rng):
+    """Elastic paged x plan: a tier move gathers only row metadata —
+    the sharded slab stays put and the page tables remap host-side,
+    so requests decoding ACROSS the move keep exact parity and the
+    allocator drains clean.  fork() is rejected (lane ids are not
+    stable across a resize)."""
+    mesh, plan = tp2
+    eng = PagedBatcher(params, CFG, block=BLOCK, lane_tiers=(1, 2),
+                       max_queue=1, scale_up_after=1,
+                       scale_down_after=2, prompt_buckets=(8,),
+                       plan=plan, mesh=mesh)
+    with pytest.raises(ValueError, match="elastic"):
+        eng.fork(0, 1)
+    prompts = _prompts(rng, lens=(6, 10, 7))
+    ra = eng.enqueue(prompts[0], 6)
+    eng.step()                        # ra decodes at tier 1...
+    rbs = [eng.enqueue(p, 6) for p in prompts[1:]]   # ...resize here
+    assert eng.lanes == 2
+    rids = [ra, *rbs]
+    while any(eng.poll(r) is None for r in rids):
+        eng.step()
+    res = eng.shutdown()
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(
+            res[rid].tokens,
+            np.asarray(generate(params, p[None], CFG, 6))[0])
+    assert eng.allocator.stats()["used"] == 0
+
+
+# ------------------------------------ speculative x plan (round 17)
+
+SPEC_DRAFT = tfm.TransformerConfig(vocab_size=64, d_model=16,
+                                   n_heads=2, n_layers=1, d_ff=32,
+                                   max_len=32, rope=True)
+
+
+def test_sharded_speculative_greedy_parity(params, tp2, rng):
+    """plan= on the speculative engine (round 17): target sharded,
+    draft replicated — greedy output stays bit-exact vs the solo
+    pinned contract (greedy speculative IS greedy generate)."""
+    mesh, plan = tp2
+    draft = tfm.init_params(jax.random.key(8), SPEC_DRAFT)
+    eng = SpeculativeBatcher(params, draft, CFG, SPEC_DRAFT, lanes=2,
+                             n_draft=3, prompt_buckets=(8,),
+                             plan=plan, mesh=mesh)
+    prompts = _prompts(rng, lens=(5, 9))
+    lanes = [eng.submit(p, 8) for p in prompts]
+    while eng.running():
+        eng.step()
+    for lane, p in zip(lanes, prompts):
+        np.testing.assert_array_equal(
+            eng.drain(lane),
+            np.asarray(generate(params, p[None], CFG, 8))[0])
+
+
+def test_sharded_speculative_rejections(params, tp2):
+    mesh, plan = tp2
+    draft = tfm.init_params(jax.random.key(8), SPEC_DRAFT)
+    with pytest.raises(ValueError, match="plan= and mesh= together"):
+        SpeculativeBatcher(params, draft, CFG, SPEC_DRAFT, plan=plan)
+    pool = PrefixPool(CFG, slots=1, draft_cfg=SPEC_DRAFT)
+    with pytest.raises(ValueError, match="prefix_pool"):
+        SpeculativeBatcher(params, draft, CFG, SPEC_DRAFT,
+                           prefix_pool=pool, plan=plan, mesh=mesh)
+
+
 # --------------------------------------------------- rejection matrix
 
 
@@ -243,9 +338,6 @@ def test_rejection_matrix(params, tp2, devices):
     with pytest.raises(ValueError, match="not divisible"):
         PagedBatcher(params, CFG, block=BLOCK, plan=plan, mesh=mesh4)
 
-    with pytest.raises(ValueError, match="lane_tiers"):
-        ContinuousBatcher(params, CFG, lane_tiers=(1, 2), max_queue=1,
-                          plan=plan, mesh=mesh)
     with pytest.raises(ValueError, match="prompt_cache"):
         ContinuousBatcher(params, CFG, plan=plan, mesh=mesh,
                           prompt_cache=(jax.tree.map(
