@@ -21,14 +21,23 @@ The lint layers share one findings model (``findings.py``):
   the compiled-placement census (per-tensor shardings + per-device
   byte ledger vs ``scripts/shard_budget.json``) and resharding
   attribution over the same trace targets.
+* :mod:`~distkeras_tpu.analysis.contract_lint` — the coordination
+  contracts: the telemetry-schema census (every emission site's
+  name/kind/label-keys vs ``scripts/obs_schema.json``, consumer and
+  documentation resolution), the wire-protocol cross-check between
+  every HTTP server and its in-repo clients, and the resource-pairing
+  control-flow proof over ``serving/``.
 
 All honor the ``# dkt: ignore[rule]`` suppression syntax and are wired
 into CI through ``scripts/graph_lint.py`` and the tier-1 tests
 (``tests/test_graph_lint.py`` / ``tests/test_shard_lint.py`` /
-``tests/test_budget_guards.py``); see docs/graph_lint.md for the rule
-catalogue and the budget-update workflow.
+``tests/test_contract_lint.py`` / ``tests/test_budget_guards.py``);
+see docs/graph_lint.md for the rule catalogue and the budget-update
+workflow.
 """
 
+from distkeras_tpu.analysis.contract_lint import (build_obs_schema,
+                                                  lint_repo_contracts)
 from distkeras_tpu.analysis.findings import Finding, format_findings
 from distkeras_tpu.analysis.ir_lint import (CollectiveOp, TraceSpec,
                                              comm_census, lint_trace,
@@ -41,4 +50,4 @@ from distkeras_tpu.analysis.source_lint import lint_paths, lint_source
 __all__ = ["Finding", "format_findings", "TraceSpec", "CollectiveOp",
            "comm_census", "lint_trace", "trace_target", "lint_plan",
            "lint_repo_plans", "placement_census", "lint_source",
-           "lint_paths"]
+           "lint_paths", "build_obs_schema", "lint_repo_contracts"]

@@ -181,6 +181,10 @@ def merge_expositions(texts: dict) -> str:
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # The telemetry wire protocol — routes/params/status codes are
+    # censused by the contract lint against scripts/obs_schema.json;
+    # operator-only routes (no in-repo client) are itemized in
+    # contract_lint.OPERATOR_ROUTES.
     server_version = "dkt-telemetry/1.0"
 
     def log_message(self, *a):  # pragma: no cover — silence stderr

@@ -16,6 +16,12 @@ A trace JSONL (obs/trace.py) reconstructs into:
 ``compare`` diffs two reports for regression triage: per-phase total /
 mean deltas, histogram percentile deltas, counter deltas — the dynamic
 reality the static comm/compile budgets (PR 3) cannot see.
+
+This module is on the contract lint's consumer list
+(``contract_lint.CONSUMER_FILES``): every metric-name literal it
+compares against must resolve to a live producer, so a renamed
+emission fails the lint here instead of silently emptying a report
+section.
 """
 
 from __future__ import annotations

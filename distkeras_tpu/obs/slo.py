@@ -100,6 +100,12 @@ class SloEngine:
     counter and the subscribers.  ``clock`` is injectable so tests
     tick deterministically; :meth:`tick` is public for the same
     reason (the background thread just calls it every ``tick_s``).
+
+    The engine's emissions (``slo.breaches``/``slo.windowed``/the
+    ``slo.breach`` event) and the metric names its rules reference are
+    both sides of a contract-lint check: the shapes are pinned in
+    ``scripts/obs_schema.json`` and every referenced name must resolve
+    to a live producer — the autoscaler's input contract.
     """
 
     def __init__(self, registry, rules=(), *, tick_s: float = 1.0,

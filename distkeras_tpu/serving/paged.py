@@ -921,9 +921,18 @@ class PagedBatcher(ContinuousBatcher):
                         self._alloc.free(b)
                     self._decline("kv_blocks")
                     return None
-                self.cache = self._copy_block(
-                    self.cache, jnp.int32(src_blocks[j]),
-                    jnp.int32(bid))
+                try:
+                    self.cache = self._copy_block(
+                        self.cache, jnp.int32(src_blocks[j]),
+                        jnp.int32(bid))
+                except Exception:
+                    # The fresh block and the refcount bumps are not
+                    # yet reachable from any table row — roll them
+                    # back or they leak for the engine's lifetime.
+                    self._alloc.free(bid)
+                    for b in shared:
+                        self._alloc.free(b)
+                    raise
                 new_blocks.append(bid)
             self._lane_blocks[dst] = new_blocks
             row = self._tables_np[dst]

@@ -1484,6 +1484,10 @@ class EngineEndpoint:
         endpoint = self
 
         class Handler(BaseHTTPRequestHandler):
+            # The engine wire protocol — every route, query param, and
+            # status code here is censused by the contract lint and
+            # pinned in scripts/obs_schema.json; protocol changes must
+            # re-record via `graph_lint.py --contracts --update-budgets`.
             server_version = "dkt-engine/1.0"
 
             def log_message(self, *a):  # pragma: no cover — quiet

@@ -645,7 +645,9 @@ class SpeculativeBatcher(_LaneEngine):
         operator who knows the draft model is bad."""
         if not self._degraded:
             obs.count("serving.degraded")
-            obs.event("serving.degraded",
+            # Event name differs from the counter: one name must map
+            # to one instrument kind (contract lint, metric-collision).
+            obs.event("serving.degrade",
                       error=None if error is None else repr(error))
         self._degraded = True
         if error is not None and self.degraded_error is None:

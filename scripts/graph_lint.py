@@ -18,7 +18,12 @@ Runs, in order:
    axis divisibility, replicated giants) and the compiled-placement
    census of every target — per-tensor shardings + per-device byte
    ledger pinned in ``scripts/shard_budget.json``, resharding
-   collectives attributed to declared scopes.
+   collectives attributed to declared scopes;
+5. the **contract lint** (analysis/contract_lint.py): the telemetry
+   census of every emission site against ``scripts/obs_schema.json``,
+   consumer + documentation resolution, the wire-protocol cross-check
+   between every HTTP server and its in-repo clients, and the
+   resource-pairing control-flow analysis over ``serving/``.
 
 Exit 0 iff there are zero unsuppressed error/warn findings.  Usage::
 
@@ -27,7 +32,8 @@ Exit 0 iff there are zero unsuppressed error/warn findings.  Usage::
     python scripts/graph_lint.py --threads        # thread-safety rules only
     python scripts/graph_lint.py --ir-only        # IR + shard + budgets
     python scripts/graph_lint.py --shardings      # shard lint only
-    python scripts/graph_lint.py --update-budgets # re-record BOTH censuses
+    python scripts/graph_lint.py --contracts      # contract lint only, fast
+    python scripts/graph_lint.py --update-budgets # re-record ALL censuses
     python scripts/graph_lint.py --update-baseline # re-record warn ledger
     python scripts/graph_lint.py -v               # also print censuses
 
@@ -59,6 +65,7 @@ sys.path.insert(0, REPO)
 BUDGET_PATH = os.path.join(REPO, "scripts", "comm_budget.json")
 SHARD_BUDGET_PATH = os.path.join(REPO, "scripts", "shard_budget.json")
 BASELINE_PATH = os.path.join(REPO, "scripts", "lint_baseline.json")
+OBS_SCHEMA_PATH = os.path.join(REPO, "scripts", "obs_schema.json")
 
 
 def run_source(findings):
@@ -72,6 +79,20 @@ def run_threads(findings):
     from distkeras_tpu.analysis.thread_lint import lint_paths_threads
 
     findings += lint_paths_threads([os.path.join(REPO, "distkeras_tpu")])
+
+
+def run_contracts(findings, update: bool = False):
+    """The contract lint: pure-AST + JSON, no trace, no compile.  With
+    ``update`` the census is re-recorded into scripts/obs_schema.json
+    BEFORE the check, so the same invocation verifies what it wrote."""
+    from distkeras_tpu.analysis import contract_lint
+
+    if update:
+        contract_lint.save_obs_schema(
+            OBS_SCHEMA_PATH, contract_lint.build_obs_schema(REPO))
+        print(f"wrote {OBS_SCHEMA_PATH}")
+    findings += contract_lint.lint_repo_contracts(
+        REPO, schema_path=OBS_SCHEMA_PATH)
 
 
 def run_plan_lint(findings):
@@ -161,6 +182,12 @@ def main(argv):
                          "the plan lint over every shipped partition "
                          "plan plus the compiled-placement census vs "
                          "scripts/shard_budget.json")
+    ap.add_argument("--contracts", action="store_true",
+                    help="contract lint only (analysis/"
+                         "contract_lint.py): telemetry census vs "
+                         "scripts/obs_schema.json, wire-protocol "
+                         "cross-check, resource pairing — pure AST, "
+                         "no compile")
     ap.add_argument("--update-budgets", action="store_true")
     ap.add_argument("--update-baseline", action="store_true",
                     help="re-record scripts/lint_baseline.json from "
@@ -168,13 +195,22 @@ def main(argv):
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
+    if args.contracts and (args.source_only or args.ir_only
+                           or args.threads or args.shardings):
+        # Same parity as --threads/--shardings: one mode flag at a
+        # time, rejected before any heavy import is paid.
+        ap.error("--contracts runs the contract lint alone; it cannot "
+                 "combine with --source-only/--ir-only/--threads/"
+                 "--shardings")
     if args.update_baseline and (args.source_only or args.ir_only
-                                 or args.threads or args.shardings):
+                                 or args.threads or args.shardings
+                                 or args.contracts):
         # The ledger covers EVERY lint layer; re-recording from a
         # half-census would drop the other layers' keys and start
         # failing their previously-baselined warns on the next full run.
         ap.error("--update-baseline needs the full run (drop "
-                 "--source-only/--ir-only/--threads/--shardings)")
+                 "--source-only/--ir-only/--threads/--shardings/"
+                 "--contracts)")
     if args.threads and (args.source_only or args.ir_only
                          or args.shardings or args.update_budgets):
         # --threads skips the IR layer entirely: silently accepting a
@@ -213,9 +249,15 @@ def main(argv):
         run_plan_lint(findings)
         run_ir(findings, update=False, verbose=args.verbose,
                shardings_only=True)
+    elif args.contracts:
+        # --contracts --update-budgets re-records obs_schema.json
+        # alone; unlike --shardings this leaves nothing stale — the
+        # contract census never depends on the compile pass.
+        run_contracts(findings, update=args.update_budgets)
     else:
         if not args.ir_only:
             run_source(findings)
+            run_contracts(findings, update=args.update_budgets)
         if not args.source_only:
             run_plan_lint(findings)
             run_ir(findings, update=args.update_budgets,

@@ -285,6 +285,34 @@ SLOW = MULTIPROCESS | {
     "test_zero_stages::test_lm_zero3_clip_ema_matches_dp",
     "test_zero_stages::test_lm_zero3_device_data_matches_streaming",
     "test_zero_stages::test_lm_zero3_eval_matches_dp",
+    # Round-20 rebalance (contract-lint gate): the gate itself is
+    # pure-AST and cheap (~5 s for tests/test_contract_lint.py +
+    # the schema-equality guard), but the suite had crept to 896 s
+    # measured against the 870 s tier-1 wall, so the heaviest SECOND
+    # spellings of already-fast-covered contracts move to the merge
+    # gate.  What stays fast per subsystem: sharded serving — the
+    # residency-digest sharded-vs-solo parity, elastic-cb scaling,
+    # FSDP-plan serving, router-over-sharded-replica, prefix-pool and
+    # cb-sampled bit-exact legs; paged serving — chunked-prefill /
+    # sampled-per-request / CoW-fork / stem-sharing / admission-
+    # tolerance parities; disagg — greedy+role-exclusivity, seeded
+    # sampling, chunked prefill, export/import refcounts, cross-hop
+    # streaming, prefill-failure fallback; prefix pool — the engine
+    # parity + zero-prefix-work and speculative-pool greedy legs;
+    # bench contract — the paged and load/elastic/spec rows.  The
+    # moved tests re-spell those same contracts on a second axis
+    # (kv_int8 x prefill-agreement, sampled x sharded-paged,
+    # speculative x sharded, staggered-lane x paged, bench rows whose
+    # underlying router/disagg paths have dedicated fast tests) and
+    # run in the full merge suite.
+    "test_serving_sharded::test_sharded_paged_greedy_and_sampled_bit_exact",
+    "test_serving_sharded::test_sharded_speculative_greedy_parity",
+    "test_serving_paged::test_kv_int8_prefill_engine_agreement",
+    "test_serving_paged::test_paged_greedy_parity_staggered_and_lane_reuse",
+    "test_serving_fastpath::test_prefix_pool_sampled_kv_int8_and_lane_reuse",
+    "test_disagg::test_disagg_parity_kv_int8",
+    "test_bench_contract::test_bench_router_affinity_row",
+    "test_bench_contract::test_bench_router_disagg_row",
 }
 
 

@@ -2,8 +2,9 @@
 step programs, the collective census vs scripts/comm_budget.json, the
 ZeRO-1 parity proof, the shard lint's compiled-placement census vs
 scripts/shard_budget.json (+ the no-unattributed-resharding
-invariant), and the compile-count guard — so a budget regression
-fails the fast gate, not a reviewer's eyeball.
+invariant), the contract census vs scripts/obs_schema.json, and the
+compile-count guard — so a budget regression fails the fast gate, not
+a reviewer's eyeball.
 """
 
 import os
@@ -333,6 +334,23 @@ def test_compile_count_guard_passes():
         capture_output=True, text=True, timeout=540,
         cwd=ROOT)
     assert r.returncode == 0, r.stdout + "\n" + r.stderr
+
+
+def test_obs_schema_matches_recorded():
+    """The contract census — every emission site's name/kind/labels,
+    the dynamic-name allowlist, the scenario-event sweep, and the wire
+    route census — matches scripts/obs_schema.json exactly (re-record
+    intentional changes with graph_lint.py --update-budgets; the JSON
+    diff IS the contract review)."""
+    from distkeras_tpu.analysis import contract_lint
+
+    built = contract_lint.build_obs_schema(ROOT)
+    pinned = contract_lint.load_obs_schema(
+        os.path.join(ROOT, "scripts", "obs_schema.json"))
+    assert pinned is not None, (
+        "scripts/obs_schema.json missing — run graph_lint.py "
+        "--contracts --update-budgets")
+    assert built == pinned
 
 
 def test_graph_lint_cli_source_only_runs_clean():

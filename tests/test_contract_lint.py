@@ -1,0 +1,527 @@
+"""Contract lint (analysis/contract_lint.py): the telemetry-schema
+census, the wire-protocol cross-check, and the resource-pairing
+control-flow analysis — every rule exercised positive AND negative on
+toy sources, the schema round-trip, the repo-clean pin (zero findings,
+zero suppressions, empty baseline), the autoscaler input-signal
+contract (satellite of the same round), and the CLI mode-flag
+rejections, PR-9/PR-15 parity.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from distkeras_tpu.analysis import contract_lint as cl
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMA_PATH = os.path.join(ROOT, "scripts", "obs_schema.json")
+
+
+def _rules(findings, only_gating=False):
+    return [f.rule for f in findings if f.gating or not only_gating]
+
+
+# ============================================================ telemetry census
+
+
+def test_census_emits_covers_facade_registry_and_slo_emit():
+    src = textwrap.dedent("""
+        def tick(self):
+            obs.count("serving.requests", route="enqueue")
+            obs.gauge("serving.queue_depth", depth)
+            obs.observe("serving.ttft_s", dt, value=dt)
+            self.registry.counter("slo.breaches", "h").inc(
+                metric=name, q=q, **labels)
+            g = self.registry.gauge("slo.windowed", "h")
+            g.set(v, metric=name, q=q)
+            self._emit("slo.breach", metric=name, q=q, **labels)
+    """)
+    sites = {s.name: s for s in cl.census_emits(src)}
+    assert sites["serving.requests"].kind == "counter"
+    assert sites["serving.requests"].labels == frozenset({"route"})
+    assert sites["serving.queue_depth"].kind == "gauge"
+    # ``value`` is a histogram call parameter, not a label.
+    assert sites["serving.ttft_s"].labels == frozenset()
+    assert sites["slo.breaches"].kind == "counter"
+    assert sites["slo.breaches"].labels == {"metric", "q", "*"}
+    assert sites["slo.windowed"].kind == "gauge"
+    assert sites["slo.breach"].kind == "event"
+    assert sites["slo.breach"].labels == {"metric", "q", "*"}
+
+
+def test_census_skips_dynamic_name_sites():
+    src = textwrap.dedent("""
+        def probe(self):
+            obs.gauge(f"train.{k}", v)
+            obs.observe(metric, value, lock=self.name)
+    """)
+    assert cl.census_emits(src) == []
+    # ...which is exactly why the allowlist exists and is pinned.
+    assert "train.step_s" in cl.DYNAMIC_METRICS
+    assert "lock.wait_s" in cl.DYNAMIC_METRICS
+
+
+def test_metric_collision_positive_and_negative():
+    bad = textwrap.dedent("""
+        def a(self):
+            obs.count("serving.degraded")
+            obs.event("serving.degraded", error=err)
+    """)
+    _census, findings = cl.merge_census(cl.census_emits(bad))
+    assert _rules(findings) == ["metric-collision"]
+    good = bad.replace('obs.event("serving.degraded"',
+                       'obs.event("serving.degrade"')
+    census, findings = cl.merge_census(cl.census_emits(good))
+    assert findings == [] and len(census) == 2
+
+
+def _schema(metrics):
+    return {"metrics": metrics, "dynamic_metrics": [],
+            "scenario_events": []}
+
+
+def test_metric_drift_positive_and_negative():
+    pinned = _schema({"serving.requests": {"kind": "counter",
+                                           "labels": ["route"]}})
+    # Unpinned emission, vanished producer, kind change — each drifts.
+    added = _schema({**pinned["metrics"],
+                     "serving.extra": {"kind": "gauge", "labels": []}})
+    assert _rules(cl.check_obs_schema(added, pinned)) == ["metric-drift"]
+    gone = _schema({})
+    assert _rules(cl.check_obs_schema(gone, pinned)) == ["metric-drift"]
+    rekind = _schema({"serving.requests": {"kind": "event",
+                                           "labels": ["route"]}})
+    assert _rules(cl.check_obs_schema(rekind, pinned)) == ["metric-drift"]
+    # No schema recorded at all is itself a drift (bootstrap error).
+    assert _rules(cl.check_obs_schema(pinned, None)) == ["metric-drift"]
+    assert cl.check_obs_schema(pinned, pinned) == []
+
+
+def test_label_drift_positive_and_negative():
+    pinned = _schema({"router.replica_load": {"kind": "gauge",
+                                              "labels": ["replica"]}})
+    drifted = _schema({"router.replica_load": {"kind": "gauge",
+                                               "labels": ["shard"]}})
+    assert _rules(cl.check_obs_schema(drifted, pinned)) == ["label-drift"]
+    assert cl.check_obs_schema(pinned, pinned) == []
+
+
+def test_dynamic_and_scenario_sections_drift():
+    pinned = _schema({})
+    drifted = dict(pinned, dynamic_metrics=["train.step_s"])
+    assert _rules(cl.check_obs_schema(drifted, pinned)) == ["metric-drift"]
+
+
+def test_schema_round_trip(tmp_path):
+    schema = cl.build_obs_schema(ROOT)
+    p = str(tmp_path / "obs_schema.json")
+    cl.save_obs_schema(p, schema)
+    loaded = cl.load_obs_schema(p)
+    assert loaded == schema  # comment stripped, sets already sorted
+    assert cl.check_obs_schema(schema, loaded) == []
+    # The on-disk form carries the provenance comment.
+    assert "comment" in json.load(open(p))
+
+
+# ------------------------------------------------------- consumer references
+
+
+def test_consumer_refs_positive_and_noise_filtered():
+    src = textwrap.dedent("""
+        def report(events):
+            for e in events:
+                if e["name"] == "serving.nope":
+                    yield e
+                if e.get("name").startswith("router."):
+                    yield e
+            rule = SloRule("serving.ttft_s", q=0.99)
+            keys = ("serving.requests", "serving.queue_depth")
+            plan = [("cluster.push", 5, "fail")]    # fault site, not a ref
+            path = "runs/serving.jsonl"             # filename, not a ref
+    """)
+    refs = cl.consumer_refs(src, "toy.py", vocab={"serving", "router",
+                                                  "cluster"})
+    names = {(n, m) for n, _ln, m in refs}
+    assert ("serving.nope", "exact") in names
+    assert ("router.", "prefix") in names
+    assert ("serving.ttft_s", "exact") in names
+    assert ("serving.requests", "exact") in names
+    # Mixed tuples (chaos fault plans) and filenames stay out.
+    assert not any(n == "cluster.push" for n, _m in names)
+    assert not any(n.endswith(".jsonl") for n, _m in names)
+
+
+def test_documented_names_strips_label_suffixes():
+    doc = "| serving | `serving.requests{route}`, `slo.breach` | - |"
+    names = cl.documented_names(doc)
+    assert {"serving.requests", "slo.breach"} <= names
+
+
+# ================================================================ wire census
+
+
+SERVER_SRC = textwrap.dedent("""
+    class Handler:
+        def do_GET(self):
+            url = urlparse(self.path)
+            if url.path == "/healthz":
+                self._send(200 if self.up else 503, body)
+            elif url.path == "/poll":
+                q = parse_qs(url.query)
+                rid = q.get("id")
+                if rid is None:
+                    self._send(404, err)
+                else:
+                    self._send(200, out)
+
+        def do_POST(self):
+            routes = {"/enqueue": self._post_enqueue}
+
+        def _post_enqueue(self):
+            if full:
+                self._send(429, err)
+            self._send(200, out)
+""")
+
+CLIENT_SRC = textwrap.dedent("""
+    class Replica:
+        def health(self):
+            body, code = self._get("/healthz")
+            return code == 200
+
+        def poll(self, rid):
+            body, code = self._get(f"/poll?id={rid}")
+            if code == 404:
+                return None
+            return body
+
+        def submit(self, payload):
+            body, code = self._post("/enqueue", payload)
+            if code == 429:
+                raise Busy()
+            return body
+""")
+
+
+def _toy_wire(client_src=CLIENT_SRC):
+    servers = {"engine": cl.server_routes(SERVER_SRC, "srv.py")}
+    clients = {"engine": {}}
+    for c in cl.client_calls(client_src, "cli.py"):
+        ent = clients["engine"].setdefault(
+            c["route"], {"params": set(), "expects": set(), "sites": []})
+        ent["params"] |= c["params"]
+        ent["expects"] |= c["expects"]
+        ent["sites"].append(("cli.py", c["line"]))
+    return servers, clients
+
+
+def test_wire_census_extracts_routes_params_statuses():
+    servers, clients = _toy_wire()
+    srv = servers["engine"]
+    assert srv["GET /healthz"]["status"] == {200, 503}
+    assert srv["GET /poll"] == {"params": {"id"}, "status": {200, 404}}
+    assert srv["POST /enqueue"]["status"] == {200, 429}
+    assert clients["engine"]["GET /poll"]["params"] == {"id"}
+    assert clients["engine"]["GET /poll"]["expects"] == {404}
+    assert clients["engine"]["POST /enqueue"]["expects"] == {429}
+
+
+def test_route_drift_positive_and_negative():
+    servers, clients = _toy_wire()
+    pinned = cl._wire_doc(servers, clients)
+    assert cl.check_wire(servers, clients, pinned, "s.json") == []
+    # Orphan client route: nothing serves /nope.
+    orphan = CLIENT_SRC + textwrap.dedent("""
+        def probe(self):
+            body, code = self._get("/nope")
+    """)
+    servers, clients = _toy_wire(orphan)
+    fs = cl.check_wire(servers, clients,
+                       cl._wire_doc(servers, clients), "s.json")
+    assert _rules(fs) == ["route-drift"]
+    assert "/nope" in fs[0].message
+
+
+def test_route_param_drift_positive():
+    noisy = CLIENT_SRC.replace("/poll?id={rid}",
+                               "/poll?verbose=1&id={rid}")
+    servers, clients = _toy_wire(noisy)
+    fs = cl.check_wire(servers, clients,
+                       cl._wire_doc(servers, clients), "s.json")
+    assert _rules(fs) == ["route-drift"]
+    assert "'verbose'" in fs[0].message
+
+
+def test_status_drift_positive_and_negative():
+    dead = CLIENT_SRC.replace("if code == 429:", "if code == 418:")
+    servers, clients = _toy_wire(dead)
+    fs = cl.check_wire(servers, clients,
+                       cl._wire_doc(servers, clients), "s.json")
+    assert _rules(fs) == ["status-drift"]
+    assert fs[0].severity == "warn" and "418" in fs[0].message
+
+
+def test_served_route_without_client_or_operator_flag():
+    # Drop the /enqueue client: the POST route is now served-but-dead.
+    lone = CLIENT_SRC.replace('self._post("/enqueue", payload)',
+                              'self._post("/other", payload)')
+    servers, clients = _toy_wire(lone)
+    fs = cl.check_wire(servers, clients,
+                       cl._wire_doc(servers, clients), "s.json")
+    assert "route-drift" in _rules(fs)
+    assert any("/enqueue" in f.message and "no in-repo client"
+               in f.message for f in fs)
+
+
+def test_pinned_schema_wire_drift():
+    servers, clients = _toy_wire()
+    pinned = cl._wire_doc(servers, clients)
+    stale = json.loads(json.dumps(pinned))
+    stale["engine"]["GET /poll"]["status"] = [200]
+    fs = cl.check_wire(servers, clients, stale, "s.json")
+    assert _rules(fs) == ["route-drift"]
+    assert "pinned" in fs[0].message
+
+
+# ============================================================ resource pairing
+
+
+def _leaks(src):
+    return [f for f in cl.lint_resource_source(textwrap.dedent(src))
+            if not f.suppressed]
+
+
+def test_unbalanced_resource_exception_edge_positive_and_negative():
+    leaky = """
+        def grow(self):
+            bid = self._alloc.alloc()
+            self.cache = self._copy_block(self.cache, bid)
+            self._alloc.free(bid)
+    """
+    fs = _leaks(leaky)
+    assert _rules(fs) == ["unbalanced-resource"]
+    assert "_copy_block" in fs[0].message
+    fixed = """
+        def grow(self):
+            bid = self._alloc.alloc()
+            try:
+                self.cache = self._copy_block(self.cache, bid)
+            except Exception:
+                self._alloc.free(bid)
+                raise
+            self.slots.append(bid)
+    """
+    assert _leaks(fixed) == []
+
+
+def test_unbalanced_resource_try_finally_discharges():
+    src = """
+        def export(self):
+            h = self.pool.acquire()
+            try:
+                self._ship(h)
+                if short:
+                    return None
+            finally:
+                self.pool.release(h)
+    """
+    assert _leaks(src) == []
+
+
+def test_unbalanced_resource_handler_rollback_still_needs_normal_release():
+    src = """
+        def grow(self):
+            bid = self._alloc.alloc()
+            try:
+                self.cache = self._copy_block(self.cache, bid)
+            except Exception:
+                self._alloc.free(bid)
+                raise
+    """
+    fs = _leaks(src)
+    assert _rules(fs) == ["unbalanced-resource"]
+    assert "never released" in fs[0].message
+
+
+def test_unbalanced_resource_discarded_acquire():
+    fs = _leaks("""
+        def warm(self):
+            self._alloc.alloc()
+    """)
+    assert _rules(fs) == ["unbalanced-resource"]
+    assert "discarded" in fs[0].message
+
+
+def test_unbalanced_resource_vacuous_none_branch():
+    src = """
+        def take(self):
+            bid = self._alloc.alloc()
+            if bid is None:
+                return None
+            self.blocks.append(bid)
+    """
+    assert _leaks(src) == []
+    # ...but falling off the function still holding is a leak.
+    assert _rules(_leaks(src.replace("self.blocks.append(bid)",
+                                     "pass"))) == ["unbalanced-resource"]
+
+
+def test_unbalanced_resource_ownership_transfer_forms():
+    src = """
+        def lease(self):
+            h = self.pool.acquire()
+            return h
+
+        def stage(self):
+            bid = self._alloc.alloc()
+            self._staged[rid] = bid
+
+        def reply(self, endpoint):
+            pid = self.engine.pin_prefix(tokens)
+            self._send(200, pid)
+    """
+    assert _leaks(src) == []
+
+
+def test_unbalanced_resource_overwrite_before_release():
+    fs = _leaks("""
+        def twice(self):
+            bid = self._alloc.alloc()
+            bid = self._alloc.alloc()
+            self._alloc.free(bid)
+    """)
+    assert _rules(fs) == ["unbalanced-resource"]
+    assert "overwritten" in fs[0].message
+
+
+def test_unbalanced_resource_suppression_comment_honoured():
+    src = """
+        def warm(self):
+            bid = self._alloc.alloc()  # dkt: ignore[unbalanced-resource]
+    """
+    fs = cl.lint_resource_source(textwrap.dedent(src))
+    assert len(fs) == 1 and fs[0].suppressed and not fs[0].gating
+
+
+# =============================================================== repo-level pin
+
+
+def test_contract_lint_clean_on_repo():
+    """The gate the PR ships green: the WHOLE repo's contracts are
+    clean against the pinned schema with zero findings — not zero
+    gating findings, zero findings: no suppressions, nothing
+    baselined."""
+    findings = cl.lint_repo_contracts(ROOT, schema_path=SCHEMA_PATH)
+    assert findings == [], [f.format() for f in findings]
+    # The undocumented-metric baseline is EMPTY: every censused name
+    # is documented, so the warn ledger carries no contract debt.
+    ledger = json.load(open(
+        os.path.join(ROOT, "scripts", "lint_baseline.json")))
+    contract_rules = ("metric-", "label-", "dangling-", "undocumented-",
+                      "route-", "status-", "unbalanced-")
+    debt = [k for k in ledger.get("warn_counts", {})
+            if k.startswith(contract_rules)]
+    assert debt == [], debt
+
+
+def test_consumer_files_and_wire_files_exist():
+    """The configured census surfaces are real files — a moved consumer
+    or server module must update contract_lint's config, not silently
+    shrink the census."""
+    for rel in (list(cl.CONSUMER_FILES) + list(cl.WIRE_SERVER_FILES)
+                + list(cl.WIRE_CLIENT_FILES) + [cl.DOC_FILE]):
+        assert os.path.exists(os.path.join(ROOT, rel)), rel
+
+
+# ============================================== autoscaler input contract
+
+
+def test_autoscaler_input_signals_pinned():
+    """The producer<->consumer agreement the upcoming autoscaler closes
+    its loop on, pinned via the schema: the SLO breach event shape, the
+    queue/load gauges, and every default SLO metric resolving to a live
+    producer."""
+    schema = cl.load_obs_schema(SCHEMA_PATH)
+    m = schema["metrics"]
+    assert m["slo.breach"] == {
+        "kind": "event",
+        "labels": ["*", "metric", "q", "threshold", "value", "window_s"]}
+    assert m["slo.breaches"] == {"kind": "counter",
+                                 "labels": ["*", "metric", "q"]}
+    assert m["slo.windowed"] == {"kind": "gauge",
+                                 "labels": ["metric", "q"]}
+    assert m["serving.queue_depth"]["kind"] == "gauge"
+    assert m["router.replica_load"] == {"kind": "gauge",
+                                        "labels": ["replica"]}
+    assert m["serving.kv_blocks_free"]["kind"] == "gauge"
+    from distkeras_tpu.obs.slo import DEFAULT_SLO_METRICS
+    for name in DEFAULT_SLO_METRICS:
+        assert name in m or name in schema["dynamic_metrics"], name
+
+
+def test_residency_digest_fields_match_router_reader():
+    """The residency digest the cache-aware router builds its affinity
+    table from: PagedBatcher.residency() publishes the fields, and the
+    router reads them under the SAME keys — checked statically so a
+    renamed field fails here, not in a fleet."""
+    import ast
+
+    src = open(os.path.join(
+        ROOT, "distkeras_tpu", "serving", "paged.py")).read()
+    keys = set()
+    for node in ast.walk(ast.parse(src)):
+        if (isinstance(node, ast.FunctionDef)
+                and node.name == "residency"):
+            for n in ast.walk(node):
+                if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Subscript)
+                        and isinstance(n.targets[0].slice, ast.Constant)):
+                    keys.add(n.targets[0].slice.value)
+    assert {"block", "stem_hashes", "prefix_ids",
+            "kv_blocks_free"} <= keys, keys
+    router = open(os.path.join(
+        ROOT, "distkeras_tpu", "serving", "router.py")).read()
+    reads = set()
+    for node in ast.walk(ast.parse(router)):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            reads.add(node.args[0].value)
+    assert {"stem_hashes", "prefix_ids"} <= reads
+    # ...and the engine wire family serves the digest route.
+    schema = cl.load_obs_schema(SCHEMA_PATH)
+    assert "GET /residency" in schema["wire"]["engine"]
+
+
+# ================================================================ CLI parity
+
+
+@pytest.mark.parametrize("argv,needle", [
+    (["--contracts", "--source-only"], "cannot combine"),
+    (["--contracts", "--ir-only"], "cannot combine"),
+    (["--contracts", "--threads"], "cannot combine"),
+    (["--contracts", "--shardings"], "cannot combine"),
+    (["--contracts", "--update-baseline"], "full run"),
+])
+def test_graph_lint_cli_rejects_contracts_combos(argv, needle):
+    """PR-9/PR-15 parity: conflicting mode combos exit at argparse,
+    before the heavy jax import is paid."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "graph_lint.py")]
+        + argv, capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert r.returncode != 0 and needle in r.stderr, r.stderr
+
+
+def test_graph_lint_cli_contracts_runs_clean():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "graph_lint.py"),
+         "--contracts"],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    assert "0 finding(s)" in r.stdout
