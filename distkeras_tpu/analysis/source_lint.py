@@ -88,7 +88,12 @@ _JAX_FREE_FILES = tuple(
               "report.py")) + (
     os.path.join("distkeras_tpu", "utils", "locks.py"),
     os.path.join("distkeras_tpu", "serving", "router.py"),
-    os.path.join("distkeras_tpu", "serving", "residency.py"))
+    os.path.join("distkeras_tpu", "serving", "residency.py"),
+    # Round 19: the autoscaling control plane and its trace-replay
+    # load driver — scaling policy and load generation are host
+    # bookkeeping; neither may ever compile a program.
+    os.path.join("distkeras_tpu", "serving", "autoscale.py"),
+    os.path.join("distkeras_tpu", "serving", "traffic.py"))
 
 
 def _attr_chain(node) -> list[str]:

@@ -52,6 +52,8 @@ SITES = (
     "cluster.heartbeat",  # HeartbeatWriter: before every beat publishes
     "cluster.push",     # AsyncPlane.push: before a host's delta publishes
     "cluster.merge",    # AsyncPlane aggregation wave: before center applies
+    "autoscale.join",   # Autoscaler scale-up: between warm-pool take
+                        # and the join health gate (round 19)
 )
 
 

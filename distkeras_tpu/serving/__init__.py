@@ -35,6 +35,15 @@ did against the old ``serving.py``.  Layout:
   :class:`EngineEndpoint` HTTP admission server.
 - :mod:`~distkeras_tpu.serving.residency` — the jax-free chain-hash
   digest language the paged engine and the router share.
+- :mod:`~distkeras_tpu.serving.autoscale` — :class:`Autoscaler` +
+  :class:`WarmPool`: the jax-free SLO-driven autoscaling control
+  plane (round 19) — warm-pool zero-compile scale-up, lossless
+  drain-and-reroute scale-down, hysteresis/cooldown, and the
+  pinned-state retire guard.
+- :mod:`~distkeras_tpu.serving.traffic` — :class:`TraceReplay`: the
+  seeded deterministic trace-replay load driver (diurnal / spike /
+  locality-shuffle / tenant-mix shapes; pure function of
+  ``(seed, tick)``) the autoscale benches and chaos legs replay.
 - :mod:`~distkeras_tpu.serving.disagg` — :class:`BlockShipment` and
   the jax-free block-transfer wire codec for disaggregated
   prefill/decode fleets (round 17): a prefill replica exports a
@@ -56,6 +65,8 @@ by tests/test_serving.py and tests/test_speculative.py.
 
 from distkeras_tpu.serving.admission import (EngineClosed, QueueFull,
                                              RequestResult)
+from distkeras_tpu.serving.autoscale import (Autoscaler,
+                                             AutoscalePolicy, WarmPool)
 from distkeras_tpu.serving.disagg import (BlockShipment,
                                           decode_shipment,
                                           encode_shipment)
@@ -68,6 +79,8 @@ from distkeras_tpu.serving.router import (EngineEndpoint, HttpReplica,
                                           ReplicaUnreachable, Router,
                                           discover_replicas)
 from distkeras_tpu.serving.speculative import SpeculativeBatcher
+from distkeras_tpu.serving.traffic import (TRACE_SHAPES, TraceReplay,
+                                           TraceRequest)
 
 __all__ = [
     "ContinuousBatcher",
@@ -85,6 +98,12 @@ __all__ = [
     "BlockShipment",
     "encode_shipment",
     "decode_shipment",
+    "Autoscaler",
+    "AutoscalePolicy",
+    "WarmPool",
+    "TraceReplay",
+    "TraceRequest",
+    "TRACE_SHAPES",
     "RequestResult",
     "QueueFull",
     "EngineClosed",

@@ -576,19 +576,22 @@ class Router:
         obs.event("router.replica_join", replica=name, up=ok,
                   epoch=self.epoch)
 
-    def remove_replica(self, name: str) -> None:
+    def remove_replica(self, name: str):
         """Leave: reroute the replica's unfinished requests, then drop
-        it from membership."""
+        it from membership.  Returns the detached replica handle —
+        the autoscaler pools a retired (still-warm) handle for later
+        re-admission; other callers may ignore it."""
         with self._lock:
             if name not in self._members:
                 raise KeyError(f"unknown replica {name!r}")
             self._members[name].draining = True
             self.epoch += 1
             self._reroute_from_locked(name, why="removed")
-            del self._members[name]
+            handle = self._members.pop(name).replica
             self._affinity.pop(name, None)
         obs.event("router.replica_leave", replica=name,
                   epoch=self.epoch)
+        return handle
 
     def drain_replica(self, name: str) -> None:
         """Graceful drain: stop routing to the replica and re-admit
@@ -755,13 +758,48 @@ class Router:
         with self._lock:
             return len(self._pending)
 
-    # --------------------------------------------------------- routing
+    # ----------------------------------------------------- fleet state
 
-    def _load_key(self, m: _Member):
-        q, busy, lanes = m.replica.load()
-        load = (busy + q) / max(lanes, 1) + m.inflight
-        obs.gauge("router.replica_load", load, replica=m.replica.name)
-        return load
+    def fleet_snapshot(self) -> dict:
+        """One CONSISTENT read of the whole fleet under a single lock
+        acquisition: ``{"epoch", "pending", "closed", "replicas":
+        {name: {...}}}`` with per-replica up/draining/degraded flags,
+        live load (``queue_depth``/``lanes_busy``/``lanes`` plus the
+        router's ``inflight`` debit and the combined ``load`` scoring
+        key), role, and the affinity view (``prefix_ids`` /
+        ``stems`` / ``block``).
+
+        This is THE fleet-state read: the route scorer, the disagg
+        planner, and the autoscaler all consume it (round 19), so a
+        membership flip can never be observed torn against the load
+        fields it changes — the ad-hoc per-field reads those
+        consumers used to make individually are gone."""
+        with self._lock:
+            return self._fleet_snapshot_locked()
+
+    def _fleet_snapshot_locked(self) -> dict:
+        now = self._clock()
+        reps = {}
+        for n, m in self._members.items():
+            q, busy, lanes = m.replica.load()
+            load = (busy + q) / max(lanes, 1) + m.inflight
+            obs.gauge("router.replica_load", load, replica=n)
+            tab = self._affinity.get(n, {})
+            reps[n] = {
+                "up": m.up, "draining": m.draining,
+                "degraded": m.degraded_until > now,
+                "inflight": m.inflight,
+                "role": getattr(m.replica, "role", None),
+                "queue_depth": q, "lanes_busy": busy, "lanes": lanes,
+                "load": load,
+                "prefix_ids": frozenset(tab.get("prefix_ids", ())),
+                "stems": len(tab.get("stem_hashes", ())),
+                "block": tab.get("block"),
+            }
+        return {"epoch": self.epoch, "pending": len(self._pending),
+                "closed": self._closed, "replicas": reps}
+
+    # --------------------------------------------------------- routing
 
     def _candidates_locked(self, req: _Routed, exclude):
         now = self._clock()
@@ -830,11 +868,16 @@ class Router:
             return True
         if not cands and not rerouting:
             raise RuntimeError("router has no live replicas")
+        del now
+        # ONE consistent fleet read scores every candidate: the
+        # degraded flag and the load key come from the same snapshot
+        # (round 19 — the scorer can never see them torn).
+        fleet = self._fleet_snapshot_locked()["replicas"]
         scored = []
         for m in cands:
             s = (self._affinity_score(req, m.replica.name)
                  if self.policy == "affinity" else 0)
-            degraded = 1 if m.degraded_until > now else 0
+            degraded = 1 if fleet[m.replica.name]["degraded"] else 0
             scored.append((m, s, degraded))
         if self.policy == "round_robin":
             order = sorted(scored, key=lambda t: t[2])
@@ -843,9 +886,10 @@ class Router:
             self._rr += 1
         else:
             order = sorted(
-                scored, key=lambda t: (-t[1], t[2],
-                                       self._load_key(t[0]),
-                                       t[0].replica.name))
+                scored,
+                key=lambda t: (-t[1], t[2],
+                               fleet[t[0].replica.name]["load"],
+                               t[0].replica.name))
         if prefer is not None:
             # Stable re-sort: the preferred replica front-runs, the
             # rest keep their relative order (spillover path intact).
@@ -968,7 +1012,8 @@ class Router:
             if all(h in resident for h in stems):
                 obs.count("router.disagg_warm_skips")
                 return None
-        name, _m = min(pre, key=lambda t: (self._load_key(t[1]), t[0]))
+        fleet = self._fleet_snapshot_locked()["replicas"]
+        name, _m = min(pre, key=lambda t: (fleet[t[0]]["load"], t[0]))
         return name
 
     def _disagg_enqueue(self, req: _Routed, prefill_name: str) -> bool:
@@ -1007,14 +1052,17 @@ class Router:
                 return False
             if not cands:
                 return False
+            del now
+            fleet = self._fleet_snapshot_locked()["replicas"]
             scored = [(m2,
                        self._affinity_score(req, m2.replica.name)
                        if self.policy == "affinity" else 0,
-                       1 if m2.degraded_until > now else 0)
+                       1 if fleet[m2.replica.name]["degraded"] else 0)
                       for m2 in cands]
             order = sorted(scored,
                            key=lambda t: (-t[1], t[2],
-                                          self._load_key(t[0]),
+                                          fleet[t[0].replica.name]
+                                          ["load"],
                                           t[0].replica.name))
             target = order[0][0].replica
             tname = target.name

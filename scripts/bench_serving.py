@@ -1681,6 +1681,213 @@ def bench_router_disagg():
     return run
 
 
+def _autoscale_leg(trace, engines, n_start, policy, *, ticks,
+                   steps_per_tick, stem_len, tail_len, vocab):
+    """One policy leg of the autoscale harness: replay ``trace`` over
+    a fleet built from ``engines`` under a VIRTUAL clock — each tick
+    injects that tick's arrivals, steps every serving replica
+    ``steps_per_tick`` decode steps (service capacity is steps, not
+    wall time, so the whole leg is deterministic), and, when
+    ``policy`` is an Autoscaler factory, runs one scaling decision.
+    ``n_start`` engines begin in the route table; the rest are parked
+    in the warm pool (idle = not burning replica-ticks).  Returns
+    ``(ttft_ticks, replica_ticks, decisions, lost)`` where
+    ``ttft_ticks[(tick, index)]`` is first-token latency in ticks for
+    every completed arrival."""
+    import numpy as np
+
+    from distkeras_tpu.serving import (InProcessReplica, QueueFull,
+                                       Router, WarmPool)
+
+    vclock = [0.0]
+    replicas = [InProcessReplica(f"r{i}", e)
+                for i, e in enumerate(engines)]
+    router = Router(replicas[:n_start], clock=lambda: vclock[0])
+    scaler = None
+    if policy is not None:
+        pool = WarmPool(replicas[n_start:])
+        scaler = policy(router, pool)
+    arrival: dict = {}     # key -> arrival tick
+    first: dict = {}       # key -> first-token tick
+    rid_of: dict = {}      # key -> fleet request id
+    retry: list = []       # QueueFull'd (key, prompt, max_new)
+    replica_ticks = 0
+
+    def inject(tick, items):
+        still = []
+        for key, prompt, max_new in items:
+            try:
+                rid_of[key] = router.enqueue(prompt, max_new)
+            except QueueFull:
+                still.append((key, prompt, max_new))
+        del tick
+        return still
+
+    def observe_first(tick):
+        # First-token detection off the live transcripts (the same
+        # read Router.stream relays; chaos_suite reads the same
+        # private tables for its timeline assertions).
+        for key, rid in rid_of.items():
+            if key in first:
+                continue
+            res = router.poll(rid)
+            req = router._requests.get(rid)
+            part = None
+            if res is not None:
+                part = res
+            elif req is not None and req.replica is not None:
+                m = router._members.get(req.replica)
+                if m is not None and req.replica_rid is not None:
+                    part = m.replica.partial(req.replica_rid)
+            if part is not None and \
+                    np.asarray(part.tokens).size > int(part.prompt_len):
+                first[key] = tick
+
+    t = 0
+    while True:
+        draining = t >= ticks
+        if not draining:
+            vclock[0] = float(t)
+            reqs = trace.replay(t)
+            items = [((r.tick, r.index),
+                      trace.prompt(r, stem_len=stem_len,
+                                   tail_len=tail_len, vocab=vocab),
+                      r.max_new) for r in reqs]
+            for key, _p, _n in items:
+                arrival[key] = t
+            retry = inject(t, retry + items)
+        else:
+            vclock[0] = float(t)
+            retry = inject(t, retry)
+        replica_ticks += len(router.replicas_up())
+        for _ in range(steps_per_tick):
+            router.step()
+        observe_first(t)
+        if scaler is not None:
+            scaler.tick()
+        if draining and not retry \
+                and all(router.poll(r) is not None
+                        for r in rid_of.values()):
+            break
+        t += 1
+        if t > ticks + 400:
+            break  # wedged leg: report what completed as lost
+    results = {k: router.poll(rid) for k, rid in rid_of.items()}
+    lost = [k for k in arrival
+            if k not in rid_of or results.get(k) is None
+            or results[k].status != "ok"]
+    ttft = {k: first[k] - arrival[k] for k in first}
+    decisions = scaler.decisions if scaler is not None else []
+    return ttft, replica_ticks, decisions, lost
+
+
+def bench_autoscale(shape):
+    """Policy-vs-policy autoscaling rows (round 19): the SAME
+    deterministic :class:`TraceReplay` trace replayed over three
+    fleet policies — static at the MINIMUM replica count, static at
+    the MAXIMUM, and autoscaled between them by the
+    :class:`Autoscaler` (warm-pool scale-up, drain-and-reroute
+    scale-down) — under a virtual clock where service capacity is
+    decode steps per tick, so every leg (arrivals, queue build-up,
+    scaling decisions) is bit-reproducible.  Value = static-min p99
+    TTFT over autoscaled p99 TTFT through the hot window (>1 means
+    the autoscaler beat the small fleet); extras carry the
+    replica-ticks each policy burned (autoscaled must undercut
+    static-max — elasticity's cost claim), the scaling-decision
+    timeline, and a repeat-run determinism check over the decision
+    audit trail."""
+    def run(ticks=36, min_replicas=1, max_replicas=4, lanes=2,
+            steps_per_tick=4, seed=0, base_rate=2.0, spike_rate=14.0,
+            spike_at=10, spike_len=8, peak_rate=10.0, period=32,
+            stem_len=8, tail_len=2, max_queue=256):
+        import numpy as np
+
+        from distkeras_tpu import obs
+        from distkeras_tpu.serving import (AutoscalePolicy, Autoscaler,
+                                           ContinuousBatcher,
+                                           TraceReplay)
+
+        cfg = _cfg()
+        params = _params()
+
+        def trace():
+            return TraceReplay(shape, seed=seed, base_rate=base_rate,
+                               peak_rate=peak_rate, period=period,
+                               spike_at=spike_at, spike_len=spike_len,
+                               spike_rate=spike_rate, stems=4,
+                               max_new=(3, 5))
+
+        def engines(n):
+            return [ContinuousBatcher(
+                params, cfg, lanes=lanes, max_queue=max_queue,
+                prompt_buckets=(stem_len + tail_len - 1,))
+                for _ in range(n)]
+
+        def scaler_factory(router, pool):
+            sc = Autoscaler(router, pool, policy=AutoscalePolicy(
+                min_replicas=min_replicas, max_replicas=max_replicas,
+                up_threshold=0.9, down_threshold=0.3, up_after=1,
+                down_after=3, cooldown_ticks=1))
+            return sc
+
+        kw = dict(ticks=ticks, steps_per_tick=steps_per_tick,
+                  stem_len=stem_len, tail_len=tail_len,
+                  vocab=cfg.vocab_size)
+        legs = {}
+        legs["static_min"] = _autoscale_leg(
+            trace(), engines(min_replicas), min_replicas, None, **kw)
+        legs["static_max"] = _autoscale_leg(
+            trace(), engines(max_replicas), max_replicas, None, **kw)
+        legs["autoscaled"] = _autoscale_leg(
+            trace(), engines(max_replicas), min_replicas,
+            scaler_factory, **kw)
+        repeat = _autoscale_leg(
+            trace(), engines(max_replicas), min_replicas,
+            scaler_factory, **kw)
+
+        if shape == "spike":
+            hot = range(spike_at, spike_at + spike_len)
+        else:
+            hot = range(period // 4, (3 * period) // 4)
+        hot = set(hot)
+
+        def hot_p99(leg):
+            ttft = [v for (tick, _i), v in leg[0].items()
+                    if tick in hot]
+            return float(np.percentile(ttft, 99)) if ttft else 0.0
+
+        timeline = [(d["tick"], d["action"], d["replica"])
+                    for d in legs["autoscaled"][2]]
+        timeline2 = [(d["tick"], d["action"], d["replica"])
+                     for d in repeat[2]]
+        extras = {
+            "shape": shape, "ticks": ticks, "seed": seed,
+            "min_replicas": min_replicas,
+            "max_replicas": max_replicas,
+            "deterministic_timeline": timeline == timeline2,
+            "scaling_changes": sum(1 for _, a, _r in timeline
+                                   if a in ("up", "down")),
+        }
+        for name, leg in legs.items():
+            extras[f"{name}_ttft_p99_ticks"] = round(hot_p99(leg), 2)
+            extras[f"{name}_replica_ticks"] = leg[1]
+            extras[f"{name}_lost"] = len(leg[3])
+        sess = obs.active()
+        if sess is not None:
+            snap = sess.registry.snapshot()
+
+            def total(name):
+                return int(sum(s["value"] for s in
+                               snap.get(name, {}).get("series", [])))
+            extras["scale_ups"] = total("autoscale.scale_ups")
+            extras["scale_downs"] = total("autoscale.scale_downs")
+            extras["offered_requests"] = total("traffic.requests")
+        p99_auto = extras["autoscaled_ttft_p99_ticks"]
+        p99_min = extras["static_min_ttft_p99_ticks"]
+        return (p99_min / max(p99_auto, 1e-9), p99_auto, 0.0, extras)
+    return run
+
+
 BENCHES = {
     "decode_greedy_b1": (bench_greedy(1), "tokens/sec/chip"),
     "decode_greedy_b8": (bench_greedy(8), "tokens/sec/chip"),
@@ -1771,6 +1978,14 @@ BENCHES = {
     # block shipping vs the co-resident baseline on the same trace —
     # value is the victims' streaming-TPOT p99 immunity ratio.
     "router_disagg": (bench_router_disagg(), "x speedup"),
+    # Round 19: policy-vs-policy autoscaling on the deterministic
+    # trace-replay harness — static-min vs static-max vs autoscaled
+    # on the SAME (seed, tick) trace; value is the p99-TTFT edge over
+    # the static-minimum fleet through the hot window.
+    "autoscale_spike": (bench_autoscale("spike"),
+                        "x ttft vs static-min"),
+    "autoscale_diurnal": (bench_autoscale("diurnal"),
+                          "x ttft vs static-min"),
 }
 
 

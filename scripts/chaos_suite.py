@@ -891,6 +891,244 @@ def run_router_prefill_kill_scenario(seed, workdir, n_wave1=4,
     return failures
 
 
+def run_autoscale_spike_scenario(seed, workdir, ticks=14, spike_at=3,
+                                 spike_len=6):
+    """The round-19 autoscaling leg: one active replica (host0) plus
+    two warm-pool replicas (host1, host2) behind the Autoscaler, a
+    flash-spike trace driving the router hot, and a SIGKILL of the
+    FIRST warm-pool replica exactly as the scale-up reaches for it.
+    The join must abort cleanly (no route-table entry ever exists for
+    the dead replica), the spike must be absorbed by the surviving
+    warm replica, every accepted request must complete (zero lost),
+    and the fleet must scale back down losslessly once the spike
+    drains.  Returns the number of failed assertions (0 = green)."""
+    import glob
+    import json
+    import urllib.request
+
+    import numpy as np
+
+    from distkeras_tpu import obs
+    from distkeras_tpu.obs.report import merge_traces
+    from distkeras_tpu.serving.autoscale import (Autoscaler,
+                                                 AutoscalePolicy,
+                                                 WarmPool)
+    from distkeras_tpu.serving.router import HttpReplica, Router
+    from distkeras_tpu.serving.traffic import TraceReplay
+    from distkeras_tpu.utils import locks
+
+    print("== cluster scenario: autoscale_spike (warm-pool scale-up "
+          "under join-time death) ==", flush=True)
+    base = os.path.join(workdir, "autoscale_spike")
+    coord = os.path.join(base, "coord")
+    tracedir = os.path.join(base, "traces")
+    os.makedirs(tracedir, exist_ok=True)
+    os.makedirs(coord, exist_ok=True)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(base, "replica.py")
+    with open(script, "w", encoding="utf-8") as f:
+        f.write(ROUTER_CHILD.format(repo=repo, tracedir=tracedir,
+                                    seed=seed))
+    ports = [_free_port(), _free_port(), _free_port()]
+
+    def launch(h):
+        import subprocess
+
+        env = {**os.environ,
+               "DKT_CLUSTER_DIR": coord,
+               "DKT_CLUSTER_HOST": str(h),
+               "DKT_CLUSTER_NHOSTS": "3",
+               "DKT_CLUSTER_WINDOW": "2.0",
+               "DKT_SERVE_PORT": str(ports[h])}
+        return subprocess.Popen([sys.executable, script], env=env)
+
+    def wait_port(h, deadline):
+        import time as _time
+
+        while True:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{ports[h]}/healthz",
+                    timeout=1.0).read()
+                return
+            except Exception:  # noqa: BLE001 — still starting
+                assert _time.time() < deadline, \
+                    f"replica {h} never came up on port {ports[h]}"
+                _time.sleep(0.2)
+
+    import time as _time
+
+    locks.enable_sanitizer()
+    children = [launch(0), launch(1), launch(2)]
+    router_trace = os.path.join(tracedir, "router.jsonl")
+    failures = 0
+    sess = None
+    try:
+        for h in range(3):
+            wait_port(h, _time.time() + 180)
+        sess = obs.enable(trace_path=router_trace)
+        # host0 serves from the start; host1/host2 sit pre-compiled in
+        # the warm pool with NO route-table entry until a scale-up
+        # health-gates them in.
+        # residency_interval=0.2: every pump refreshes the cached
+        # queue_depth/lanes_busy the autoscaler's utilization signal
+        # reads — without it the tiny engines drain each tick's
+        # arrivals before the 2s default refresh ever sees them hot.
+        router = Router(
+            [HttpReplica("host0", f"127.0.0.1:{ports[0]}")],
+            policy="least_loaded", health_interval=0.3,
+            residency_interval=0.2)
+        pool = WarmPool([
+            HttpReplica("host1", f"127.0.0.1:{ports[1]}"),
+            HttpReplica("host2", f"127.0.0.1:{ports[2]}")])
+        asc = Autoscaler(router, pool, policy=AutoscalePolicy(
+            min_replicas=1, max_replicas=2, up_threshold=0.9,
+            down_threshold=0.2, up_after=1, down_after=3,
+            cooldown_ticks=1))
+        # Long decodes (max_new=16) at a rate one 2-lane replica
+        # cannot drain inside a tick: the spike piles queue depth the
+        # refreshed residency makes visible, driving utilization past
+        # the scale-up threshold.  Pre-spike the trickle stays under
+        # it, so the FIRST scale-up lands inside the spike — after
+        # host1 is dead.
+        trace = TraceReplay("spike", seed=seed, base_rate=0.3,
+                            spike_at=spike_at, spike_len=spike_len,
+                            spike_rate=20.0, max_new=(4, 8))
+        # SIGKILL the FIFO head of the warm pool before the spike can
+        # reach for it: the scale-up's join health gate must race the
+        # death — abort cleanly, admit the survivor.
+        children[1].kill()
+        children[1].wait(timeout=30)
+        print("  killed warm-pool replica 1 ahead of the join",
+              flush=True)
+        rids, retry = [], []
+        for t in range(ticks):
+            arrivals = (retry
+                        + [trace.prompt(r, stem_len=8, tail_len=4,
+                                        vocab=64) for r in
+                           trace.requests_at(t)])
+            retry = []
+            for p in arrivals:
+                try:
+                    rids.append(router.enqueue(np.asarray(
+                        p, np.int32), 16))
+                except Exception:  # noqa: BLE001 — backpressure
+                    retry.append(p)
+            router.pump()
+            asc.tick()
+            _time.sleep(0.15)
+        ups = [d for d in asc.decisions if d["action"] == "up"]
+        assert ups, "the flash spike never triggered a scale-up"
+        assert all(d["replica"] == "host2" for d in ups), (
+            f"a dead warm-pool replica was admitted: {ups}")
+        snap = router.fleet_snapshot()
+        assert "host1" not in snap["replicas"], (
+            "SIGKILLed warm-pool replica holds a route-table entry")
+        assert "host2" in router.replicas_up(), (
+            "surviving warm replica never joined the fleet")
+        # Zero lost: every accepted request completes across the
+        # aborted join and the scale-up.
+        deadline = _time.time() + 300
+        done = {}
+        while len(done) < len(rids):
+            assert _time.time() < deadline, (
+                f"autoscale_spike stalled: {len(done)}/{len(rids)} "
+                f"done, up={router.replicas_up()}")
+            router.pump()
+            for r in rids:
+                if r not in done and router.poll(r) is not None:
+                    done[r] = router.take(r)
+            _time.sleep(0.05)
+        lost = [r for r, v in done.items() if not v.ok]
+        assert not lost, (
+            f"requests lost across the spike: "
+            f"{[(r, done[r].status) for r in lost]}")
+        reg = sess.registry.snapshot()
+
+        def _total(name):
+            return sum(s.get("value", 0) for s in
+                       reg.get(name, {}).get("series", []))
+
+        assert _total("autoscale.join_aborts") >= 1, (
+            "the killed warm-pool replica produced no join abort")
+        # Spike drained: the idle fleet scales back down to the
+        # envelope floor, pooling the retired still-warm handle.
+        deadline = _time.time() + 60
+        while len(router.replicas_up()) > 1:
+            assert _time.time() < deadline, (
+                "fleet never scaled back down after the spike "
+                f"(up={router.replicas_up()})")
+            router.pump()
+            asc.tick()
+            _time.sleep(0.2)
+        assert len(pool) >= 1, \
+            "retired replica handle was not returned to the warm pool"
+        print(f"  PASS  cluster/autoscale_spike: {len(rids)} "
+              f"request(s) ok across the spike, scale-up to "
+              f"{ups[0]['replica']} after "
+              f"{int(_total('autoscale.join_aborts'))} join "
+              "abort(s), fleet back at the floor", flush=True)
+    except Exception as e:  # noqa: BLE001 — report the ladder
+        failures += 1
+        print(f"  FAIL  cluster/autoscale_spike: "
+              f"{type(e).__name__}: {e}")
+    finally:
+        if sess is not None:
+            obs.disable()
+        for h in (0, 1, 2):
+            with open(os.path.join(coord, f"stop{h}"), "w"):
+                pass
+        for c in children:
+            try:
+                c.wait(timeout=60)
+            except Exception:  # noqa: BLE001 — force it down
+                c.kill()
+
+    # Merged cross-process timeline: the scaling decisions and the
+    # join abort must be visible, and the surviving replicas must
+    # report clean lock ledgers (host1 died mid-join — no report).
+    traces = sorted(glob.glob(os.path.join(tracedir, "*.jsonl")))
+    merged = merge_traces(traces)
+    print("--- cross-process autoscale timeline (autoscale_spike, "
+          "JSONL) ---")
+    for e in merged["timeline"]:
+        if e["name"].startswith(("autoscale", "router.reroute",
+                                 "locks")):
+            print(json.dumps({"t": round(e["t"], 4),
+                              "host": e["host"], "event": e["name"],
+                              **e["fields"]}))
+    decisions = [e for e in merged["timeline"]
+                 if e["name"] == "autoscale.decision"]
+    if not any(e["fields"].get("action") == "up" for e in decisions):
+        failures += 1
+        print("  FAIL  cluster/autoscale_spike: no scale-up decision "
+              "in the merged timeline")
+    if not any(e["fields"].get("action") == "abort"
+               for e in decisions):
+        failures += 1
+        print("  FAIL  cluster/autoscale_spike: no join-abort "
+              "decision in the merged timeline")
+    reports = [e for e in merged["timeline"]
+               if e["name"] == "locks.report"]
+    hosts_reported = {e["fields"].get("host") for e in reports}
+    if not hosts_reported >= {0, 2}:
+        failures += 1
+        print(f"  FAIL  cluster/autoscale_spike: lock report missing "
+              f"for replica(s) {sorted({0, 2} - hosts_reported)}")
+    bad = [e for e in reports if e["fields"].get("violations")]
+    if bad:
+        failures += 1
+        print("  FAIL  cluster/autoscale_spike: lock sanitizer "
+              "violation(s) in replica report(s)")
+    if locks.violation_count():
+        failures += 1
+        print("  FAIL  cluster/autoscale_spike: router-process lock "
+              "sanitizer violations:")
+        for v in locks.violations():
+            print("  VIOLATION " + v.format())
+    return failures
+
+
 # SLO breach classes (metric names) the cluster ladder tolerates.
 # Empty on purpose: the in-child rule (train.step_s p99 < 60s over a
 # 30s window) is generous enough that ANY breach means a real latency
@@ -1158,6 +1396,9 @@ def run_cluster_ladder(scenarios, seed, workdir):
     if "serve_kill_prefill" in scenarios:
         scenarios.remove("serve_kill_prefill")
         failures += run_router_prefill_kill_scenario(seed, workdir)
+    if "autoscale_spike" in scenarios:
+        scenarios.remove("autoscale_spike")
+        failures += run_autoscale_spike_scenario(seed, workdir)
     if not scenarios:
         return failures
 
@@ -1276,13 +1517,15 @@ def main():
                          "ladder instead of the single-host matrix")
     ap.add_argument("--scenarios",
                     default="kill,stall,drop,serve_kill,"
-                            "serve_kill_prefill,"
+                            "serve_kill_prefill,autoscale_spike,"
                             "async_stall,async_kill_push",
                     help="--cluster fault kinds to run "
                          "(kill = host loss, stall = wedged heartbeat "
                          "writer, drop = partition, serve_kill = "
                          "kill-a-serving-replica-mid-stream under the "
-                         "router, async_stall = bounded-staleness "
+                         "router, autoscale_spike = flash-spike "
+                         "scale-up with a warm-pool replica SIGKILLed "
+                         "mid-join, async_stall = bounded-staleness "
                          "straggler in the async tier, async_kill_push "
                          "= host loss mid-delta-publish)")
     ap.add_argument("--workdir", default=None,

@@ -808,6 +808,70 @@ def session_spec_sharded():
         "(_warm_sharded) with live-matching placements")
 
 
+def session_serving_autoscale():
+    """Autoscaling control plane (round 19): ONE active paged replica
+    plus a pre-compiled warm-pool replica behind the Autoscaler.
+    Engine construction compiles everything (the recorded budget — a
+    warm-cache delta after the serving_router session, which runs the
+    identical geometry); the entire ELASTIC phase — saturate, the
+    health-gated warm-pool join, serving on the freshly joined
+    replica, lossless drain-and-retire scale-down, and serving after
+    the shrink — is asserted to compile ZERO programs: a scale-up is
+    a route-table insert of an already-warm engine, never a compile,
+    and a scale-down is the router's existing drain-and-reroute."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.serving import (Autoscaler, AutoscalePolicy,
+                                       InProcessReplica, PagedBatcher,
+                                       Router, WarmPool)
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    engines = [PagedBatcher(params, cfg, lanes=2, block=8, n_blocks=17,
+                            max_queue=4, prompt_buckets=(8,))
+               for _ in range(2)]
+    built = _COMPILES["n"]
+    router = Router([InProcessReplica("r0", engines[0])])
+    pool = WarmPool([InProcessReplica("w0", engines[1])])
+    asc = Autoscaler(router, pool, policy=AutoscalePolicy(
+        min_replicas=1, max_replicas=2, up_after=1, down_after=1,
+        cooldown_ticks=0))
+    rng = np.random.default_rng(0)
+    stem = rng.integers(0, 64, (8,)).astype(np.int32)
+    # Saturate past r0's bounded queue: the spillover backlog votes
+    # hot and the next tick admits w0 from the warm pool.
+    rids = [router.enqueue(np.concatenate(
+        [stem, rng.integers(0, 64, (4,)).astype(np.int32)]), 5)
+        for _ in range(6)]
+    rec = asc.tick()
+    assert rec["action"] == "up" and rec["replica"] == "w0", \
+        f"saturated fleet did not scale up: {rec}"
+    while any(router.poll(r) is None for r in rids):
+        router.step()
+        router.pump()
+    assert all(router.take(r).status == "ok" for r in rids)
+    # Idle fleet scales back down; the retire is the router's
+    # drain-and-reroute, and the handle returns to the pool warm.
+    rec = asc.tick()
+    assert rec["action"] == "down", f"idle fleet held: {rec}"
+    assert len(router.replicas_up()) == 1 and len(pool) == 1
+    after = router.enqueue(np.concatenate(
+        [stem, rng.integers(0, 64, (4,)).astype(np.int32)]), 5)
+    while router.poll(after) is None:
+        router.step()
+    assert router.take(after).status == "ok"
+    serve = _COMPILES["n"] - built
+    assert serve == 0, (
+        f"autoscale join/retire cycle compiled {serve} program(s); a "
+        "warm-pool join must be a route-table insert of an already-"
+        "warm engine and a retire the existing drain-and-reroute — "
+        "never device work")
+
+
 SESSIONS = {
     "adag": lambda: session_adag(),
     "adag_zero1": lambda: session_adag(zero1=True),
@@ -871,6 +935,10 @@ SESSIONS = {
     "serving_sharded_elastic": session_serving_sharded_elastic,
     "serving_disagg": session_serving_disagg,
     "spec_sharded": session_spec_sharded,
+    # Round 19: the autoscaler's warm-pool join + scale-down cycle is
+    # ASSERTED zero-compile inside the session (appended LAST so every
+    # earlier warm-cache budget delta is unchanged).
+    "serving_autoscale": session_serving_autoscale,
 }
 
 

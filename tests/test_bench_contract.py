@@ -373,3 +373,41 @@ def test_bench_paged_rows(monkeypatch):
     assert ratio > 0 and fork_s > 0
     assert extras["fork_ms"] > 0 and extras["cache_copy_ms"] > 0
     assert extras["bytes_ratio"] > 1
+
+
+def test_bench_autoscale_row(monkeypatch):
+    """Round-19 policy-vs-policy row: the SAME deterministic spike
+    trace over static-min, static-max, and autoscaled fleets under
+    the virtual clock.  The autoscaled leg must beat static-min on
+    hot-window p99 TTFT while burning fewer replica-ticks than
+    static-max, lose NOTHING, and reproduce its scaling-decision
+    timeline on a repeat run (the `autoscale.decision` audit trail)."""
+    import bench_serving as bs
+    from distkeras_tpu import obs
+
+    monkeypatch.setattr(bs, "_cfg", lambda window=None:
+                        _tiny_serving_cfg())
+    sess = obs.enable()
+    try:
+        value, p99_auto, _, extras = bs.bench_autoscale("spike")(
+            ticks=16, min_replicas=1, max_replicas=2, lanes=2,
+            steps_per_tick=3, spike_at=4, spike_len=5,
+            spike_rate=7.0, base_rate=0.5)
+    finally:
+        obs.disable()
+    assert value > 1.0, (
+        f"autoscaled p99 TTFT did not beat static-min: {extras}")
+    assert (extras["autoscaled_replica_ticks"]
+            < extras["static_max_replica_ticks"]), (
+        "elasticity burned as many replica-ticks as the static "
+        f"maximum fleet: {extras}")
+    assert extras["deterministic_timeline"], (
+        "two same-seed runs produced different scaling decisions")
+    assert extras["autoscaled_lost"] == 0
+    assert extras["static_max_lost"] == 0
+    assert extras["scale_ups"] >= 1
+    assert extras["offered_requests"] > 0
+    for key in ("static_min_ttft_p99_ticks", "scaling_changes",
+                "autoscaled_ttft_p99_ticks", "shape"):
+        assert key in extras
+    assert p99_auto == extras["autoscaled_ttft_p99_ticks"]

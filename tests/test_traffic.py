@@ -1,0 +1,172 @@
+"""Trace-replay driver (round 19): the load signal feeding the
+autoscaling control plane must be bit-reproducible — same seed =>
+identical tick-by-tick schedule across runs and across generation
+order (the PR-16 AsyncSchedule contract) — and each trace shape must
+actually produce its advertised distribution (ramp monotone, spike
+amplitude, locality-shuffle destroying stem reuse, tenant-mix
+weights)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.serving.traffic import (TRACE_SHAPES, TraceReplay,
+                                           TraceRequest)
+
+
+def _schedule(trace, ticks):
+    return [trace.requests_at(t) for t in range(ticks)]
+
+
+@pytest.mark.parametrize("shape", TRACE_SHAPES)
+def test_same_seed_identical_schedule(shape):
+    """Two independently constructed traces with the same seed emit
+    the IDENTICAL tick-by-tick request schedule — every field of
+    every arrival, not just counts (frozen-dataclass equality)."""
+    a = _schedule(TraceReplay(shape, seed=7), 64)
+    b = _schedule(TraceReplay(shape, seed=7), 64)
+    assert a == b
+    assert any(len(t) > 0 for t in a), "trace emitted nothing in 64 ticks"
+
+
+def test_ticks_are_independent_draws():
+    """Ticks use independent SeedSequence streams, so generating them
+    in any order (or skipping around) reproduces the same schedule —
+    a replay can seek."""
+    tr = TraceReplay("spike", seed=3)
+    fwd = [tr.requests_at(t) for t in range(32)]
+    rev = [tr.requests_at(t) for t in reversed(range(32))][::-1]
+    assert fwd == rev
+    assert tr.requests_at(17) == fwd[17]
+
+
+def test_different_seed_different_schedule():
+    a = _schedule(TraceReplay("diurnal", seed=0), 64)
+    b = _schedule(TraceReplay("diurnal", seed=1), 64)
+    assert a != b
+
+
+def test_diurnal_ramp_monotone():
+    """The diurnal envelope rises monotonically to the peak at
+    period/2 and falls monotonically back — the slow swing the
+    scale-up/scale-down hysteresis must track."""
+    tr = TraceReplay("diurnal", base_rate=1.0, peak_rate=9.0,
+                     period=40)
+    rates = [tr.rate(t) for t in range(40)]
+    up, down = rates[:21], rates[20:]
+    assert all(b >= a for a, b in zip(up, up[1:]))
+    assert all(b <= a for a, b in zip(down, down[1:]))
+    assert max(rates) == pytest.approx(9.0)
+    assert rates[0] == pytest.approx(1.0)
+
+
+def test_spike_amplitude():
+    """Inside the flash window the offered rate is spike_rate and the
+    realized arrival mean tracks it; outside it is base_rate."""
+    tr = TraceReplay("spike", seed=5, base_rate=2.0, spike_at=10,
+                     spike_len=64, spike_rate=16.0)
+    assert tr.rate(9) == 2.0 and tr.rate(10 + 64) == 2.0
+    assert all(tr.rate(t) == 16.0 for t in range(10, 74))
+    in_spike = [len(tr.requests_at(t)) for t in range(10, 74)]
+    before = [len(tr.requests_at(t)) for t in range(10)]
+    assert np.mean(in_spike) == pytest.approx(16.0, rel=0.25)
+    assert np.mean(in_spike) > 3 * max(np.mean(before), 0.5)
+
+
+def test_shuffle_destroys_stem_locality():
+    """The adversarial shape: the steady shapes reuse a small stem
+    pool (repeats are what the affinity table keys on); ``shuffle``
+    gives every request a UNIQUE stem so no two prompts share a warm
+    prefix."""
+    steady = TraceReplay("tenant_mix", seed=2, base_rate=4.0, stems=4)
+    shuffled = TraceReplay("shuffle", seed=2, base_rate=4.0, stems=4)
+    s_reqs = [r for t in range(40) for r in steady.requests_at(t)]
+    x_reqs = [r for t in range(40) for r in shuffled.requests_at(t)]
+    assert len(s_reqs) > 40 and len(x_reqs) > 40
+    assert len({r.stem for r in s_reqs}) <= 4
+    assert len({r.stem for r in x_reqs}) == len(x_reqs)
+    # Prompt-level check: shared stem => shared stem_len prefix;
+    # unique stems => distinct prefixes.
+    by_stem = {}
+    for r in s_reqs:
+        by_stem.setdefault(r.stem, []).append(r)
+    grp = next(g for g in by_stem.values() if len(g) >= 2)
+    p0 = steady.prompt(grp[0], stem_len=6, tail_len=2)
+    p1 = steady.prompt(grp[1], stem_len=6, tail_len=2)
+    assert np.array_equal(p0[:6], p1[:6])
+    q0 = shuffled.prompt(x_reqs[0], stem_len=6, tail_len=2)
+    q1 = shuffled.prompt(x_reqs[1], stem_len=6, tail_len=2)
+    assert not np.array_equal(q0[:6], q1[:6])
+
+
+def test_tails_unique_across_trace():
+    tr = TraceReplay("spike", seed=1, spike_rate=20.0, spike_len=16)
+    tails = [r.tail for t in range(40) for r in tr.requests_at(t)]
+    assert len(tails) == len(set(tails))
+
+
+def test_tenant_mix_weights():
+    tr = TraceReplay("tenant_mix", seed=9, base_rate=8.0,
+                     tenants=(("a", 3.0), ("b", 1.0)))
+    reqs = [r for t in range(80) for r in tr.requests_at(t)]
+    counts = {n: sum(1 for r in reqs if r.tenant == n)
+              for n in ("a", "b")}
+    assert counts["a"] + counts["b"] == len(reqs)
+    assert counts["a"] / max(counts["b"], 1) == pytest.approx(3.0,
+                                                             rel=0.3)
+
+
+def test_max_new_range_and_request_fields():
+    tr = TraceReplay("diurnal", seed=4, max_new=(2, 6))
+    reqs = [r for t in range(32) for r in tr.requests_at(t)]
+    assert all(2 <= r.max_new <= 6 for r in reqs)
+    assert all(isinstance(r, TraceRequest) for r in reqs)
+    assert all(r.tick < 32 and r.index >= 0 for r in reqs)
+
+
+def test_prompt_deterministic_and_typed():
+    tr = TraceReplay("spike", seed=0)
+    r = TraceRequest(tick=3, index=0, tenant="t0", stem=1, tail=99,
+                     max_new=4)
+    p1 = tr.prompt(r, stem_len=5, tail_len=3, vocab=32)
+    p2 = tr.prompt(r, stem_len=5, tail_len=3, vocab=32)
+    assert np.array_equal(p1, p2)
+    assert p1.dtype == np.int32 and p1.size == 8
+    assert (p1 >= 0).all() and (p1 < 32).all()
+
+
+def test_replay_emits_offered_load_audit_trail():
+    """``replay`` is ``requests_at`` plus the audit emissions: the
+    per-tick offered gauge and one counter increment per arrival,
+    labeled by shape and tenant."""
+    from distkeras_tpu import obs
+
+    tr = TraceReplay("spike", seed=5, base_rate=6.0)
+    sess = obs.enable()
+    try:
+        total = sum(len(tr.replay(t)) for t in range(8))
+        snap = sess.registry.snapshot()
+    finally:
+        obs.disable()
+    assert total > 0
+    counted = sum(s["value"] for s in
+                  snap["traffic.requests"]["series"])
+    assert int(counted) == total
+    assert any(s["labels"].get("shape") == "spike"
+               for s in snap["traffic.offered"]["series"])
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        TraceReplay("nope")
+    with pytest.raises(ValueError):
+        TraceReplay("spike", base_rate=0.0)
+    with pytest.raises(ValueError):
+        TraceReplay("spike", stems=0)
+    with pytest.raises(ValueError):
+        TraceReplay("spike", max_new=(0, 4))
+    with pytest.raises(ValueError):
+        TraceReplay("spike", tenants=())
+    with pytest.raises(ValueError):
+        TraceReplay("spike", tenants=(("a", -1.0),))
+    with pytest.raises(ValueError):
+        TraceReplay("spike").requests_at(-1)
