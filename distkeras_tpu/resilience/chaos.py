@@ -54,6 +54,12 @@ SITES = (
     "cluster.merge",    # AsyncPlane aggregation wave: before center applies
     "autoscale.join",   # Autoscaler scale-up: between warm-pool take
                         # and the join health gate (round 19)
+    "publish.commit",   # SnapshotPublisher: between the bucket writes
+                        # and the atomic manifest rename — a kill here
+                        # leaves a torn snapshot no reader adopts
+                        # (round 20)
+    "canary.promote",   # CanaryController: between the canary gate
+                        # passing and the fleet-wide swap (round 20)
 )
 
 

@@ -49,6 +49,19 @@ did against the old ``serving.py``.  Layout:
   prefill/decode fleets (round 17): a prefill replica exports a
   prompt's KV blocks, the router ships them, a decode replica adopts
   them by page-table splice.
+- :mod:`~distkeras_tpu.serving.publish` — :class:`SnapshotPublisher`
+  / :class:`SnapshotReader` (round 20): the trainer side of the live
+  train→serve weight push — versioned param snapshots in the same
+  dtype-grouped fusion buckets the gradient exchange wires (optional
+  int8 coding), published atomically (bucket files → checksummed
+  manifest → version pointer) so a reader NEVER adopts a torn
+  publish.
+- :mod:`~distkeras_tpu.serving.canary` — :class:`CanaryController`
+  (round 20): SLO-gated canary rollout of a published version over a
+  ``hot_swap=True`` fleet — canary-subset swap, pinned-prompt
+  logit-drift probe, promote-or-rollback under a bumped router
+  epoch; rollback is first-class (the ``train_kill_push`` /
+  ``canary_bad_push`` chaos legs).
 
 The reference has no serving story at all (its ModelPredictor runs the
 training forward over a static batch — reference:
@@ -67,6 +80,7 @@ from distkeras_tpu.serving.admission import (EngineClosed, QueueFull,
                                              RequestResult)
 from distkeras_tpu.serving.autoscale import (Autoscaler,
                                              AutoscalePolicy, WarmPool)
+from distkeras_tpu.serving.canary import CanaryController
 from distkeras_tpu.serving.disagg import (BlockShipment,
                                           decode_shipment,
                                           encode_shipment)
@@ -74,6 +88,11 @@ from distkeras_tpu.serving.lanes import (KV_INT8_LANE_ADVISORY,
                                          ContinuousBatcher)
 from distkeras_tpu.serving.paged import BlockAllocator, PagedBatcher
 from distkeras_tpu.serving.prefix import PinnedStems, PrefixPool
+from distkeras_tpu.serving.publish import (SnapshotCorrupt,
+                                           SnapshotError,
+                                           SnapshotPublisher,
+                                           SnapshotReader,
+                                           StaleSnapshot)
 from distkeras_tpu.serving.router import (EngineEndpoint, HttpReplica,
                                           InProcessReplica,
                                           ReplicaUnreachable, Router,
@@ -101,6 +120,12 @@ __all__ = [
     "Autoscaler",
     "AutoscalePolicy",
     "WarmPool",
+    "SnapshotPublisher",
+    "SnapshotReader",
+    "SnapshotError",
+    "SnapshotCorrupt",
+    "StaleSnapshot",
+    "CanaryController",
     "TraceReplay",
     "TraceRequest",
     "TRACE_SHAPES",

@@ -1,0 +1,275 @@
+"""SLO-gated canary rollout of published weight versions.
+
+The policy half of the train→serve loop (``serving/publish.py`` is the
+transport): a :class:`CanaryController` pushes version N+1 to a small
+canary subset of the router's fleet, gates on a pinned-prompt
+logit-drift probe plus the per-replica SLO state the router already
+tracks (the ``breach_demoter``'s ``degraded`` flag over live SLO
+windows), and then either promotes the version fleet-wide or rolls the
+canaries back to version N — **rollback is the first-class path**: it
+is exactly a ``swap_params(old, allow_downgrade=True)`` per canary,
+exercised by the ``canary_bad_push`` chaos leg (drift probe trips →
+automatic rollback, zero lost requests) and by ``train_kill_push``
+(trainer SIGKILLed mid-publish → the torn snapshot is never even
+offered to a canary).
+
+Both the promote and the rollback commit under a bumped router
+membership epoch (:meth:`Router.bump_epoch`): a weight push changes
+what the fleet serves, so route state made under the old version set
+is re-stamped the same way a drain re-stamps it.
+
+The drift probe is ONE jitted program compiled at construction —
+``max |logits_new - logits_old|`` over a pinned prompt, NaN mapped to
++inf so a poisoned push (the classic silent-NaN checkpoint) always
+trips regardless of threshold.  It runs on fresh zero caches, so it
+never touches an engine's serving state.
+
+Locking: ``serving.canary`` is the OUTERMOST serving-plane lock — a
+rollout takes it, then the router's ``serving.router`` lock (via
+``fleet_snapshot``/``bump_epoch``), then each engine's admission lock
+(via ``swap_params``); never the reverse (docs/concurrency.md).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu import obs
+from distkeras_tpu.resilience import chaos
+from distkeras_tpu.serving.publish import SnapshotCorrupt
+from distkeras_tpu.utils.locks import TracedLock
+
+__all__ = ["CanaryController"]
+
+
+def _make_drift_probe(cfg):
+    """The jitted pinned-prompt probe: greedy logits of the candidate
+    vs the incumbent params over fresh zero caches.  Returns a scalar
+    drift (max-abs over every prompt position's logits), with NaN
+    mapped to +inf — a NaN anywhere means the candidate cannot be
+    compared, which must TRIP the gate, not sneak past a ``>``
+    comparison that NaN always fails."""
+    from distkeras_tpu.models.generate import _decode_chunk, init_cache
+
+    def drift(params_new, params_old, rows):
+        pos = jnp.zeros((1,), jnp.int32)
+        new_logits, _ = _decode_chunk(
+            params_new, init_cache(cfg, 1), rows, pos, cfg,
+            uniform_pos=True)
+        old_logits, _ = _decode_chunk(
+            params_old, init_cache(cfg, 1), rows, pos, cfg,
+            uniform_pos=True)
+        d = jnp.max(jnp.abs(new_logits.astype(jnp.float32)
+                            - old_logits.astype(jnp.float32)))
+        return jnp.where(jnp.isnan(d), jnp.inf, d)
+
+    return jax.jit(drift)
+
+
+class CanaryController:
+    """Push → gate → promote-or-rollback over a router's fleet.
+
+    ``router``: the :class:`~distkeras_tpu.serving.router.Router`
+    whose in-process replicas wrap ``hot_swap=True`` engines.
+    ``reader``: a :class:`~distkeras_tpu.serving.publish.
+    SnapshotReader` for :meth:`poll` (may be None when the caller
+    feeds :meth:`rollout` directly).  ``cfg``/``template``: the model
+    config and a param pytree (arrays or ShapeDtypeStructs) — the
+    drift probe compiles against them at construction, so a rollout
+    never compiles anything (the ``serving_weight_push`` session pins
+    it).
+
+    ``canary``: how many replicas take the push first.  ``max_drift``:
+    the finite drift budget (default +inf: only a NaN/Inf candidate
+    trips — set it when the deploy has a known logit tolerance).
+    ``probe_prompt``: the pinned token prompt the probe scores.
+
+    The SLO half of the gate is the router's own state: a canary whose
+    ``degraded`` flag is set in the post-push fleet snapshot (the
+    ``breach_demoter`` flips it when that replica's live SLO window
+    breaches) fails the gate exactly like drift does.
+    """
+
+    def __init__(self, router, reader, cfg, template, *, canary: int = 1,
+                 max_drift: float = float("inf"),
+                 probe_prompt=(1, 2, 3)):
+        if canary < 1:
+            raise ValueError(f"canary must be >= 1, got {canary}")
+        prompt = [int(t) for t in probe_prompt]
+        if not prompt:
+            raise ValueError("probe_prompt must carry >= 1 token")
+        self.router = router
+        self.reader = reader
+        self.cfg = cfg
+        self.template = template
+        self.canary = int(canary)
+        self.max_drift = float(max_drift)
+        self._rows = jnp.asarray([prompt], jnp.int32)
+        self._lock = TracedLock("serving.canary")
+        self._probe = _make_drift_probe(cfg)
+        # Compile the probe NOW: a rollout is serve-phase, and its
+        # zero-compile budget covers the probe too.  Zero trees carry
+        # the template's exact avals (uncommitted, like engine params).
+        zeros = jax.tree.map(
+            lambda leaf: jnp.zeros(leaf.shape, leaf.dtype), template)
+        float(self._probe(zeros, zeros, self._rows))
+        # The last successfully promoted (version, tree) — the
+        # rollback source once version 1 has been promoted; before
+        # that, canaries roll back to each engine's own live tree.
+        self._good: tuple | None = None
+        # Versions a rollout rejected: :meth:`poll` quarantines them
+        # so a gate-tripped publish is pushed ONCE, not re-pushed on
+        # every tick until the trainer publishes something newer.
+        self._rejected: set[int] = set()
+
+    # ------------------------------------------------------------ gate
+
+    def _drift(self, new_tree, old_tree) -> float:
+        new_j = jax.tree.map(jnp.asarray, new_tree)
+        old_j = jax.tree.map(jnp.asarray, old_tree)
+        drift = float(self._probe(new_j, old_j, self._rows))
+        obs.observe("canary.drift", drift)
+        return drift
+
+    # --------------------------------------------------------- rollout
+
+    def rollout(self, version: int, tree) -> dict:
+        """Run one full push of ``tree`` as ``version``: canary swap →
+        drift + SLO gate → promote fleet-wide or roll the canaries
+        back.  Returns the rollout record
+        ``{"action", "version", "drift", "canaries", "promoted"}``.
+
+        Atomic from the fleet's point of view: on ANY failure —
+        gate trip, a mid-swap exception, a chaos fault at the
+        ``canary.promote`` probe — every replica that saw version
+        ``version`` is rolled back to what it served before, and the
+        epoch is bumped so routing state never straddles the attempt.
+        """
+        version = int(version)
+        with self._lock:
+            return self._rollout_locked(version, tree)
+
+    def _rollout_locked(self, version: int, tree) -> dict:
+        snap = self.router.fleet_snapshot()
+        handles = self.router.replica_handles()
+        eligible = sorted(
+            n for n, r in snap["replicas"].items()
+            if r["up"] and not r["draining"]
+            and hasattr(handles[n], "swap_params"))
+        if not eligible:
+            raise ValueError(
+                "no eligible replicas: a rollout needs >= 1 up, "
+                "non-draining replica wrapping a hot_swap=True engine")
+        canaries = eligible[:self.canary]
+        rest = eligible[self.canary:]
+        old = self._good[1] if self._good is not None else None
+        obs.event("canary.push", version=version,
+                  canaries=len(canaries), fleet=len(eligible))
+        # ---- canary swap (stash each replica's incumbent for the
+        # rollback path; reading it through the handle keeps version N
+        # alive however this attempt ends).
+        swapped: list = []
+        try:
+            for n in canaries:
+                incumbent = (old if old is not None
+                             else handles[n].engine.params)
+                from_v = handles[n].param_version()
+                handles[n].swap_params(tree, version)
+                swapped.append((n, incumbent, from_v))
+            drift = self._drift(tree, swapped[0][1])
+            post = self.router.fleet_snapshot()
+            degraded = [n for n in canaries
+                        if post["replicas"][n]["degraded"]
+                        or not post["replicas"][n]["up"]]
+            # Non-finite drift ALWAYS trips — ``inf <= inf`` would
+            # otherwise wave a NaN push through the default budget.
+            tripped = (not math.isfinite(drift)
+                       or drift > self.max_drift or bool(degraded))
+            if tripped:
+                return self._rollback(
+                    version, swapped, drift,
+                    reason=("slo_degraded" if degraded
+                            else "drift"))
+            # ---- promote: the canaries passed; the rest of the
+            # fleet follows, then the epoch commits the new version
+            # set.  A fault injected at the probe site lands AFTER
+            # the gate but BEFORE any non-canary swap — the rollback
+            # below must leave the whole fleet on the incumbent.
+            chaos.probe("canary.promote", step=version)
+            for n in rest:
+                handles[n].swap_params(tree, version)
+        except Exception:
+            self._rollback(version, swapped, None, reason="error")
+            raise
+        self.router.bump_epoch(f"canary promote v{version}")
+        self._good = (version, tree)
+        if self.reader is not None:
+            self.reader.adopt(version)
+        obs.count("canary.promotions")
+        obs.event("canary.rollout", action="promote", version=version,
+                  drift=drift, canaries=len(canaries),
+                  promoted=len(eligible))
+        return {"action": "promote", "version": version,
+                "drift": drift, "canaries": list(canaries),
+                "promoted": len(eligible)}
+
+    def _rollback(self, version: int, swapped, drift,
+                  reason: str) -> dict:
+        for n, incumbent, from_v in swapped:
+            # allow_downgrade: THE legitimate monotonicity exception.
+            n_handle_swap_ok = True
+            try:
+                # Re-fetch nothing: the handle in ``swapped`` is the
+                # one we pushed through; an engine that died between
+                # push and rollback surfaces here, not silently.
+                self.router.replica_handles()[n].swap_params(
+                    incumbent, from_v, allow_downgrade=True)
+            except Exception as e:  # noqa: BLE001 — best-effort per
+                # replica: one dead canary must not strand the rest
+                # on the rejected version.
+                n_handle_swap_ok = False
+                obs.event("canary.rollback_failed", replica=n,
+                          error=f"{type(e).__name__}: {e}"[:200])
+            if n_handle_swap_ok:
+                obs.event("canary.replica_rollback", replica=n,
+                          to_version=from_v)
+        self._rejected.add(version)
+        self.router.bump_epoch(
+            f"canary rollback v{version} ({reason})")
+        obs.count("canary.rollbacks")
+        obs.event("canary.rollout", action="rollback", version=version,
+                  drift=drift, reason=reason, canaries=len(swapped),
+                  promoted=0)
+        return {"action": "rollback", "version": version,
+                "drift": drift, "reason": reason,
+                "canaries": [n for n, _, _ in swapped], "promoted": 0}
+
+    # ------------------------------------------------------------ poll
+
+    def poll(self) -> dict | None:
+        """One train→serve tick: surface the newest fully-verified
+        snapshot strictly above the adopted version and roll it out.
+        Returns the rollout record, an ``{"action": "abort"}`` record
+        when the newest publish is torn/corrupt (engines keep serving
+        the current version — the ``train_kill_push`` contract), or
+        None when there is nothing new."""
+        if self.reader is None:
+            raise ValueError(
+                "poll() needs a SnapshotReader (reader=); feed "
+                "rollout() directly otherwise")
+        latest = self.reader.latest_version()
+        if latest is not None and int(latest) in self._rejected:
+            return None
+        try:
+            nxt = self.reader.poll(self.template)
+        except SnapshotCorrupt as e:
+            obs.count("canary.aborts")
+            obs.event("canary.abort", reason=f"{e}"[:200])
+            return {"action": "abort", "error": str(e)}
+        if nxt is None:
+            return None
+        version, tree = nxt
+        return self.rollout(version, tree)

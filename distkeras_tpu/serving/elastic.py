@@ -192,7 +192,11 @@ class _ElasticLanesMixin:
                 self._steps[n] = self._make_step(n)
         for n in self._step_windows:
             # The step donates its cache: a fresh dummy per window.
-            self._steps[n](*self._tier_state(tier))
+            # Hot-swap engines pass the LIVE params (committed arrays
+            # — their shardings are part of the jit cache key, so the
+            # warm entry is exactly the one swap_params' replacements
+            # will hit).
+            self._steps[n](*self._pargs(), *self._tier_state(tier))
 
     def _warm_admission(self, tier: int) -> None:
         pool = self._prefix_pool
@@ -203,10 +207,11 @@ class _ElasticLanesMixin:
                 self._admit(cache, rows, jnp.int32(0), jnp.int32(0),
                             pool.slab, jnp.int32(-1))
             else:
-                self._admit(cache, rows, jnp.int32(0),
+                self._admit(*self._pargs(), cache, rows, jnp.int32(0),
                             jnp.int32(self._off))
             if self._admit_cont is not None:
-                self._admit_cont(self._tier_state(tier)[0], rows,
+                self._admit_cont(*self._pargs(),
+                                 self._tier_state(tier)[0], rows,
                                  jnp.int32(0), jnp.int32(0))
         if self._prefix_lane is not None:
             self._reseed(self._tier_state(tier)[0], jnp.int32(0))

@@ -55,7 +55,7 @@ class _Lane:
 
 def _make_lane_admit(model_params, model_cfg, prefix_lane=None,
                      pooled: bool = False, seed: bool = True,
-                     constrain=None):
+                     constrain=None, take_params: bool = False):
     """ONE-lane admission program factory shared by both engines:
     prefill ``rows`` (bucket-padded) into a single lane's cache slice
     at traced start position ``off``, seeded from the engine's static
@@ -73,8 +73,17 @@ def _make_lane_admit(model_params, model_cfg, prefix_lane=None,
     ``constrain``: sharding-constraint hook (pod-sharded engines pass
     the KV-slab constraint so GSPMD pins the cache layout inside the
     compiled program instead of inferring it per call).
+
+    ``take_params=True`` builds the hot-swap spelling (round 20): the
+    program takes the param tree as its FIRST argument instead of
+    closing over it, so a live weight push is a plain argument change
+    on a warm jit cache — same avals + same committed shardings = the
+    exact cache entry, zero recompiles (the ``serving_weight_push``
+    compile session pins it).  The cache is still the donated buffer
+    (argnums shifts to 1); params are never donated — version N must
+    survive the swap for rollback.
     """
-    def admit(cache, rows, lane, off, *pool):
+    def _admit(params, cache, rows, lane, off, *pool):
         if constrain is not None:
             cache = constrain(cache)
         lane_cache = jax.tree.map(
@@ -105,13 +114,19 @@ def _make_lane_admit(model_params, model_cfg, prefix_lane=None,
             else:
                 lane_cache = jax.tree.map(jnp.zeros_like, lane_cache)
         _, lane_cache = _decode_chunk(
-            model_params, lane_cache, rows,
+            params, lane_cache, rows,
             jnp.reshape(off, (1,)).astype(jnp.int32), model_cfg,
             uniform_pos=True)
         out = jax.tree.map(
             lambda a, u: jax.lax.dynamic_update_slice_in_dim(
                 a, u, lane, axis=1), cache, lane_cache)
         return constrain(out) if constrain is not None else out
+
+    if take_params:
+        return jax.jit(_admit, donate_argnums=1)
+
+    def admit(cache, rows, lane, off, *pool):
+        return _admit(model_params, cache, rows, lane, off, *pool)
     return jax.jit(admit, donate_argnums=0)
 
 
@@ -157,6 +172,89 @@ class _LaneEngine(_AdmissionMixin, _ElasticMixin):
     mesh = None
     plan = None
     _kv_axis = None
+
+    # Live weight push (round 20): engines built with
+    # ``hot_swap=True`` compile their decode/admission programs to
+    # take the param tree as an ARGUMENT (see ``_make_lane_admit``'s
+    # ``take_params``), so :meth:`swap_params` is a warm-cache
+    # argument change.  ``param_version`` is 0 until the first swap —
+    # every engine carries it (the router's fleet snapshot reads it
+    # unconditionally).
+    _hot_swap = False
+    param_version = 0
+
+    def _pargs(self) -> tuple:
+        """The params-argument prefix of every compiled-program call:
+        ``(params,)`` on a hot-swap engine, ``()`` otherwise — ONE
+        spelling at every dispatch/warm-up site, so the two engine
+        modes cannot drift."""
+        return (self.params,) if self._hot_swap else ()
+
+    def swap_params(self, new_params, version: int,
+                    allow_downgrade: bool = False) -> int:
+        """Replace the engine's weights BETWEEN steps (round 20): the
+        new tree is placed with the live params' exact shardings, so
+        every warm program is a jit cache hit — zero recompiles (the
+        ``serving_weight_push`` session pins it).  In-flight requests
+        continue mid-stream on the new weights over their existing
+        K/V (the documented mixed-cache contract: tokens emitted
+        under version N are bit-deterministic functions of version N).
+
+        ``version`` must be strictly greater than ``param_version``
+        unless ``allow_downgrade=True`` — the canary controller's
+        rollback is the one legitimate downgrade.  Geometry is
+        validated leaf-for-leaf; a mismatched tree raises and the
+        engine keeps serving its current version.  Returns the new
+        ``param_version``."""
+        if not self._hot_swap:
+            raise ValueError(
+                "engine was built without hot_swap=True: its programs "
+                "closed over the weights at compile time, so a swap "
+                "would recompile everything — rebuild with "
+                "hot_swap=True for live weight push")
+        version = int(version)
+        with self._admission_lock:
+            if version <= self.param_version and not allow_downgrade:
+                raise ValueError(
+                    f"swap_params(version={version}) ≤ live version "
+                    f"{self.param_version}: versions are monotone "
+                    "(rollback passes allow_downgrade=True)")
+            old_leaves, old_def = jax.tree_util.tree_flatten(
+                self.params)
+            new_leaves, new_def = jax.tree_util.tree_flatten(
+                new_params)
+            if old_def != new_def:
+                raise ValueError(
+                    f"swap_params: param tree structure changed "
+                    f"({new_def} vs live {old_def}) — a push must "
+                    "carry the exact geometry the engine compiled "
+                    "for")
+            for i, (o, nw) in enumerate(zip(old_leaves, new_leaves)):
+                if (tuple(np.shape(nw)) != tuple(o.shape)
+                        or jnp.asarray(nw).dtype != o.dtype):
+                    raise ValueError(
+                        f"swap_params: leaf {i} is "
+                        f"[{np.shape(nw)} {jnp.asarray(nw).dtype}], "
+                        f"engine compiled for [{tuple(o.shape)} "
+                        f"{o.dtype}]")
+            # Placement must REPRODUCE the live tree's exactly — avals
+            # plus committed-ness are the jit cache key, so the swap
+            # is invisible to the compiler.  Unsharded engines placed
+            # via asarray (uncommitted, like every other engine; a
+            # committed replacement would re-key every warm program);
+            # pod-sharded engines re-commit to the live shardings.
+            if self.mesh is None:
+                self.params = jax.tree.map(jnp.asarray, new_params)
+            else:
+                self.params = jax.device_put(
+                    new_params,
+                    jax.tree.map(lambda l: l.sharding, self.params))
+            old = self.param_version
+            self.param_version = version
+            obs.count("serving.param_swaps")
+            obs.event("serving.param_swap", version=version,
+                      from_version=old, engine=type(self).__name__)
+            return version
 
     # ----------------------------------------- sharded-placement hooks
 
@@ -307,6 +405,7 @@ class _LaneEngine(_AdmissionMixin, _ElasticMixin):
                                if self._prefix_pool is not None
                                else []),
                 "stem_hashes": [],
+                "param_version": int(self.param_version),
             }
 
     def _validate_request_args(self, prompt, max_new_tokens: int):

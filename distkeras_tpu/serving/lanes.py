@@ -158,6 +158,26 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
     construction under the plan's constraints; rejects
     ``prompt_cache``/rolling configs (the composition table lives in
     docs/serving_guide.md "Pod-sharded serving").
+
+    **Live weight push** (round 20, ``hot_swap=True``): every decode
+    and admission program takes the param tree as an explicit jit
+    argument (never donated), so :meth:`swap_params` can replace the
+    served weights BETWEEN steps with zero recompiles — the swap
+    rebinds a host-side reference under the live placement
+    (``jnp.asarray`` re-placement unsharded, ``device_put`` onto the
+    live leaves' shardings under ``plan=``), it never re-keys the jit
+    cache (the ``serving_weight_push`` compile session pins it).
+    Swaps are version-monotone (``allow_downgrade=True`` is the
+    canary rollback's exception), validated against the live tree's
+    treedef/shapes/dtypes, and atomic under the admission lock — a
+    request's next step either wholly sees version N or wholly sees
+    N+1.  ``residency()`` reports ``param_version`` so the router's
+    fleet snapshot carries per-replica versions.  Rejects
+    ``prompt_cache``/``prefix_pool`` (prefilled K/V baked from old
+    params would mix versions) and forces always-warm admission.  The
+    policy layer above is :class:`~distkeras_tpu.serving.canary.
+    CanaryController` over :class:`~distkeras_tpu.serving.publish.
+    SnapshotReader`.
     """
 
     def __init__(self, params, cfg: TransformerConfig, lanes: int = 8,
@@ -170,7 +190,7 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                  lane_tiers=None, scale_up_after: int = 2,
                  scale_down_after: int = 8, step_windows=(1,),
                  prefill_chunk: int | None = None, prefix_pool=None,
-                 plan=None, mesh=None):
+                 plan=None, mesh=None, hot_swap: bool = False):
         # Windowed configs: the engine runs ROLLING lanes — each lane
         # decodes past max_len on the ring-buffer cache (the unbounded
         # streaming-chat shape), which needs rope (positions beyond
@@ -321,6 +341,28 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
             raise ValueError(
                 f"eos_token {eos_token} outside vocab [0, "
                 f"{cfg.vocab_size})")
+        # Live weight push (round 20, ``hot_swap=``): compile every
+        # decode/admission program to take the param tree as an
+        # ARGUMENT instead of closing over it, so swap_params() is a
+        # warm-cache argument change — zero recompiles (the
+        # serving_weight_push compile session pins it).  Prefilled
+        # prefixes are rejected: their K/V was computed under the
+        # params they were built with, so a swap would silently serve
+        # a version mix (re-prefill and rebuild instead).
+        self._hot_swap = bool(hot_swap)
+        if self._hot_swap:
+            if prompt_cache is not None or prefix_pool is not None:
+                raise ValueError(
+                    "hot_swap=True does not compose with "
+                    "prompt_cache=/prefix_pool=: prefix K/V is baked "
+                    "from the params it was prefilled with, so a "
+                    "weight swap would silently mix param versions "
+                    "mid-sequence — rebuild the prefix under the new "
+                    "version instead")
+            # Every program must exist before the first request: a
+            # lazy serve-phase compile would land INSIDE the push
+            # window the zero-compile budget pins.
+            self._always_warm = True
         if plan is not None:
             # Sharded device placement per the plan's rules: the big
             # matmul operands scatter over the mesh, small leaves
@@ -573,9 +615,9 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
             return jax.random.categorical(
                 jax.random.fold_in(k, q), row)
 
-        def one_step(cache, cur, pos, keys, temps, tps, mps):
+        def one_step_p(params, cache, cur, pos, keys, temps, tps, mps):
             logits, cache = _decode_chunk(
-                self.params, cache, cur[:, None], pos, cfg)
+                params, cache, cur[:, None], pos, cfg)
             logits = logits[:, 0]                      # [lanes, V]
             if per_request_sampling:
                 # Vectorized per-lane params: greedy lanes (t <= 0)
@@ -635,11 +677,44 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                        else jnp.minimum(pos + 1, cfg.max_len - 1))
             return cache, nxt.astype(jnp.int32), nxt_pos
 
+        if self._hot_swap:
+            # Hot-swap engines thread the params through as the first
+            # step argument (the swap is then a warm-cache argument
+            # change); the default spelling below bakes self.params in
+            # at trace time — its jaxpr, and therefore every recorded
+            # compile budget and IR census, is byte-identical to the
+            # pre-round-20 one.
+            return one_step_p
+
+        def one_step(cache, cur, pos, keys, temps, tps, mps):
+            return one_step_p(self.params, cache, cur, pos, keys,
+                              temps, tps, mps)
         return one_step
 
     def _make_step(self, n: int):
         one_step = self._one_step
         constrain = self._kv_constraint
+
+        if self._hot_swap:
+            def step_n_p(params, cache, cur, pos, keys, temps, tps,
+                         mps):
+                if constrain is not None:
+                    cache = constrain(cache)
+
+                def body(carry, _):
+                    cache, cur, pos = carry
+                    cache, cur, pos = one_step(params, cache, cur,
+                                               pos, keys, temps, tps,
+                                               mps)
+                    return (cache, cur, pos), cur
+                (cache, cur, pos), toks = jax.lax.scan(
+                    body, (cache, cur, pos), None, length=n)
+                if constrain is not None:
+                    cache = constrain(cache)
+                return cache, cur, pos, toks.T    # [lanes, n]
+            # Donate the cache (now argument 1); params are NOT
+            # donated — version N must survive the swap for rollback.
+            return jax.jit(step_n_p, donate_argnums=1)
 
         def step_n(cache, cur, pos, keys, temps, tps, mps):
             if constrain is not None:
@@ -673,13 +748,15 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
         self._admit = _make_lane_admit(self.params, self.cfg,
                                        prefix_lane=self._prefix_lane,
                                        pooled=pooled,
-                                       constrain=constrain)
+                                       constrain=constrain,
+                                       take_params=self._hot_swap)
         # Chunked prefill: the continuation program lands chunk k > 0
         # on the lane's existing cache (no reseed — that would erase
         # the earlier chunks).
         self._admit_cont = (_make_lane_admit(self.params, self.cfg,
                                              seed=False,
-                                             constrain=constrain)
+                                             constrain=constrain,
+                                             take_params=self._hot_swap)
                             if self.prefill_chunk is not None else None)
         self._reseed = (_make_lane_reseed(prefix_lane=self._prefix_lane,
                                           constrain=constrain)
@@ -791,7 +868,8 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
                 jnp.int32(start), self._prefix_pool.slab,
                 jnp.int32(-1))
         else:
-            self.cache = self._admit(self.cache, jnp.asarray(rows),
+            self.cache = self._admit(*self._pargs(), self.cache,
+                                     jnp.asarray(rows),
                                      jnp.int32(lane), jnp.int32(start))
 
     def _exec_reseed(self, lane, slot) -> None:
@@ -825,7 +903,8 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
         return rows
 
     def _exec_chunk(self, lane, start, rows):
-        self.cache = self._admit_cont(self.cache, jnp.asarray(rows),
+        self.cache = self._admit_cont(*self._pargs(), self.cache,
+                                      jnp.asarray(rows),
                                       jnp.int32(lane), jnp.int32(start))
 
     def _finish_admission(self, lane, st):
@@ -1051,21 +1130,24 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
             # collectives (scripts/comm_budget.json).
             mode += f"_tp{int(self.mesh.shape[self._kv_axis])}"
         rows = jnp.zeros((1, self._buckets[0]), jnp.int32)
-        admit_args = (self.cache, rows, jnp.int32(0),
-                      jnp.int32(self._off))
+        pargs = self._pargs()  # hot-swap engines take params first
+        d = len(pargs)
+        admit_args = pargs + (self.cache, rows, jnp.int32(0),
+                              jnp.int32(self._off))
         if self._prefix_pool is not None:
             admit_args += (self._prefix_pool.slab, jnp.int32(0))
         return [
             TraceSpec(
                 name=f"continuousbatcher_{mode}/decode_step",
                 fn=self._steps[1],
-                args=(self.cache, self.cur, self.pos, self.keys,
-                      self.temps, self.tps, self.mps),
-                donate_argnums=(0,)),
+                args=pargs + (self.cache, self.cur, self.pos,
+                              self.keys, self.temps, self.tps,
+                              self.mps),
+                donate_argnums=(d,)),
             TraceSpec(
                 name=f"continuousbatcher_{mode}/admit_b"
                      f"{self._buckets[0]}",
-                fn=self._admit, args=admit_args, donate_argnums=(0,)),
+                fn=self._admit, args=admit_args, donate_argnums=(d,)),
         ]
 
     def step(self, n: int = 1):
@@ -1134,7 +1216,7 @@ class ContinuousBatcher(_ElasticLanesMixin, _LaneEngine):
         if n not in self._steps:
             self._steps[n] = self._make_step(n)
         self.cache, self.cur, self.pos, toks = self._steps[n](
-            self.cache, self.cur, self.pos, self.keys,
+            *self._pargs(), self.cache, self.cur, self.pos, self.keys,
             self.temps, self.tps, self.mps)
         return np.asarray(toks)
 

@@ -1,0 +1,377 @@
+"""Versioned weight snapshots: the trainer -> serving-fleet transport.
+
+The reference stack was train-then-predict as one static pipeline — a
+trainer ran to completion and handed its final weights to a
+``ModelPredictor``.  The production shape is continuous: a trainer
+publishes weights into a *live* fleet without dropping a request.  This
+module is the wire format of that loop; ``serving/canary.py`` is the
+rollout policy on top.
+
+Design:
+
+* **Snapshots are fusion buckets.**  A snapshot is the parameter pytree
+  packed through the exact dtype-grouped bucket layout the gradient
+  exchange already wires (:class:`~distkeras_tpu.parallel.collectives.
+  Zero1Layout` with ``n=1``) — same leaf-order placement, same
+  dtype-homogeneous buckets, so the optional ``int8`` coding is the
+  exchange layer's symmetric per-row quantization for free.  Packing is
+  pure numpy over ``layout.slots``: a publisher never traces or
+  compiles anything.
+* **A reader never adopts a partial publish.**  Bucket files land
+  first; the manifest (per-bucket CRCs + a SHA-256 over its own body)
+  is written last via tmp + ``os.replace``, and the ``LATEST`` pointer
+  after that.  A publisher killed mid-publish (the ``train_kill_push``
+  chaos leg probes ``publish.commit`` right before the manifest
+  rename) leaves bucket files but no manifest — :class:`SnapshotReader`
+  raises :class:`SnapshotCorrupt` instead of adopting, and ``LATEST``
+  still names the previous good version.
+* **Versions are monotone.**  A reader records the version it last
+  adopted and declines anything ≤ it (:class:`StaleSnapshot`), so a
+  replayed or re-pointed ``LATEST`` can never roll a fleet backward
+  silently — downgrades are a first-class *rollback* in the canary
+  controller, not an accident here.
+
+Locking: ``serving.publish`` (a leaf lock — nothing else is taken
+while it is held) serializes concurrent publishes of one publisher;
+reader adoption state is a single int assignment guarded by the same
+discipline on the caller (the canary controller holds
+``serving.canary``).  See docs/concurrency.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import zipfile
+import zlib
+
+import numpy as np
+
+from distkeras_tpu import obs
+from distkeras_tpu.parallel.collectives import DEFAULT_BUCKET_MB, Zero1Layout
+from distkeras_tpu.resilience import chaos
+from distkeras_tpu.utils.locks import TracedLock
+
+__all__ = [
+    "SnapshotPublisher", "SnapshotReader",
+    "SnapshotError", "SnapshotCorrupt", "StaleSnapshot",
+]
+
+_MANIFEST = "MANIFEST.json"
+_LATEST = "LATEST"
+# Matches parallel/exchange.py's int8 zero-scale guard.
+_EPS = np.float32(1.1754944e-38)
+
+
+class SnapshotError(RuntimeError):
+    """Base error for snapshot publish/load failures."""
+
+
+class SnapshotCorrupt(SnapshotError):
+    """Torn or corrupt snapshot: missing manifest, manifest-hash
+    mismatch, or a bucket whose checksum does not match.  Never
+    adopted — the reader stays on its current version."""
+
+
+class StaleSnapshot(SnapshotError):
+    """Snapshot version ≤ the reader's adopted version."""
+
+
+def _version_dir(root: str, version: int) -> str:
+    return os.path.join(root, f"v{int(version):08d}")
+
+
+def _dtype(name: str) -> np.dtype:
+    """dtype-by-name, including the ml_dtypes extension types (e.g.
+    ``bfloat16``) numpy itself cannot spell."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # ships with jax
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _is_float(dtype: np.dtype) -> bool:
+    try:
+        if np.issubdtype(dtype, np.floating):
+            return True
+    except TypeError:
+        pass
+    return dtype.name in ("bfloat16", "float16", "float32", "float64")
+
+
+def _manifest_hash(body: dict) -> str:
+    blob = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def _int8_encode(x: np.ndarray):
+    """Numpy spelling of ``parallel.exchange.int8_encode``: symmetric
+    per-row quantization over the last axis."""
+    xf = np.asarray(x, dtype=np.float32)
+    scale = np.max(np.abs(xf), axis=-1, keepdims=True) / 127.0
+    scale = np.maximum(scale, _EPS).astype(np.float32)
+    q = np.clip(np.rint(xf / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+class SnapshotPublisher:
+    """Trainer-side writer of versioned parameter snapshots.
+
+    ``root`` is the snapshot directory (one subdirectory per version);
+    ``coding`` is ``None`` (raw buckets) or ``"int8"`` (the exchange
+    layer's symmetric per-row int8 on floating buckets — lossy, the
+    serving-side weights are the dequantized values); ``bucket_mb``
+    must match what readers rebuild, so it is recorded in the manifest.
+    """
+
+    def __init__(self, root: str, coding: str | None = None,
+                 bucket_mb: float = DEFAULT_BUCKET_MB):
+        if coding not in (None, "int8"):
+            raise ValueError(
+                f"unknown snapshot coding {coding!r}; known: None, 'int8'")
+        self.root = str(root)
+        self.coding = coding
+        self.bucket_mb = float(bucket_mb)
+        self._lock = TracedLock("serving.publish")
+        self._layout: Zero1Layout | None = None
+        self.published = 0
+        os.makedirs(self.root, exist_ok=True)
+
+    # ------------------------------------------------------------ pack
+
+    def _layout_for(self, leaves, treedef) -> Zero1Layout:
+        # Layout is geometry-only; cache it across rounds (every round
+        # publishes the same pytree geometry).
+        lay = self._layout
+        if (lay is None or lay.treedef != treedef
+                or any(tuple(np.shape(x)) != s.shape
+                       for s, x in zip(lay.slots, leaves))):
+            lay = Zero1Layout.for_tree(
+                [np.asarray(x) for x in leaves], n=1,
+                bucket_mb=self.bucket_mb)
+            lay = Zero1Layout(
+                n=1, treedef=treedef, slots=lay.slots,
+                bucket_cols=lay.bucket_cols,
+                bucket_dtypes=lay.bucket_dtypes,
+                bucket_groups=lay.bucket_groups)
+            self._layout = lay
+        return lay
+
+    @staticmethod
+    def _np_pack(layout: Zero1Layout, leaves) -> list[np.ndarray]:
+        """Pure-numpy ``Zero1Layout.pack`` for ``n=1`` (cols == size,
+        zero pad): no tracing, no device transfers."""
+        buckets = [np.zeros((1, c), dtype=d)
+                   for c, d in zip(layout.bucket_cols,
+                                   layout.bucket_dtypes)]
+        for slot, leaf in zip(layout.slots, leaves):
+            flat = np.asarray(leaf).reshape(-1)
+            buckets[slot.bucket][0, slot.offset:slot.offset + slot.cols] \
+                = flat
+        return buckets
+
+    # --------------------------------------------------------- publish
+
+    def publish(self, tree, version: int) -> str:
+        """Write ``tree`` as snapshot ``version``; returns the snapshot
+        directory.  Atomic from any reader's point of view: bucket
+        files first, manifest via tmp + ``os.replace`` second, the
+        ``LATEST`` pointer last."""
+        version = int(version)
+        with self._lock:
+            import jax.tree_util as jtu
+
+            leaves, treedef = jtu.tree_flatten(tree)
+            layout = self._layout_for(leaves, treedef)
+            buckets = self._np_pack(layout, leaves)
+            vdir = _version_dir(self.root, version)
+            os.makedirs(vdir, exist_ok=True)
+            entries, total = [], 0
+            for i, bucket in enumerate(buckets):
+                fname = f"bucket_{i:04d}.npz"
+                coded = (self.coding
+                         if self.coding and _is_float(bucket.dtype)
+                         else None)
+                if coded == "int8":
+                    q, scale = _int8_encode(bucket)
+                    payload = {"q": q, "scale": scale}
+                    crc = zlib.crc32(scale.tobytes(),
+                                     zlib.crc32(q.tobytes()))
+                    nbytes = q.nbytes + scale.nbytes
+                else:
+                    raw = np.frombuffer(bucket.tobytes(), dtype=np.uint8)
+                    payload = {"raw": raw}
+                    crc = zlib.crc32(raw.tobytes())
+                    nbytes = raw.nbytes
+                np.savez(os.path.join(vdir, fname), **payload)
+                entries.append({
+                    "file": fname, "crc": int(crc),
+                    "dtype": np.dtype(bucket.dtype).name,
+                    "cols": int(bucket.shape[1]), "coding": coded,
+                })
+                total += nbytes
+            body = {"version": version, "bucket_mb": self.bucket_mb,
+                    "n_leaves": len(leaves), "buckets": entries}
+            manifest = dict(body, manifest_hash=_manifest_hash(body))
+            # The commit point: everything before this line is
+            # invisible to readers; everything after is atomic.  The
+            # train_kill_push chaos leg SIGKILLs here — the torn
+            # version directory has buckets but no manifest.
+            chaos.probe("publish.commit", step=version)
+            tmp = os.path.join(vdir, _MANIFEST + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(vdir, _MANIFEST))
+            ltmp = os.path.join(self.root, _LATEST + ".tmp")
+            with open(ltmp, "w") as f:
+                f.write(str(version))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(ltmp, os.path.join(self.root, _LATEST))
+            self.published += 1
+            obs.count("publish.snapshots")
+            obs.event("publish.commit", version=version,
+                      buckets=len(entries), bytes=total,
+                      coding=self.coding)
+            return vdir
+
+
+class SnapshotReader:
+    """Engine-side loader of published snapshots.
+
+    Tracks the last *adopted* version; :meth:`poll` surfaces only a
+    strictly newer, fully verified snapshot.  Verification order:
+    manifest present -> manifest hash -> per-bucket CRC -> geometry
+    against the caller's template pytree.  Any failure raises
+    :class:`SnapshotCorrupt` (counted as ``publish.torn``) and leaves
+    the adopted version untouched.
+    """
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        self.version = 0  # last adopted version; 0 = none yet
+
+    # ----------------------------------------------------------- state
+
+    def latest_version(self) -> int | None:
+        """The publisher's ``LATEST`` pointer, or ``None`` before the
+        first complete publish."""
+        try:
+            with open(os.path.join(self.root, _LATEST)) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def adopt(self, version: int) -> None:
+        """Record ``version`` as adopted (the caller swapped it into
+        an engine); later polls only surface strictly newer ones."""
+        self.version = max(self.version, int(version))
+        obs.event("publish.adopt", version=int(version))
+
+    # ------------------------------------------------------------ load
+
+    def _manifest(self, version: int) -> dict:
+        path = os.path.join(_version_dir(self.root, version), _MANIFEST)
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            obs.count("publish.torn")
+            raise SnapshotCorrupt(
+                f"snapshot v{version}: no readable manifest at {path} "
+                f"({e}) — torn publish, not adopting") from e
+        body = {k: v for k, v in manifest.items() if k != "manifest_hash"}
+        if manifest.get("manifest_hash") != _manifest_hash(body):
+            obs.count("publish.torn")
+            raise SnapshotCorrupt(
+                f"snapshot v{version}: manifest hash mismatch — torn or "
+                "tampered publish, not adopting")
+        return manifest
+
+    def load(self, version: int, template):
+        """Verify and decode snapshot ``version`` into the geometry of
+        ``template`` (a pytree of arrays or ShapeDtypeStructs); returns
+        a numpy pytree.  Does NOT mark the version adopted — callers
+        adopt only after the swap lands (see ``CanaryController``)."""
+        version = int(version)
+        if version <= self.version:
+            obs.count("publish.stale")
+            raise StaleSnapshot(
+                f"snapshot v{version} ≤ adopted v{self.version}")
+        manifest = self._manifest(version)
+        import jax.tree_util as jtu
+
+        leaves, treedef = jtu.tree_flatten(template)
+        layout = Zero1Layout.for_tree(
+            [np.asarray(x) if not hasattr(x, "dtype") else x
+             for x in leaves],
+            n=1, bucket_mb=float(manifest.get("bucket_mb",
+                                              DEFAULT_BUCKET_MB)))
+        entries = manifest["buckets"]
+        if (len(entries) != len(layout.bucket_cols)
+                or manifest.get("n_leaves") != len(leaves)):
+            obs.count("publish.torn")
+            raise SnapshotCorrupt(
+                f"snapshot v{version}: {len(entries)} buckets /"
+                f" {manifest.get('n_leaves')} leaves do not match the"
+                f" template layout ({len(layout.bucket_cols)} buckets /"
+                f" {len(leaves)} leaves)")
+        vdir = _version_dir(self.root, version)
+        buckets: list[np.ndarray] = []
+        for i, ent in enumerate(entries):
+            dtype = _dtype(ent["dtype"])
+            cols = int(ent["cols"])
+            if (cols != layout.bucket_cols[i]
+                    or dtype != np.dtype(layout.bucket_dtypes[i])):
+                obs.count("publish.torn")
+                raise SnapshotCorrupt(
+                    f"snapshot v{version} bucket {i}: "
+                    f"[{ent['dtype']} x {cols}] does not match template "
+                    f"[{np.dtype(layout.bucket_dtypes[i]).name} x "
+                    f"{layout.bucket_cols[i]}]")
+            try:
+                with np.load(os.path.join(vdir, ent["file"])) as z:
+                    payload = {k: z[k] for k in z.files}
+            except (OSError, ValueError, KeyError, zlib.error,
+                    zipfile.BadZipFile) as e:
+                obs.count("publish.torn")
+                raise SnapshotCorrupt(
+                    f"snapshot v{version}: bucket file {ent['file']} "
+                    f"unreadable ({e})") from e
+            if ent.get("coding") == "int8":
+                q, scale = payload["q"], payload["scale"]
+                crc = zlib.crc32(scale.tobytes(),
+                                 zlib.crc32(q.tobytes()))
+                bucket = (q.astype(np.float32) * scale).astype(dtype)
+            else:
+                raw = payload["raw"]
+                crc = zlib.crc32(raw.tobytes())
+                bucket = np.frombuffer(
+                    raw.tobytes(), dtype=dtype).reshape(1, cols)
+            if int(crc) != int(ent["crc"]):
+                obs.count("publish.torn")
+                raise SnapshotCorrupt(
+                    f"snapshot v{version} bucket {i} ({ent['file']}): "
+                    f"checksum mismatch (manifest {ent['crc']}, "
+                    f"payload {crc}) — not adopting")
+            buckets.append(np.asarray(bucket))
+        out = []
+        for s in layout.slots:
+            flat = buckets[s.bucket][:, s.offset:s.offset + s.cols]
+            out.append(flat.reshape(-1)[:s.size].reshape(s.shape))
+        return treedef.unflatten(out)
+
+    def poll(self, template):
+        """``(version, tree)`` for the newest fully-verified snapshot
+        strictly above the adopted version, else ``None``.  Raises
+        :class:`SnapshotCorrupt` if the newest snapshot is torn — the
+        caller decides whether to abort or retry."""
+        latest = self.latest_version()
+        if latest is None or latest <= self.version:
+            return None
+        return latest, self.load(latest, template)

@@ -212,6 +212,18 @@ class InProcessReplica:
         return (self.engine.queued, len(self.engine.running()),
                 self.engine.lanes)
 
+    def param_version(self) -> int:
+        """The engine's live weight version (0 until the first
+        ``swap_params``; engines without hot-swap report 0 forever)."""
+        return int(getattr(self.engine, "param_version", 0))
+
+    def swap_params(self, tree, version: int,
+                    allow_downgrade: bool = False) -> int:
+        """Live weight push passthrough (round 20) — hot-swap engines
+        only; the canary controller drives this."""
+        return self.engine.swap_params(
+            tree, version, allow_downgrade=allow_downgrade)
+
     # -------------------------------------------------- self-stepping
 
     def start(self, idle_s: float = 0.005) -> "InProcessReplica":
@@ -421,6 +433,11 @@ class HttpReplica:
         c = self._cached
         return (int(c.get("queue_depth", 0)),
                 int(c.get("lanes_busy", 0)), int(c.get("lanes", 1)))
+
+    def param_version(self) -> int:
+        """Weight version from the last ``/residency`` poll (0 until
+        one lands — same staleness contract as :meth:`load`)."""
+        return int(self._cached.get("param_version", 0))
 
 
 def discover_replicas(coord_dir: str, timeout: float = 2.0
@@ -777,6 +794,28 @@ class Router:
         with self._lock:
             return self._fleet_snapshot_locked()
 
+    def replica_handles(self) -> dict:
+        """``{name: replica}`` — the live member handles under one
+        lock acquisition.  The canary controller's swap surface
+        (round 20): it needs the handles themselves (to call
+        ``swap_params``), which the dict-of-dicts snapshot above
+        deliberately does not carry."""
+        with self._lock:
+            return {n: m.replica for n, m in self._members.items()}
+
+    def bump_epoch(self, reason: str) -> int:
+        """Advance the route epoch without a membership change — the
+        canary controller's promote/rollback commit point (round 20):
+        a weight push changes what the fleet SERVES, so in-flight
+        routing state made under the old version set is re-stamped
+        the same way a drain re-stamps it.  Event emitted after the
+        lock is released (the drain-path convention)."""
+        with self._lock:
+            self.epoch += 1
+            epoch = self.epoch
+        obs.event("router.epoch_bump", reason=str(reason), epoch=epoch)
+        return epoch
+
     def _fleet_snapshot_locked(self) -> dict:
         now = self._clock()
         reps = {}
@@ -795,6 +834,14 @@ class Router:
                 "prefix_ids": frozenset(tab.get("prefix_ids", ())),
                 "stems": len(tab.get("stem_hashes", ())),
                 "block": tab.get("block"),
+                # Round 20: the live weight version (0 = never
+                # swapped).  The canary controller and the request
+                # waterfalls read it; the autoscaler ignores it (its
+                # policies key on the named load fields above —
+                # regression-tested in tests/test_autoscale.py).
+                "param_version": (m.replica.param_version()
+                                  if hasattr(m.replica,
+                                             "param_version") else 0),
             }
         return {"epoch": self.epoch, "pending": len(self._pending),
                 "closed": self._closed, "replicas": reps}

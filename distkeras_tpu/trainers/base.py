@@ -156,6 +156,40 @@ class CheckpointingBase:
             restored = self._ckpt.restore(pytree, step)
         return restored, step
 
+    def attach_publisher(self, publisher, every: int = 1):
+        """Wire a :class:`~distkeras_tpu.serving.publish.
+        SnapshotPublisher` into the round loop: every ``every`` rounds
+        (and on the final round) the trainer publishes its current
+        weights as snapshot version ``round_idx`` — the trainer side
+        of the live train→serve weight push (docs/serving_guide.md).
+
+        Publishing is independent of checkpointing: a trainer with no
+        ``checkpoint_dir`` still publishes.  The snapshot version IS
+        the round index, so versions are monotone across a resumed
+        run for free.  Returns ``self`` for chaining."""
+        if every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        self._publisher = publisher
+        self._publish_every = int(every)
+        self._last_published = 0
+        return self
+
+    def _publish_tree(self, pytree):
+        """The weights to publish, extracted from the round-loop state.
+        Subclasses override to unwrap their carry (and un-view ZeRO-3
+        shard views); the base publishes the state as-is."""
+        return pytree
+
+    def _maybe_publish(self, pytree, round_idx: int,
+                       final: bool = False) -> None:
+        pub = getattr(self, "_publisher", None)
+        if pub is None or round_idx == self._last_published:
+            return
+        if final or round_idx % self._publish_every == 0:
+            with obs.span("publish.snapshot", step=round_idx):
+                pub.publish(self._publish_tree(pytree), round_idx)
+            self._last_published = round_idx
+
     def _checkpoint(self, pytree, round_idx: int, final: bool = False) -> None:
         """Persist training state after round ``round_idx`` (1-based).
 
@@ -164,6 +198,7 @@ class CheckpointingBase:
         alias them.  States at dist-keras scale write in milliseconds.
         """
         chaos.probe("train.round", step=round_idx)
+        self._maybe_publish(pytree, round_idx, final)
         if self.preempt_event is not None and self.preempt_event.is_set():
             # Graceful preemption (SIGTERM via a Supervisor, or any
             # orchestrator flipping the event): persist THIS round's

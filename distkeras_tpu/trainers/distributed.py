@@ -306,6 +306,13 @@ class DistributedTrainer(Trainer):
             state = self._zero_unview_state(state)
         return super()._export(state)
 
+    def _publish_tree(self, state):
+        """Live weight push: publish parameter-layout weights (one
+        gather per bucket under stage 3, only on publish rounds —
+        same cost note as mid-train eval)."""
+        tv, ntv = self._eval_state_view(state)
+        return {"tv": list(tv), "ntv": list(ntv)}
+
     def _batch_sharding(self, leading_window: bool,
                         leading_sync: bool = False):
         spec = (P(None, None, "data") if leading_sync

@@ -662,6 +662,15 @@ class LMTrainer(CheckpointingBase):
                 self._zero_bucket_mb)
         return self._zero_layout_cache
 
+    def _publish_tree(self, carry):
+        """Live weight push: the carry is ``(params, opt_state)``;
+        publish the params in parameter layout (one gather per bucket
+        under stage 3, only on publish rounds)."""
+        params, _ = carry
+        if self.zero >= 3:
+            params = self._layout().unview(params)
+        return params
+
     def _dp_local_value_and_grad(self):
         """``jax.value_and_grad`` replacement for the replicated-DP
         configuration (see __init__): gradients are computed per

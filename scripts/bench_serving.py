@@ -1888,6 +1888,84 @@ def bench_autoscale(shape):
     return run
 
 
+def bench_canary_rollout():
+    """Live weight push under load (round 20): two hot_swap engines
+    behind a Router serve a wave of in-flight requests while a
+    :class:`CanaryController` promotes a freshly published snapshot
+    mid-stream.  Value = victim-request TPOT p99 with the mid-stream
+    push over the no-push baseline's (≈1.0 means a live swap is
+    invisible to in-flight decodes — the zero-recompile claim measured
+    from the victim's seat).  Extras carry the rollout wall-clock
+    (canary swap → drift probe → fleet swap → epoch bump), both TPOT
+    p99s, and a per-version token-determinism flag: each leg runs
+    twice and must produce bit-identical token streams (the swap lands
+    between the same two steps, so same params ⇒ same tokens)."""
+    def run(n_req=6, max_new=16, push_after=3, lanes=4, seed=0):
+        import time
+
+        import jax
+        import numpy as np
+
+        from distkeras_tpu.models import transformer as tfm
+        from distkeras_tpu.serving import (ContinuousBatcher,
+                                           InProcessReplica, Router)
+        from distkeras_tpu.serving.canary import CanaryController
+
+        cfg = _cfg()
+        params = _params(cfg=cfg)
+        v1 = jax.tree.map(np.asarray,
+                          tfm.init_params(jax.random.key(1), cfg))
+        template = jax.eval_shape(
+            lambda: tfm.init_params(jax.random.key(0), cfg))
+        rng = np.random.default_rng(seed)
+        prompts = [rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+                   for _ in range(n_req)]
+
+        def leg(push):
+            engines = [ContinuousBatcher(params, cfg, lanes=lanes,
+                                         hot_swap=True)
+                       for _ in range(2)]
+            router = Router([InProcessReplica(f"r{i}", e)
+                             for i, e in enumerate(engines)])
+            ctl = CanaryController(router, None, cfg, template)
+            rids = [router.enqueue(p, max_new) for p in prompts]
+            gaps, rollout_ms, steps = [], 0.0, 0
+            while any(router.poll(r) is None for r in rids):
+                if push and steps == push_after:
+                    t0 = time.perf_counter()
+                    rec = ctl.rollout(1, v1)
+                    rollout_ms = (time.perf_counter() - t0) * 1e3
+                    assert rec["action"] == "promote", rec
+                t0 = time.perf_counter()
+                router.step()
+                gaps.append(time.perf_counter() - t0)
+                steps += 1
+            toks = tuple(tuple(int(t) for t in router.take(r).tokens)
+                         for r in rids)
+            return gaps, rollout_ms, toks
+
+        base_gaps, _, base_toks = leg(push=False)
+        _, _, base_toks2 = leg(push=False)
+        push_gaps, rollout_ms, push_toks = leg(push=True)
+        _, _, push_toks2 = leg(push=True)
+
+        base_p99 = float(np.percentile(base_gaps, 99)) * 1e3
+        push_p99 = float(np.percentile(push_gaps, 99)) * 1e3
+        deterministic = (base_toks == base_toks2
+                         and push_toks == push_toks2)
+        extras = {
+            "rollout_wallclock_ms": round(rollout_ms, 3),
+            "tpot_p99_push_ms": round(push_p99, 3),
+            "tpot_p99_baseline_ms": round(base_p99, 3),
+            "tokens_deterministic_per_version": deterministic,
+            "tokens_changed_at_push": push_toks != base_toks,
+            "n_req": n_req, "push_after_steps": push_after,
+        }
+        ratio = push_p99 / max(base_p99, 1e-9)
+        return (ratio, rollout_ms / 1e3, 0.0, extras)
+    return run
+
+
 BENCHES = {
     "decode_greedy_b1": (bench_greedy(1), "tokens/sec/chip"),
     "decode_greedy_b8": (bench_greedy(8), "tokens/sec/chip"),
@@ -1986,6 +2064,11 @@ BENCHES = {
                         "x ttft vs static-min"),
     "autoscale_diurnal": (bench_autoscale("diurnal"),
                           "x ttft vs static-min"),
+    # Round 20: live weight push under load — value is the victim
+    # requests' TPOT p99 with a mid-stream canary promote over the
+    # no-push baseline's (≈1.0 = the swap is invisible in-flight).
+    "canary_rollout": (bench_canary_rollout(),
+                       "x no-push tpot p99"),
 }
 
 

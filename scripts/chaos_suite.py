@@ -64,6 +64,17 @@ trail; ``async_kill_push`` kills a host at the ``cluster.push`` probe
 and asserts the in-flight delta dropped cleanly with no torn merge
 (pushes == merges == center version).
 
+Round 20 adds the TRAIN→SERVE legs (docs/serving_guide.md):
+``train_kill_push`` SIGKILLs the trainer process between a snapshot
+version's bucket writes and its atomic manifest rename — the serving
+fleet must keep serving the last complete version, the torn snapshot
+must be refused (even when the version pointer names it), and the
+canary tick must abort cleanly with zero lost requests;
+``canary_bad_push`` publishes NaN weights with valid checksums — the
+canary's logit-drift probe must trip, the fleet must roll back to the
+promoted version (straddling requests all finish, tokens bit-identical
+post-rollback), and the rejected version must be quarantined.
+
 Usage: python scripts/chaos_suite.py [--seed N] [--kill-rounds 3,7,12]
                                      [--trace chaos.jsonl]
        python scripts/chaos_suite.py --cluster [--scenarios kill,stall]
@@ -1372,6 +1383,209 @@ def run_async_scenarios(scenarios, seed, workdir):
     return failures
 
 
+# ------------------------------------------- live weight push ladder
+#
+# The round-20 train→serve legs of --cluster: the trainer publishes
+# versioned fusion-bucket snapshots (serving/publish.py) and a
+# CanaryController pushes them across a hot_swap serving fleet.
+# ``train_kill_push`` SIGKILLs the TRAINER process between a version's
+# bucket writes and its atomic manifest rename (the publish.commit
+# probe) and asserts the serving side never adopts the torn snapshot;
+# ``canary_bad_push`` publishes a poisoned (NaN) version with VALID
+# checksums — transport is healthy, the weights are not — and asserts
+# the canary's logit-drift gate rolls the fleet back with zero lost
+# requests.
+
+TRAINER_PUSH_CHILD = '''
+import os, sys
+os.environ["KERAS_BACKEND"] = "jax"
+os.environ.setdefault("DKT_LOCK_SANITIZER", "1")
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+
+import numpy as np
+import distkeras_tpu as dk
+from distkeras_tpu.models.transformer import TransformerConfig
+from distkeras_tpu.parallel.mesh import MeshSpec, make_mesh
+from distkeras_tpu.resilience import FaultPlan
+from distkeras_tpu.serving.publish import SnapshotPublisher
+
+rng = np.random.default_rng({seed})
+tokens = rng.integers(0, 64, (64, 17)).astype(np.int32)
+cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                        d_ff=64, max_len=32)
+t = dk.LMTrainer(cfg, optimizer="sgd", learning_rate=0.05, batch_size=16,
+                 num_epoch=2, mesh=make_mesh(MeshSpec(data=1)),
+                 seed={seed})
+t.attach_publisher(SnapshotPublisher({snapdir!r}), every=1)
+with FaultPlan({seed}).kill("publish.commit", at={kill_at}):
+    t.train(tokens)
+print("CHILD DONE (kill never fired)", flush=True)
+'''
+
+
+def _push_fleet(seed):
+    """Two hot_swap engines behind a Router plus the canary plumbing —
+    the serving half both push legs share."""
+    from distkeras_tpu.serving.canary import CanaryController
+    from distkeras_tpu.serving.router import InProcessReplica, Router
+
+    params = tfm.init_params(jax.random.key(seed), CFG)
+    engines = [ContinuousBatcher(params, CFG, lanes=2, hot_swap=True)
+               for _ in range(2)]
+    router = Router([InProcessReplica(f"r{i}", e)
+                     for i, e in enumerate(engines)])
+    template = jax.eval_shape(
+        lambda: tfm.init_params(jax.random.key(seed), CFG))
+    return engines, router, template, CanaryController
+
+
+def _push_wave(router, n=4, max_new=6):
+    """Serve one wave of greedy requests to completion; a request that
+    fails to finish raises out of drain — completing IS the
+    zero-lost-requests assertion."""
+    rids = [router.enqueue([1 + i, 2, 3], max_new) for i in range(n)]
+    out = []
+    for r in rids:
+        res = router.drain(r)
+        toks = res["tokens"] if isinstance(res, dict) else res.tokens
+        out.append(tuple(int(t) for t in toks))
+    return out
+
+
+def run_train_kill_push_scenario(seed, workdir, kill_at=2):
+    """SIGKILL the trainer between bucket writes and the manifest
+    rename of version ``kill_at``: the serving fleet must keep serving
+    the last complete version, the torn snapshot must never be
+    adopted, and the canary tick must abort cleanly."""
+    from distkeras_tpu.serving.publish import (SnapshotCorrupt,
+                                               SnapshotReader)
+    from distkeras_tpu.utils import locks
+
+    print("== cluster scenario: train_kill_push (trainer SIGKILL "
+          "mid-publish) ==", flush=True)
+    try:
+        import subprocess
+
+        snapdir = os.path.join(workdir, "push_snaps")
+        os.makedirs(snapdir, exist_ok=True)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(workdir, "train_push_child.py")
+        with open(script, "w") as f:
+            f.write(TRAINER_PUSH_CHILD.format(
+                repo=repo, seed=seed, snapdir=snapdir, kill_at=kill_at))
+        proc = subprocess.run([sys.executable, script],
+                              capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 137, (
+            f"trainer child exited {proc.returncode}, expected 137 "
+            f"(SIGKILL-equivalent)\n{proc.stdout[-400:]}"
+            f"\n{proc.stderr[-800:]}")
+        torn = os.path.join(snapdir, f"v{kill_at:08d}")
+        assert os.path.isdir(torn), "kill fired before bucket writes"
+        assert not os.path.exists(os.path.join(torn, "MANIFEST.json")), (
+            "manifest present: the kill did not land mid-publish")
+
+        engines, router, template, CanaryController = _push_fleet(seed)
+        reader = SnapshotReader(snapdir)
+        ctl = CanaryController(router, reader, CFG, template)
+        base_viol = locks.violation_count()
+        _push_wave(router)                       # serve on init params
+        # LATEST never advanced past the last COMPLETE publish.
+        assert reader.latest_version() == kill_at - 1, (
+            reader.latest_version())
+        rec = ctl.poll()
+        assert rec is not None and rec["action"] == "promote", rec
+        assert all(e.param_version == kill_at - 1 for e in engines)
+        served = _push_wave(router)              # serve on pushed v1
+        # A direct read of the torn version must refuse, loudly.
+        try:
+            reader.load(kill_at, template)
+            raise AssertionError("torn snapshot adopted")
+        except SnapshotCorrupt:
+            pass
+        # Worst case: the version pointer itself names the torn
+        # version (simulated pointer corruption).  The canary tick
+        # must abort — never a partial adoption, never a crash.
+        with open(os.path.join(snapdir, "LATEST"), "w") as f:
+            f.write(str(kill_at))
+        rec2 = ctl.poll()
+        assert rec2 is not None and rec2["action"] == "abort", rec2
+        assert all(e.param_version == kill_at - 1 for e in engines)
+        after = _push_wave(router)
+        assert after == served, "tokens drifted across the abort"
+        assert locks.violation_count() == base_viol, (
+            "lock sanitizer violations during the push leg")
+        print(f"  PASS  cluster/train_kill_push: trainer died at "
+              f"publish.commit v{kill_at} (rc 137), torn snapshot "
+              f"refused, fleet stayed on v{kill_at - 1}, canary tick "
+              f"aborted cleanly, zero lost requests")
+        return 0
+    except Exception as e:  # noqa: BLE001 — report the ladder
+        print(f"  FAIL  cluster/train_kill_push: "
+              f"{type(e).__name__}: {e}")
+        return 1
+
+
+def run_canary_bad_push_scenario(seed, workdir):
+    """Publish a poisoned (NaN) version with valid checksums: the
+    drift probe must trip, the fleet must roll back to the promoted
+    version with zero lost requests, and the rejected version must be
+    quarantined (pushed once, never re-pushed)."""
+    from distkeras_tpu.serving.publish import (SnapshotPublisher,
+                                               SnapshotReader)
+    from distkeras_tpu.utils import locks
+
+    print("== cluster scenario: canary_bad_push (NaN weights, valid "
+          "checksums) ==", flush=True)
+    try:
+        snapdir = os.path.join(workdir, "canary_snaps")
+        os.makedirs(snapdir, exist_ok=True)
+        engines, router, template, CanaryController = _push_fleet(seed)
+        pub = SnapshotPublisher(snapdir)
+        reader = SnapshotReader(snapdir)
+        ctl = CanaryController(router, reader, CFG, template)
+        base_viol = locks.violation_count()
+
+        good = jax.tree.map(
+            np.asarray, tfm.init_params(jax.random.key(seed + 1), CFG))
+        pub.publish(good, 1)
+        rec = ctl.poll()
+        assert rec is not None and rec["action"] == "promote", rec
+        served = _push_wave(router)
+        # In-flight requests straddle the bad push: enqueue, partially
+        # decode, push, then drain — every request must still finish.
+        straddlers = [router.enqueue([9 + i, 8, 7], 6) for i in range(3)]
+        for _ in range(2):
+            router.step()
+        bad = jax.tree.map(
+            lambda a: np.full_like(np.asarray(a), np.nan), good)
+        pub.publish(bad, 2)                  # checksums are VALID
+        rec2 = ctl.poll()
+        assert rec2 is not None and rec2["action"] == "rollback", rec2
+        assert rec2["reason"] == "drift" and rec2["drift"] == float(
+            "inf"), rec2
+        assert all(e.param_version == 1 for e in engines), (
+            [e.param_version for e in engines])
+        for r in straddlers:                 # zero lost requests
+            router.drain(r)
+        after = _push_wave(router)
+        assert after == served, (
+            "rollback did not restore bit-identical serving")
+        assert ctl.poll() is None, "rejected version re-pushed"
+        assert locks.violation_count() == base_viol, (
+            "lock sanitizer violations during the canary leg")
+        print("  PASS  cluster/canary_bad_push: drift probe tripped "
+              "(inf), fleet rolled back to v1, straddling requests "
+              "all finished, tokens bit-identical post-rollback, "
+              "rejected v2 quarantined")
+        return 0
+    except Exception as e:  # noqa: BLE001 — report the ladder
+        print(f"  FAIL  cluster/canary_bad_push: "
+              f"{type(e).__name__}: {e}")
+        return 1
+
+
 def run_cluster_ladder(scenarios, seed, workdir):
     """The --cluster entry: reference run + one chaos run per
     training scenario (bit-for-bit weight comparison, merged
@@ -1399,6 +1613,12 @@ def run_cluster_ladder(scenarios, seed, workdir):
     if "autoscale_spike" in scenarios:
         scenarios.remove("autoscale_spike")
         failures += run_autoscale_spike_scenario(seed, workdir)
+    if "train_kill_push" in scenarios:
+        scenarios.remove("train_kill_push")
+        failures += run_train_kill_push_scenario(seed, workdir)
+    if "canary_bad_push" in scenarios:
+        scenarios.remove("canary_bad_push")
+        failures += run_canary_bad_push_scenario(seed, workdir)
     if not scenarios:
         return failures
 
@@ -1518,7 +1738,8 @@ def main():
     ap.add_argument("--scenarios",
                     default="kill,stall,drop,serve_kill,"
                             "serve_kill_prefill,autoscale_spike,"
-                            "async_stall,async_kill_push",
+                            "async_stall,async_kill_push,"
+                            "train_kill_push,canary_bad_push",
                     help="--cluster fault kinds to run "
                          "(kill = host loss, stall = wedged heartbeat "
                          "writer, drop = partition, serve_kill = "
@@ -1527,7 +1748,10 @@ def main():
                          "scale-up with a warm-pool replica SIGKILLed "
                          "mid-join, async_stall = bounded-staleness "
                          "straggler in the async tier, async_kill_push "
-                         "= host loss mid-delta-publish)")
+                         "= host loss mid-delta-publish, "
+                         "train_kill_push = trainer SIGKILL mid-weight-"
+                         "publish, canary_bad_push = poisoned weight "
+                         "push rolled back by the canary gate)")
     ap.add_argument("--workdir", default=None,
                     help="--cluster scratch dir (default: a temp dir, "
                          "kept on failure)")

@@ -872,6 +872,70 @@ def session_serving_autoscale():
         "never device work")
 
 
+def session_serving_weight_push():
+    """Live weight push (round 20): two hot_swap ContinuousBatchers
+    behind a Router with the publish→canary plumbing.  Construction
+    compiles everything — the hot_swap step/admission programs take
+    params as an explicit jit argument, and the canary's logit-drift
+    probe is compiled and warmed in the controller's constructor (the
+    recorded budget).  The entire PUSH phase — serve, a promoted
+    rollout (canary swap, drift probe, fleet-wide swap), serving the
+    new version, a rejected NaN push rolled back, and serving after
+    the rollback — is asserted to compile ZERO programs: a weight
+    swap is a host-side rebind that reproduces the live placement,
+    never a recompile."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import transformer as tfm
+    from distkeras_tpu.serving import (ContinuousBatcher,
+                                       InProcessReplica, Router)
+    from distkeras_tpu.serving.canary import CanaryController
+    from distkeras_tpu.serving.publish import (SnapshotPublisher,
+                                               SnapshotReader)
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=32,
+                                rope=True)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    engines = [ContinuousBatcher(params, cfg, lanes=2, hot_swap=True)
+               for _ in range(2)]
+    router = Router([InProcessReplica(f"r{i}", e)
+                     for i, e in enumerate(engines)])
+    root = tempfile.mkdtemp()
+    pub = SnapshotPublisher(root)
+    template = jax.eval_shape(
+        lambda: tfm.init_params(jax.random.key(0), cfg))
+    ctl = CanaryController(router, SnapshotReader(root), cfg, template)
+    built = _COMPILES["n"]
+
+    def serve():
+        rids = [router.enqueue([1 + i, 2, 3], 5) for i in range(3)]
+        for r in rids:
+            router.drain(r)
+
+    serve()
+    good = jax.tree.map(np.asarray,
+                        tfm.init_params(jax.random.key(1), cfg))
+    pub.publish(good, 1)
+    rec = ctl.poll()
+    assert rec["action"] == "promote", f"good push not promoted: {rec}"
+    serve()
+    bad = jax.tree.map(lambda a: np.full_like(a, np.nan), good)
+    pub.publish(bad, 2)
+    rec = ctl.poll()
+    assert rec["action"] == "rollback", f"NaN push not rejected: {rec}"
+    serve()
+    swap = _COMPILES["n"] - built
+    assert swap == 0, (
+        f"weight push cycle compiled {swap} program(s); a live swap "
+        "must rebind the params argument under the live placement — "
+        "a compile here means the swapped tree re-keyed the jit "
+        "cache (committedness or layout drift)")
+
+
 SESSIONS = {
     "adag": lambda: session_adag(),
     "adag_zero1": lambda: session_adag(zero1=True),
@@ -939,6 +1003,10 @@ SESSIONS = {
     # ASSERTED zero-compile inside the session (appended LAST so every
     # earlier warm-cache budget delta is unchanged).
     "serving_autoscale": session_serving_autoscale,
+    # Round 20: the train→serve weight push — swap + serve phases are
+    # ASSERTED zero-compile inside the session (appended LAST so every
+    # earlier warm-cache budget delta is unchanged).
+    "serving_weight_push": session_serving_weight_push,
 }
 
 

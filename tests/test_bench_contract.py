@@ -411,3 +411,37 @@ def test_bench_autoscale_row(monkeypatch):
                 "autoscaled_ttft_p99_ticks", "shape"):
         assert key in extras
     assert p99_auto == extras["autoscaled_ttft_p99_ticks"]
+
+
+def test_bench_canary_rollout_row(monkeypatch):
+    """Round-20 live-push row: a canary promote lands mid-stream over
+    in-flight requests.  Both legs must be per-version token-
+    deterministic, the push must actually change the served tokens,
+    and the victim TPOT ratio / rollout wall-clock must be finite."""
+    import bench_serving as bs
+    from distkeras_tpu import obs
+
+    monkeypatch.setattr(bs, "_cfg", lambda window=None:
+                        _tiny_serving_cfg())
+    sess = obs.enable()
+    try:
+        ratio, rollout_s, _, extras = bs.bench_canary_rollout()(
+            n_req=3, max_new=6, push_after=2, lanes=2)
+    finally:
+        obs.disable()
+    assert extras["tokens_deterministic_per_version"], (
+        "same-seed legs produced different token streams")
+    assert extras["tokens_changed_at_push"], (
+        "the mid-stream push left every token stream unchanged — the "
+        "swap never landed")
+    assert extras["rollout_wallclock_ms"] > 0
+    # extras round to 3 decimals of a millisecond; compare in seconds
+    # with the matching absolute slack.
+    assert rollout_s == pytest.approx(
+        extras["rollout_wallclock_ms"] / 1e3, abs=1e-6)
+    assert ratio == pytest.approx(extras["tpot_p99_push_ms"]
+                                  / extras["tpot_p99_baseline_ms"],
+                                  rel=0.05)
+    for key in ("tpot_p99_push_ms", "tpot_p99_baseline_ms", "n_req",
+                "push_after_steps"):
+        assert key in extras
